@@ -1,0 +1,70 @@
+"""Benchmark entry point — prints ONE JSON line for the driver.
+
+Measures sync-SGD training throughput (fwd+bwd+update, the reference's
+"records/second" metric, DistriOptimizer.scala:241-244) on the flagship
+image model. BASELINE.json publishes no reference absolute numbers
+(`published: {}`), so vs_baseline is 0.0 until a reference number exists.
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from bigdl_tpu import nn
+    from bigdl_tpu.models.lenet import lenet5
+    from bigdl_tpu.optim import SGD
+
+    batch = 512
+    model = lenet5(10)
+    crit = nn.ClassNLLCriterion()
+    opt = SGD(learning_rate=0.05, momentum=0.9)
+
+    rng = jax.random.PRNGKey(0)
+    params = model.init(rng)
+    mod_state = model.init_state()
+    opt_state = opt.init(params)
+
+    x = jnp.asarray(np.random.RandomState(0)
+                    .randn(batch, 28, 28, 1).astype(np.float32))
+    y = jnp.asarray(np.random.RandomState(1).randint(0, 10, batch))
+
+    @jax.jit
+    def step(params, mod_state, opt_state, x, y):
+        def loss_fn(p):
+            out, ms = model.apply(p, mod_state, x, training=True)
+            return crit(out, y), ms
+
+        (loss, ms), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        new_params, new_opt = opt.update(grads, opt_state, params)
+        return new_params, ms, new_opt, loss
+
+    # warmup / compile
+    params, mod_state, opt_state, loss = step(params, mod_state, opt_state, x, y)
+    jax.block_until_ready(loss)
+
+    iters = 30
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        params, mod_state, opt_state, loss = step(params, mod_state,
+                                                  opt_state, x, y)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+    ips = batch * iters / dt
+
+    print(json.dumps({
+        "metric": "lenet5_mnist_train_throughput",
+        "value": round(ips, 1),
+        "unit": "images/sec/chip",
+        "vs_baseline": 0.0,
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,13 @@
+"""bigdl-tpu: a TPU-native deep-learning framework with the capabilities of
+early BigDL (the Scala/Spark + Intel-MKL library at /root/reference).
+
+Nothing here is a port: the reference's MKL/JNI compute lowers to XLA HLO,
+its Engine thread pools dissolve into the compiler, and its Spark
+BlockManager all-reduce becomes ICI/DCN collectives (see bigdl_tpu.parallel).
+"""
+
+__version__ = "0.1.0"
+
+from bigdl_tpu import core, nn
+
+__all__ = ["core", "nn", "__version__"]

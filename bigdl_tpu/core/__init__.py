@@ -1,0 +1,22 @@
+from bigdl_tpu.core.module import (
+    Module,
+    SimpleModule,
+    ElementwiseModule,
+    Container,
+    Sequential,
+    Identity,
+    Lambda,
+    EMPTY_STATE,
+    uniform_fan_in,
+    xavier_uniform,
+)
+from bigdl_tpu.core.criterion import Criterion
+from bigdl_tpu.core.pytree import (
+    flatten_params,
+    tree_zeros_like,
+    tree_add,
+    tree_scale,
+    tree_size,
+    tree_global_norm,
+    tree_cast,
+)

@@ -1,0 +1,37 @@
+"""Criterion (loss) base class.
+
+Functional analog of the reference's AbstractCriterion
+(dl/src/main/scala/com/intel/analytics/bigdl/nn/abstractnn/AbstractCriterion.scala):
+``forward(input, target) -> scalar loss``. The backward half
+(``updateGradInput``) does not exist — gradients flow through ``jax.grad`` on
+the composed ``loss = criterion(module.apply(...), target)``.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax.numpy as jnp
+
+__all__ = ["Criterion"]
+
+
+class Criterion:
+    """Base class for losses. Subclasses implement :meth:`forward` as a pure
+    function returning a scalar (mean over the batch unless
+    ``size_average=False``, matching the reference's sizeAverage flag)."""
+
+    def __init__(self, size_average: bool = True):
+        self.size_average = size_average
+
+    def forward(self, input: Any, target: Any) -> jnp.ndarray:
+        raise NotImplementedError(f"{type(self).__name__}.forward")
+
+    def __call__(self, input: Any, target: Any = None) -> jnp.ndarray:
+        return self.forward(input, target)
+
+    def _reduce(self, per_elem: jnp.ndarray) -> jnp.ndarray:
+        return jnp.mean(per_elem) if self.size_average else jnp.sum(per_elem)
+
+    def __repr__(self) -> str:
+        return f"{type(self).__name__}()"

@@ -1,0 +1,268 @@
+"""Core module system for bigdl-tpu.
+
+A functional, JAX-native re-design of the reference's mutable module tree
+(reference: dl/src/main/scala/com/intel/analytics/bigdl/nn/abstractnn/AbstractModule.scala:40-291).
+
+Design
+------
+The reference couples three things inside one mutable object: the layer's
+*description* (hyperparameters), its *parameters* (weight/gradWeight storage),
+and its *buffers* (cached output/gradInput, BN running stats). Under XLA that
+coupling is hostile: jit-compiled functions must be pure, and parameters must
+be explicit pytrees so they can be sharded with `jax.sharding` and donated
+between steps.
+
+So here a :class:`Module` is a cheap, immutable *description*. Parameters and
+mutable state live outside it:
+
+* ``params = module.init(rng)`` — a pytree (nested dicts) of ``jnp`` arrays.
+* ``state = module.init_state()`` — a pytree for non-gradient buffers
+  (e.g. BatchNormalization running mean/var). ``()`` when stateless.
+* ``y, new_state = module.apply(params, state, x, training=..., rng=...)`` —
+  the pure forward function. Under ``jax.grad`` this single function replaces
+  the reference's ``updateOutput`` / ``updateGradInput`` /
+  ``accGradParameters`` triple (AbstractModule.scala:161-183): XLA autodiff
+  derives both gradient paths from ``apply``.
+
+There is no ``backward`` anywhere: gradients come from ``jax.value_and_grad``
+over a loss composed with ``apply``. There is no ``Engine`` thread pool
+(reference utils/Engine.scala): intra-op parallelism is XLA's job.
+
+Containers (:class:`Sequential` & friends in ``bigdl_tpu.nn``) store child
+params under string keys ``"0", "1", ...`` so checkpoints are stable and
+human-readable.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "Module",
+    "SimpleModule",
+    "ElementwiseModule",
+    "Container",
+    "Sequential",
+    "Identity",
+    "Lambda",
+    "EMPTY_STATE",
+]
+
+# Canonical "no state" sentinel. An empty tuple is a valid (leaf-less) pytree,
+# so it threads through jit/grad transparently.
+EMPTY_STATE = ()
+
+Params = Any
+State = Any
+PRNGKey = jax.Array
+
+
+def _child_rng(rng: Optional[PRNGKey], index: int) -> Optional[PRNGKey]:
+    """Deterministic per-child rng stream (None propagates)."""
+    if rng is None:
+        return None
+    return jax.random.fold_in(rng, index)
+
+
+class Module:
+    """Base class for all layers and containers.
+
+    Subclasses override :meth:`init`, optionally :meth:`init_state`, and
+    :meth:`apply`. ``apply`` must be pure (traceable under ``jax.jit``).
+    """
+
+    def __init__(self, name: Optional[str] = None):
+        self.name = name if name is not None else type(self).__name__
+
+    # ------------------------------------------------------------------ init
+    def init(self, rng: PRNGKey) -> Params:
+        """Create this module's parameter pytree. Paramless modules return {}."""
+        del rng
+        return {}
+
+    def init_state(self) -> State:
+        """Create the non-gradient state pytree (running stats etc.)."""
+        return EMPTY_STATE
+
+    # ----------------------------------------------------------------- apply
+    def apply(
+        self,
+        params: Params,
+        state: State,
+        x: Any,
+        *,
+        training: bool = False,
+        rng: Optional[PRNGKey] = None,
+    ) -> tuple[Any, State]:
+        """Pure forward pass. Returns ``(output, new_state)``."""
+        raise NotImplementedError(f"{type(self).__name__}.apply")
+
+    # ----------------------------------------------------------- convenience
+    def forward(
+        self,
+        params: Params,
+        x: Any,
+        state: Optional[State] = None,
+        *,
+        training: bool = False,
+        rng: Optional[PRNGKey] = None,
+    ) -> Any:
+        """Forward that discards the state update (inference convenience).
+        ``state=None`` uses a freshly-initialized state."""
+        if state is None:
+            state = self.init_state()
+        y, _ = self.apply(params, state, x, training=training, rng=rng)
+        return y
+
+    def __call__(self, params: Params, x: Any, **kw: Any) -> Any:
+        return self.forward(params, x, **kw)
+
+    # ------------------------------------------------------------ reflection
+    def children(self) -> Sequence["Module"]:
+        return ()
+
+    def modules(self) -> list["Module"]:
+        """This module and all descendants, depth-first (reference
+        Container.scala:41-90 recursion)."""
+        out: list[Module] = [self]
+        for c in self.children():
+            out.extend(c.modules())
+        return out
+
+    def named_modules(self, prefix: str = "") -> list[tuple[str, "Module"]]:
+        """(path, module) pairs; paths mirror the params-pytree keys."""
+        me = prefix if prefix else self.name
+        out: list[tuple[str, Module]] = [(me, self)]
+        for i, c in enumerate(self.children()):
+            out.extend(c.named_modules(f"{me}.{i}:{c.name}"))
+        return out
+
+    def param_count(self, params: Params) -> int:
+        return sum(int(p.size) for p in jax.tree_util.tree_leaves(params))
+
+    def __repr__(self) -> str:
+        return f"{type(self).__name__}(name={self.name!r})"
+
+
+class SimpleModule(Module):
+    """A module with no mutable state. Subclasses implement ``_forward``."""
+
+    def _forward(
+        self,
+        params: Params,
+        x: Any,
+        *,
+        training: bool,
+        rng: Optional[PRNGKey],
+    ) -> Any:
+        raise NotImplementedError
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        return self._forward(params, x, training=training, rng=rng), state
+
+
+class ElementwiseModule(SimpleModule):
+    """Paramless elementwise op defined by a single jnp function."""
+
+    def _fn(self, x):
+        raise NotImplementedError
+
+    def _forward(self, params, x, *, training, rng):
+        del params, training, rng
+        return self._fn(x)
+
+
+class Identity(ElementwiseModule):
+    """Pass-through (reference nn/Identity.scala)."""
+
+    def _fn(self, x):
+        return x
+
+
+class Lambda(SimpleModule):
+    """Wrap an arbitrary pure function as a paramless module."""
+
+    def __init__(self, fn: Callable[[Any], Any], name: Optional[str] = None):
+        super().__init__(name or getattr(fn, "__name__", "Lambda"))
+        self.fn = fn
+
+    def _forward(self, params, x, *, training, rng):
+        del params, training, rng
+        return self.fn(x)
+
+
+class Container(Module):
+    """Base container holding an ordered list of children (reference
+    nn/Container.scala:28-112). Child params/state are stored in dicts keyed
+    by the child's index as a string, giving stable checkpoint layouts."""
+
+    def __init__(self, *modules: Module, name: Optional[str] = None):
+        super().__init__(name)
+        self._modules: list[Module] = list(modules)
+
+    def add(self, module: Module) -> "Container":
+        """Append a child (mirrors Container.add, nn/Container.scala:36).
+
+        Mutation is allowed here because it edits the *description* before
+        ``init``/``apply`` — never traced state."""
+        self._modules.append(module)
+        return self
+
+    def children(self) -> Sequence[Module]:
+        return tuple(self._modules)
+
+    def __len__(self) -> int:
+        return len(self._modules)
+
+    def __getitem__(self, i: int) -> Module:
+        return self._modules[i]
+
+    def init(self, rng: PRNGKey) -> Params:
+        return {
+            str(i): m.init(_child_rng(rng, i))
+            for i, m in enumerate(self._modules)
+        }
+
+    def init_state(self) -> State:
+        return {str(i): m.init_state() for i, m in enumerate(self._modules)}
+
+    def __repr__(self) -> str:
+        inner = ", ".join(repr(m) for m in self._modules)
+        return f"{type(self).__name__}({inner})"
+
+
+class Sequential(Container):
+    """Feed-forward chain (reference nn/Sequential.scala:26)."""
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        new_state: dict[str, State] = {}
+        for i, m in enumerate(self._modules):
+            k = str(i)
+            x, s = m.apply(
+                params[k], state[k], x, training=training, rng=_child_rng(rng, i)
+            )
+            new_state[k] = s
+        return x, new_state
+
+
+# --------------------------------------------------------------------------
+# Shared init helpers (used by layers' default resets; formulas match the
+# reference's InitializationMethod semantics, nn/InitializationMethod.scala).
+# --------------------------------------------------------------------------
+
+def uniform_fan_in(rng: PRNGKey, shape: Sequence[int], fan_in: int, dtype=jnp.float32):
+    """Torch-style default init: U(-1/sqrt(fanIn), 1/sqrt(fanIn))."""
+    stdv = 1.0 / math.sqrt(max(1, fan_in))
+    return jax.random.uniform(rng, tuple(shape), dtype, minval=-stdv, maxval=stdv)
+
+
+def xavier_uniform(rng: PRNGKey, shape: Sequence[int], fan_in: int, fan_out: int, dtype=jnp.float32):
+    """Xavier/Glorot uniform: U(+-sqrt(6/(fanIn+fanOut))) (reference
+    InitializationMethod.Xavier as used by SpatialConvolution.reset,
+    nn/SpatialConvolution.scala:88-103)."""
+    a = math.sqrt(6.0 / max(1, fan_in + fan_out))
+    return jax.random.uniform(rng, tuple(shape), dtype, minval=-a, maxval=a)

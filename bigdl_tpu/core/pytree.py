@@ -1,0 +1,60 @@
+"""Parameter-pytree utilities.
+
+The reference compacts all parameters into one contiguous storage via
+``Module.flatten`` (dl/src/main/scala/com/intel/analytics/bigdl/nn/Module.scala:42-91),
+then every clone aliases that storage. In JAX the same capability — "view the
+whole model as one flat vector" (used by LBFGS, gradient checking, checkpoint
+size accounting) — is `ravel_pytree`, with the unravel closure replacing
+storage aliasing.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax.flatten_util import ravel_pytree
+
+__all__ = [
+    "flatten_params",
+    "tree_zeros_like",
+    "tree_add",
+    "tree_scale",
+    "tree_size",
+    "tree_global_norm",
+    "tree_cast",
+]
+
+
+def flatten_params(params: Any) -> tuple[jnp.ndarray, Callable[[jnp.ndarray], Any]]:
+    """Return ``(flat_vector, unflatten_fn)`` — the functional analog of
+    ``Module.getParameters`` (AbstractModule.scala:199-202)."""
+    return ravel_pytree(params)
+
+
+def tree_zeros_like(tree: Any) -> Any:
+    return jax.tree_util.tree_map(jnp.zeros_like, tree)
+
+
+def tree_add(a: Any, b: Any) -> Any:
+    return jax.tree_util.tree_map(jnp.add, a, b)
+
+
+def tree_scale(tree: Any, s) -> Any:
+    return jax.tree_util.tree_map(lambda x: x * s, tree)
+
+
+def tree_size(tree: Any) -> int:
+    return sum(int(x.size) for x in jax.tree_util.tree_leaves(tree))
+
+
+def tree_global_norm(tree: Any) -> jnp.ndarray:
+    leaves = jax.tree_util.tree_leaves(tree)
+    if not leaves:
+        return jnp.zeros(())
+    return jnp.sqrt(sum(jnp.sum(jnp.square(x.astype(jnp.float32))) for x in leaves))
+
+
+def tree_cast(tree: Any, dtype) -> Any:
+    return jax.tree_util.tree_map(lambda x: x.astype(dtype), tree)

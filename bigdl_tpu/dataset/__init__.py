@@ -1,0 +1,7 @@
+from bigdl_tpu.dataset.transformer import (
+    Transformer, ChainedTransformer, FnTransformer,
+)
+from bigdl_tpu.dataset.dataset import (
+    DataSet, LocalArrayDataSet, BatchDataSet, MiniBatch,
+)
+from bigdl_tpu.dataset import mnist, image

@@ -1,0 +1,127 @@
+"""DataSet abstractions (reference dataset/DataSet.scala).
+
+The reference distinguishes LocalDataSet (in-memory array + atomic cursor)
+from DistributedDataSet (cached RDDs, partition==executor). Here a DataSet is
+a host-side batch source; the distributed analog shards *by host process*
+(each host reads its slice and forms its local part of the global batch —
+the `jax.make_array_from_process_local_data` model that replaces
+ZippedPartitionsWithLocalityRDD, SURVEY.md §2.6).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterator, Optional, Sequence
+
+import numpy as np
+
+from bigdl_tpu.dataset.transformer import Transformer
+
+__all__ = ["DataSet", "LocalArrayDataSet", "BatchDataSet", "MiniBatch"]
+
+
+class MiniBatch:
+    """(input, target) batch pair (reference dataset/Types.scala:74)."""
+
+    __slots__ = ("input", "target")
+
+    def __init__(self, input: Any, target: Any):
+        self.input = input
+        self.target = target
+
+    def __iter__(self):  # tuple-unpacking convenience
+        yield self.input
+        yield self.target
+
+    @property
+    def size(self) -> int:
+        return len(self.input)
+
+
+class DataSet:
+    """Base: iterate one epoch of elements; ``size`` = element count
+    (reference AbstractDataSet: data(train)/size/shuffle :47-105)."""
+
+    def __iter__(self) -> Iterator:
+        raise NotImplementedError
+
+    def size(self) -> int:
+        raise NotImplementedError
+
+    def shuffle(self, seed: Optional[int] = None) -> None:
+        """Reshuffle the epoch order (reference CachedDistriDataSet.shuffle)."""
+
+    def transform(self, t: Transformer) -> "TransformedDataSet":
+        """(reference AbstractDataSet.transform/-> :74-88)"""
+        return TransformedDataSet(self, t)
+
+    def __rshift__(self, t: Transformer) -> "TransformedDataSet":
+        return self.transform(t)
+
+
+class TransformedDataSet(DataSet):
+    def __init__(self, base: DataSet, t: Transformer):
+        self.base, self.t = base, t
+
+    def __iter__(self):
+        return self.t(iter(self.base))
+
+    def size(self):
+        return self.base.size()
+
+    def shuffle(self, seed=None):
+        self.base.shuffle(seed)
+
+
+class LocalArrayDataSet(DataSet):
+    """In-memory sample array with per-epoch shuffling
+    (reference DataSet.scala:111-157; the endless modulo-cursor train
+    iterator becomes "the training loop re-iterates each epoch")."""
+
+    def __init__(self, data: Sequence, shuffle: bool = False, seed: int = 0):
+        self.data = list(data)
+        self._shuffle = shuffle
+        self._rng = np.random.RandomState(seed)
+        self._order = np.arange(len(self.data))
+
+    def __iter__(self):
+        if self._shuffle:
+            self._rng.shuffle(self._order)
+        return (self.data[i] for i in self._order)
+
+    def size(self):
+        return len(self.data)
+
+    def shuffle(self, seed=None):
+        if seed is not None:
+            self._rng = np.random.RandomState(seed)
+        self._rng.shuffle(self._order)
+
+
+class BatchDataSet(DataSet):
+    """Batches (features, labels) numpy arrays into MiniBatch objects —
+    the terminal stage the Optimizer consumes (analog of SampleToBatch,
+    dataset/Transformer.scala:73-140, including the drop-remainder semantics
+    training needs for static XLA shapes)."""
+
+    def __init__(self, features: np.ndarray, labels: np.ndarray,
+                 batch_size: int, shuffle: bool = False, seed: int = 0,
+                 drop_remainder: bool = True):
+        assert len(features) == len(labels)
+        self.features, self.labels = features, labels
+        self.batch_size = batch_size
+        self._shuffle = shuffle
+        self._rng = np.random.RandomState(seed)
+        self.drop_remainder = drop_remainder
+
+    def __iter__(self):
+        n = len(self.features)
+        order = np.arange(n)
+        if self._shuffle:
+            self._rng.shuffle(order)
+        end = (n - self.batch_size + 1) if self.drop_remainder else n
+        for i in range(0, max(end, 0), self.batch_size):
+            idx = order[i:i + self.batch_size]
+            yield MiniBatch(self.features[idx], self.labels[idx])
+
+    def size(self):
+        return len(self.features)

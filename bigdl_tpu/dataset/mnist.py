@@ -1,0 +1,61 @@
+"""MNIST idx-ubyte reader (reference models/lenet/Utils.scala raw readers).
+
+Returns NHWC float arrays — images (N, 28, 28, 1) uint8->float32, labels
+(N,) int32 0-based (the reference emits 1-based labels for Lua parity; we
+use 0-based throughout).
+"""
+
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+
+import numpy as np
+
+__all__ = ["load_images", "load_labels", "load_mnist",
+           "TRAIN_MEAN", "TRAIN_STD"]
+
+# Canonical MNIST training-set statistics (reference models/lenet/Utils.scala
+# trainMean/trainStd constants).
+TRAIN_MEAN = 0.13066047740239506
+TRAIN_STD = 0.3081078
+
+_IMG_MAGIC = 2051
+_LBL_MAGIC = 2049
+
+
+def _open(path: str):
+    return gzip.open(path, "rb") if path.endswith(".gz") else open(path, "rb")
+
+
+def load_images(path: str) -> np.ndarray:
+    with _open(path) as f:
+        magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+        if magic != _IMG_MAGIC:
+            raise ValueError(f"bad MNIST image magic {magic} in {path}")
+        data = np.frombuffer(f.read(n * rows * cols), np.uint8)
+    return data.reshape(n, rows, cols, 1)
+
+
+def load_labels(path: str) -> np.ndarray:
+    with _open(path) as f:
+        magic, n = struct.unpack(">II", f.read(8))
+        if magic != _LBL_MAGIC:
+            raise ValueError(f"bad MNIST label magic {magic} in {path}")
+        return np.frombuffer(f.read(n), np.uint8).astype(np.int32)
+
+
+def load_mnist(folder: str, train: bool = True):
+    """Load (images, labels) from the standard file names."""
+    stem = "train" if train else "t10k"
+    imgs = labels = None
+    for suffix in ("", ".gz"):
+        ip = os.path.join(folder, f"{stem}-images-idx3-ubyte{suffix}")
+        lp = os.path.join(folder, f"{stem}-labels-idx1-ubyte{suffix}")
+        if os.path.exists(ip) and os.path.exists(lp):
+            imgs, labels = load_images(ip), load_labels(lp)
+            break
+    if imgs is None:
+        raise FileNotFoundError(f"MNIST files for '{stem}' not in {folder}")
+    return imgs, labels

@@ -1,0 +1,30 @@
+"""LeNet-5 (reference models/lenet/Model.scala:26-40).
+
+Same topology as the reference: conv(1->6,5x5) tanh pool conv(6->12,5x5)
+tanh pool fc(12*4*4->100) tanh fc(100->10) logsoftmax — expressed over NHWC
+(28,28,1) inputs. BASELINE config 1 ("LeNet-5 on MNIST, local mode").
+"""
+
+from __future__ import annotations
+
+from bigdl_tpu.core.module import Sequential
+from bigdl_tpu import nn
+
+__all__ = ["lenet5"]
+
+
+def lenet5(class_num: int = 10) -> Sequential:
+    return Sequential(
+        nn.SpatialConvolution(1, 6, 5, 5),
+        nn.Tanh(),
+        nn.SpatialMaxPooling(2, 2, 2, 2),
+        nn.Tanh(),
+        nn.SpatialConvolution(6, 12, 5, 5),
+        nn.SpatialMaxPooling(2, 2, 2, 2),
+        nn.Reshape([12 * 4 * 4]),
+        nn.Linear(12 * 4 * 4, 100),
+        nn.Tanh(),
+        nn.Linear(100, class_num),
+        nn.LogSoftMax(),
+        name="LeNet5",
+    )

@@ -1,0 +1,298 @@
+"""Activation layers — parity with the reference's activation zoo
+(dl/src/main/scala/com/intel/analytics/bigdl/nn/{ReLU,Tanh,...}.scala).
+
+Every one of these is a fused elementwise op under XLA; none of the
+reference's intra-layer threading (e.g. Threshold.scala:72-336) is needed —
+the compiler fuses these into neighboring matmuls/convs on the MXU/VPU path.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.core.module import ElementwiseModule, SimpleModule, Module
+
+__all__ = [
+    "ReLU", "ReLU6", "PReLU", "RReLU", "LeakyReLU", "ELU", "Threshold",
+    "Tanh", "TanhShrink", "Sigmoid", "LogSigmoid", "HardTanh", "HardShrink",
+    "SoftShrink", "SoftPlus", "SoftSign", "SoftMax", "SoftMin", "LogSoftMax",
+    "Power", "Square", "Sqrt", "Abs", "Exp", "Log", "Clamp",
+    "GradientReversal",
+]
+
+
+class ReLU(ElementwiseModule):
+    """max(x, 0) (reference nn/ReLU.scala; ip=true has no meaning functionally)."""
+
+    def _fn(self, x):
+        return jax.nn.relu(x)
+
+
+class ReLU6(ElementwiseModule):
+    """min(max(x,0),6) (reference nn/ReLU6.scala)."""
+
+    def _fn(self, x):
+        return jax.nn.relu6(x)
+
+
+class Threshold(ElementwiseModule):
+    """x if x > th else v (reference nn/Threshold.scala:403-LoC file)."""
+
+    def __init__(self, th: float = 1e-6, v: float = 0.0, name=None):
+        super().__init__(name)
+        self.th, self.v = th, v
+
+    def _fn(self, x):
+        return jnp.where(x > self.th, x, jnp.asarray(self.v, x.dtype))
+
+
+class PReLU(SimpleModule):
+    """Parametric ReLU with learned per-channel (or shared) slope
+    (reference nn/PReLU.scala, 314 LoC). ``n_output_plane=0`` shares one
+    scalar; otherwise one slope per channel, channels last (NHWC)."""
+
+    def __init__(self, n_output_plane: int = 0, name=None):
+        super().__init__(name)
+        self.n_output_plane = n_output_plane
+
+    def init(self, rng):
+        n = max(1, self.n_output_plane)
+        return {"weight": jnp.full((n,), 0.25, jnp.float32)}
+
+    def _forward(self, params, x, *, training, rng):
+        w = params["weight"].astype(x.dtype)
+        if self.n_output_plane == 0:
+            w = w[0]
+        # channels-last broadcast: (..., C) * (C,)
+        return jnp.where(x >= 0, x, w * x)
+
+
+class RReLU(SimpleModule):
+    """Randomized leaky ReLU (reference nn/RReLU.scala): slope ~ U(lower,upper)
+    per element in training, fixed mean slope at inference."""
+
+    def __init__(self, lower: float = 1.0 / 8, upper: float = 1.0 / 3, name=None):
+        super().__init__(name)
+        self.lower, self.upper = lower, upper
+
+    def _forward(self, params, x, *, training, rng):
+        if training:
+            if rng is None:
+                raise ValueError("RReLU needs an rng in training mode")
+            a = jax.random.uniform(
+                rng, x.shape, x.dtype, minval=self.lower, maxval=self.upper
+            )
+        else:
+            a = jnp.asarray((self.lower + self.upper) / 2, x.dtype)
+        return jnp.where(x >= 0, x, a * x)
+
+
+class LeakyRelUBase(ElementwiseModule):
+    negval = 0.01
+
+    def _fn(self, x):
+        return jnp.where(x >= 0, x, jnp.asarray(self.negval, x.dtype) * x)
+
+
+class LeakyReLU(LeakyRelUBase):
+    """(reference nn/LeakyReLU.scala)"""
+
+    def __init__(self, negval: float = 0.01, name=None):
+        super().__init__(name)
+        self.negval = negval
+
+
+class ELU(ElementwiseModule):
+    """(reference nn/ELU.scala)"""
+
+    def __init__(self, alpha: float = 1.0, name=None):
+        super().__init__(name)
+        self.alpha = alpha
+
+    def _fn(self, x):
+        safe = jnp.where(x > 0, 0.0, x)  # avoid overflow in exp for large x
+        return jnp.where(x > 0, x, self.alpha * jnp.expm1(safe))
+
+
+class Tanh(ElementwiseModule):
+    def _fn(self, x):
+        return jnp.tanh(x)
+
+
+class TanhShrink(ElementwiseModule):
+    """x - tanh(x) (reference nn/TanhShrink.scala)."""
+
+    def _fn(self, x):
+        return x - jnp.tanh(x)
+
+
+class Sigmoid(ElementwiseModule):
+    def _fn(self, x):
+        return jax.nn.sigmoid(x)
+
+
+class LogSigmoid(ElementwiseModule):
+    def _fn(self, x):
+        return jax.nn.log_sigmoid(x)
+
+
+class HardTanh(ElementwiseModule):
+    """clip(x, min_value, max_value) (reference nn/HardTanh.scala, 213 LoC)."""
+
+    def __init__(self, min_value: float = -1.0, max_value: float = 1.0, name=None):
+        super().__init__(name)
+        assert max_value > min_value
+        self.min_value, self.max_value = min_value, max_value
+
+    def _fn(self, x):
+        return jnp.clip(x, self.min_value, self.max_value)
+
+
+class Clamp(HardTanh):
+    """Alias of HardTanh with int bounds (reference nn/Clamp.scala)."""
+
+    def __init__(self, min_value: int, max_value: int, name=None):
+        super().__init__(float(min_value), float(max_value), name)
+
+
+class HardShrink(ElementwiseModule):
+    """x if |x| > lambda else 0 (reference nn/HardShrink.scala)."""
+
+    def __init__(self, lam: float = 0.5, name=None):
+        super().__init__(name)
+        self.lam = lam
+
+    def _fn(self, x):
+        return jnp.where(jnp.abs(x) > self.lam, x, jnp.zeros_like(x))
+
+
+class SoftShrink(ElementwiseModule):
+    """sign(x)*max(|x|-lambda, 0) (reference nn/SoftShrink.scala)."""
+
+    def __init__(self, lam: float = 0.5, name=None):
+        super().__init__(name)
+        self.lam = lam
+
+    def _fn(self, x):
+        return jnp.sign(x) * jnp.maximum(jnp.abs(x) - self.lam, 0.0)
+
+
+class SoftPlus(ElementwiseModule):
+    """log(1+exp(beta*x))/beta with linear tail (reference nn/SoftPlus.scala)."""
+
+    def __init__(self, beta: float = 1.0, name=None):
+        super().__init__(name)
+        self.beta = beta
+
+    def _fn(self, x):
+        return jax.nn.softplus(self.beta * x) / self.beta
+
+
+class SoftSign(ElementwiseModule):
+    """x / (1+|x|) (reference nn/SoftSign.scala)."""
+
+    def _fn(self, x):
+        return jax.nn.soft_sign(x)
+
+
+class SoftMax(ElementwiseModule):
+    """Softmax over the last axis (reference nn/SoftMax.scala operates over
+    the feature dim; here features are axis -1)."""
+
+    def __init__(self, axis: int = -1, name=None):
+        super().__init__(name)
+        self.axis = axis
+
+    def _fn(self, x):
+        return jax.nn.softmax(x, axis=self.axis)
+
+
+class SoftMin(ElementwiseModule):
+    """softmax(-x) (reference nn/SoftMin.scala)."""
+
+    def __init__(self, axis: int = -1, name=None):
+        super().__init__(name)
+        self.axis = axis
+
+    def _fn(self, x):
+        return jax.nn.softmax(-x, axis=self.axis)
+
+
+class LogSoftMax(ElementwiseModule):
+    """Numerically-stable log-softmax (reference nn/LogSoftMax.scala)."""
+
+    def __init__(self, axis: int = -1, name=None):
+        super().__init__(name)
+        self.axis = axis
+
+    def _fn(self, x):
+        return jax.nn.log_softmax(x, axis=self.axis)
+
+
+class Power(ElementwiseModule):
+    """(shift + scale*x)^power (reference nn/Power.scala)."""
+
+    def __init__(self, power: float, scale: float = 1.0, shift: float = 0.0, name=None):
+        super().__init__(name)
+        self.power, self.scale, self.shift = power, scale, shift
+
+    def _fn(self, x):
+        return jnp.power(self.shift + self.scale * x, self.power)
+
+
+class Square(ElementwiseModule):
+    def _fn(self, x):
+        return jnp.square(x)
+
+
+class Sqrt(ElementwiseModule):
+    def _fn(self, x):
+        return jnp.sqrt(x)
+
+
+class Abs(ElementwiseModule):
+    def _fn(self, x):
+        return jnp.abs(x)
+
+
+class Exp(ElementwiseModule):
+    def _fn(self, x):
+        return jnp.exp(x)
+
+
+class Log(ElementwiseModule):
+    def _fn(self, x):
+        return jnp.log(x)
+
+
+@jax.custom_vjp
+def _grad_reverse(x, lam):
+    return x
+
+
+def _grad_reverse_fwd(x, lam):
+    return x, lam
+
+
+def _grad_reverse_bwd(lam, g):
+    return (-lam * g, None)
+
+
+_grad_reverse.defvjp(_grad_reverse_fwd, _grad_reverse_bwd)
+
+
+class GradientReversal(SimpleModule):
+    """Identity forward, -lambda * grad backward (reference
+    nn/GradientReversal.scala) — implemented as a custom VJP, the JAX analog
+    of overriding updateGradInput."""
+
+    def __init__(self, lam: float = 1.0, name=None):
+        super().__init__(name)
+        self.lam = lam
+
+    def _forward(self, params, x, *, training, rng):
+        del params, training, rng
+        return _grad_reverse(x, jnp.asarray(self.lam, x.dtype))

@@ -1,0 +1,317 @@
+"""Linear-algebra layers (reference nn/{Linear,Bilinear,CMul,...}.scala).
+
+TPU notes: Linear stores weight as (in, out) so the forward is a plain
+``x @ w`` feeding the MXU with no transpose; the reference stores (out, in)
+(Torch convention) — the difference is layout only, cited per class. Batched
+table ops (MM/MV/DotProduct/...) take Python tuples as the reference takes
+``Table`` inputs.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.core.module import (
+    Module,
+    SimpleModule,
+    uniform_fan_in,
+    xavier_uniform,
+)
+
+__all__ = [
+    "Linear", "Bilinear", "CMul", "CAdd", "Mul", "Add", "MulConstant",
+    "AddConstant", "MM", "MV", "Cosine", "Euclidean", "DotProduct",
+    "CosineDistance", "PairwiseDistance", "LookupTable",
+]
+
+
+class Linear(SimpleModule):
+    """y = x @ W + b (reference nn/Linear.scala, 203 LoC).
+
+    Weight shape (in_features, out_features) — transposed from the reference's
+    Torch layout so the matmul hits the MXU directly. Default init is
+    Torch-style U(+-1/sqrt(fanIn)) matching Linear.reset.
+    """
+
+    def __init__(
+        self,
+        in_features: int,
+        out_features: int,
+        with_bias: bool = True,
+        init: str = "default",
+        param_dtype=jnp.float32,
+        name: Optional[str] = None,
+    ):
+        super().__init__(name)
+        self.in_features = in_features
+        self.out_features = out_features
+        self.with_bias = with_bias
+        self.init_method = init
+        self.param_dtype = param_dtype
+
+    def init(self, rng):
+        k_w, k_b = jax.random.split(rng)
+        shape = (self.in_features, self.out_features)
+        if self.init_method == "xavier":
+            w = xavier_uniform(k_w, shape, self.in_features, self.out_features,
+                               self.param_dtype)
+        else:
+            w = uniform_fan_in(k_w, shape, self.in_features, self.param_dtype)
+        p = {"weight": w}
+        if self.with_bias:
+            p["bias"] = uniform_fan_in(k_b, (self.out_features,),
+                                       self.in_features, self.param_dtype)
+        return p
+
+    def _forward(self, params, x, *, training, rng):
+        w = params["weight"].astype(x.dtype)
+        y = x @ w
+        if self.with_bias:
+            y = y + params["bias"].astype(x.dtype)
+        return y
+
+
+class Bilinear(SimpleModule):
+    """y_k = x1 @ W_k @ x2 + b_k over a table input (x1, x2)
+    (reference nn/Bilinear.scala, 197 LoC)."""
+
+    def __init__(self, in1: int, in2: int, out: int, with_bias: bool = True,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.in1, self.in2, self.out = in1, in2, out
+        self.with_bias = with_bias
+
+    def init(self, rng):
+        k_w, k_b = jax.random.split(rng)
+        fan_in = self.in1 * self.in2
+        p = {"weight": uniform_fan_in(k_w, (self.out, self.in1, self.in2), fan_in)}
+        if self.with_bias:
+            p["bias"] = uniform_fan_in(k_b, (self.out,), fan_in)
+        return p
+
+    def _forward(self, params, x, *, training, rng):
+        x1, x2 = x
+        w = params["weight"].astype(x1.dtype)
+        # (B,in1),(out,in1,in2),(B,in2) -> (B,out)
+        y = jnp.einsum("bi,oij,bj->bo", x1, w, x2)
+        if self.with_bias:
+            y = y + params["bias"].astype(y.dtype)
+        return y
+
+
+class CMul(SimpleModule):
+    """Learned componentwise scale of given (broadcastable) size
+    (reference nn/CMul.scala)."""
+
+    def __init__(self, size: Sequence[int], name: Optional[str] = None):
+        super().__init__(name)
+        self.size = tuple(size)
+
+    def init(self, rng):
+        fan_in = int(jnp.prod(jnp.asarray(self.size)))
+        return {"weight": uniform_fan_in(rng, self.size, fan_in)}
+
+    def _forward(self, params, x, *, training, rng):
+        return x * params["weight"].astype(x.dtype)
+
+
+class CAdd(SimpleModule):
+    """Learned componentwise bias (reference nn/CAdd.scala)."""
+
+    def __init__(self, size: Sequence[int], name: Optional[str] = None):
+        super().__init__(name)
+        self.size = tuple(size)
+
+    def init(self, rng):
+        fan_in = int(jnp.prod(jnp.asarray(self.size)))
+        return {"bias": uniform_fan_in(rng, self.size, fan_in)}
+
+    def _forward(self, params, x, *, training, rng):
+        return x + params["bias"].astype(x.dtype)
+
+
+class Mul(SimpleModule):
+    """Single learned scalar gain (reference nn/Mul.scala)."""
+
+    def init(self, rng):
+        return {"weight": jax.random.uniform(rng, (), jnp.float32, -1.0, 1.0)}
+
+    def _forward(self, params, x, *, training, rng):
+        return x * params["weight"].astype(x.dtype)
+
+
+class Add(SimpleModule):
+    """Learned bias vector over the feature dim (reference nn/Add.scala)."""
+
+    def __init__(self, input_size: int, name: Optional[str] = None):
+        super().__init__(name)
+        self.input_size = input_size
+
+    def init(self, rng):
+        return {"bias": uniform_fan_in(rng, (self.input_size,), self.input_size)}
+
+    def _forward(self, params, x, *, training, rng):
+        return x + params["bias"].astype(x.dtype)
+
+
+class MulConstant(SimpleModule):
+    """x * c (reference nn/MulConstant.scala)."""
+
+    def __init__(self, constant: float, name: Optional[str] = None):
+        super().__init__(name)
+        self.constant = constant
+
+    def _forward(self, params, x, *, training, rng):
+        return x * jnp.asarray(self.constant, x.dtype)
+
+
+class AddConstant(SimpleModule):
+    """x + c (reference nn/AddConstant.scala)."""
+
+    def __init__(self, constant: float, name: Optional[str] = None):
+        super().__init__(name)
+        self.constant = constant
+
+    def _forward(self, params, x, *, training, rng):
+        return x + jnp.asarray(self.constant, x.dtype)
+
+
+class MM(SimpleModule):
+    """Batched matrix-matrix product of a table (A, B)
+    (reference nn/MM.scala) — lowers to one MXU dot_general."""
+
+    def __init__(self, trans_a: bool = False, trans_b: bool = False,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.trans_a, self.trans_b = trans_a, trans_b
+
+    def _forward(self, params, x, *, training, rng):
+        a, b = x
+        if self.trans_a:
+            a = jnp.swapaxes(a, -1, -2)
+        if self.trans_b:
+            b = jnp.swapaxes(b, -1, -2)
+        return a @ b
+
+
+class MV(SimpleModule):
+    """Batched matrix-vector product of a table (M, v) (reference nn/MV.scala)."""
+
+    def __init__(self, trans: bool = False, name: Optional[str] = None):
+        super().__init__(name)
+        self.trans = trans
+
+    def _forward(self, params, x, *, training, rng):
+        m, v = x
+        if self.trans:
+            m = jnp.swapaxes(m, -1, -2)
+        return jnp.einsum("...ij,...j->...i", m, v)
+
+
+class Cosine(SimpleModule):
+    """Cosine similarity against a learned weight bank: output_j =
+    cos(x, w_j) (reference nn/Cosine.scala, 212 LoC)."""
+
+    def __init__(self, input_size: int, output_size: int, eps: float = 1e-12,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.input_size, self.output_size, self.eps = input_size, output_size, eps
+
+    def init(self, rng):
+        return {"weight": uniform_fan_in(
+            rng, (self.output_size, self.input_size), self.input_size)}
+
+    def _forward(self, params, x, *, training, rng):
+        w = params["weight"].astype(x.dtype)  # (O, I)
+        xn = x / jnp.maximum(jnp.linalg.norm(x, axis=-1, keepdims=True), self.eps)
+        wn = w / jnp.maximum(jnp.linalg.norm(w, axis=-1, keepdims=True), self.eps)
+        return xn @ wn.T
+
+
+class Euclidean(SimpleModule):
+    """Distances to a learned set of centers: y_j = ||x - w_j||
+    (reference nn/Euclidean.scala)."""
+
+    def __init__(self, input_size: int, output_size: int,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.input_size, self.output_size = input_size, output_size
+
+    def init(self, rng):
+        return {"weight": uniform_fan_in(
+            rng, (self.output_size, self.input_size), self.input_size)}
+
+    def _forward(self, params, x, *, training, rng):
+        w = params["weight"].astype(x.dtype)  # (O, I)
+        d = x[..., None, :] - w  # (B, O, I)
+        return jnp.sqrt(jnp.sum(jnp.square(d), axis=-1) + 1e-12)
+
+
+class DotProduct(SimpleModule):
+    """Row-wise dot product of a table (a, b) (reference nn/DotProduct.scala)."""
+
+    def _forward(self, params, x, *, training, rng):
+        a, b = x
+        return jnp.sum(a * b, axis=-1)
+
+
+class CosineDistance(SimpleModule):
+    """Row-wise cosine similarity of a table (a, b)
+    (reference nn/CosineDistance.scala)."""
+
+    def __init__(self, eps: float = 1e-12, name: Optional[str] = None):
+        super().__init__(name)
+        self.eps = eps
+
+    def _forward(self, params, x, *, training, rng):
+        a, b = x
+        na = jnp.maximum(jnp.linalg.norm(a, axis=-1), self.eps)
+        nb = jnp.maximum(jnp.linalg.norm(b, axis=-1), self.eps)
+        return jnp.sum(a * b, axis=-1) / (na * nb)
+
+
+class PairwiseDistance(SimpleModule):
+    """Row-wise Lp distance of a table (a, b) (reference nn/PairwiseDistance.scala)."""
+
+    def __init__(self, norm: int = 2, name: Optional[str] = None):
+        super().__init__(name)
+        self.norm = norm
+
+    def _forward(self, params, x, *, training, rng):
+        a, b = x
+        d = jnp.abs(a - b)
+        if self.norm == 1:
+            return jnp.sum(d, axis=-1)
+        return jnp.power(jnp.sum(jnp.power(d, self.norm), axis=-1), 1.0 / self.norm)
+
+
+class LookupTable(SimpleModule):
+    """Embedding lookup (reference nn/LookupTable.scala, 267 LoC).
+
+    Indices are 0-based here (the reference is 1-based Lua convention).
+    ``max_norm`` renormalizes *the gathered rows* at lookup time like the
+    reference does; gather lowers to an efficient XLA dynamic-gather.
+    """
+
+    def __init__(self, n_index: int, n_output: int,
+                 max_norm: Optional[float] = None, norm_type: float = 2.0,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.n_index, self.n_output = n_index, n_output
+        self.max_norm, self.norm_type = max_norm, norm_type
+
+    def init(self, rng):
+        return {"weight": jax.random.normal(
+            rng, (self.n_index, self.n_output), jnp.float32)}
+
+    def _forward(self, params, x, *, training, rng):
+        w = params["weight"]
+        rows = jnp.take(w, x.astype(jnp.int32), axis=0)
+        if self.max_norm is not None:
+            n = jnp.linalg.norm(rows, ord=self.norm_type, axis=-1, keepdims=True)
+            rows = rows * jnp.minimum(1.0, self.max_norm / jnp.maximum(n, 1e-7))
+        return rows

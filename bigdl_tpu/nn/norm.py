@@ -1,0 +1,225 @@
+"""Normalization layers (reference nn/{BatchNormalization,SpatialCrossMapLRN,...}.scala).
+
+BatchNormalization carries running statistics in the module *state* pytree —
+the functional replacement for the reference's mutable runningMean/runningVar
+buffers (nn/BatchNormalization.scala, 625 LoC). Its per-channel Engine
+threading (:151,220,435,523) is XLA's fusion problem now.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from bigdl_tpu.core.module import Module, SimpleModule
+
+__all__ = [
+    "BatchNormalization",
+    "SpatialBatchNormalization",
+    "SpatialCrossMapLRN",
+    "SpatialSubtractiveNormalization",
+    "SpatialDivisiveNormalization",
+    "SpatialContrastiveNormalization",
+    "Normalize",
+]
+
+
+class BatchNormalization(Module):
+    """Batch normalization over the feature (last) axis
+    (reference nn/BatchNormalization.scala; defaults eps=1e-5, momentum=0.1,
+    affine=true match the reference's constructor).
+
+    State = {running_mean, running_var}; training mode updates them with the
+    reference's EMA rule ``r = (1-m)*r + m*batch_stat`` and normalizes by the
+    *batch* statistics; eval mode normalizes by the running statistics.
+
+    Distributed note: under the jit-SPMD :class:`~bigdl_tpu.parallel
+    .DataParallel` strategy, leave ``axis_name=None`` — the batch mean/var
+    reductions there are *global* ops over the sharded batch, so XLA already
+    computes exact global-batch statistics (sync-BN for free; the reference's
+    per-executor clones used local stats). ``axis_name`` exists for
+    shard_map/pmap execution, where reductions are per-shard and must be
+    pmean'd across the named axis.
+    """
+
+    reduce_axes: tuple = (0,)
+
+    def __init__(self, n_output: int, eps: float = 1e-5, momentum: float = 0.1,
+                 affine: bool = True, axis_name: Optional[str] = None,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.n_output = n_output
+        self.eps, self.momentum, self.affine = eps, momentum, affine
+        self.axis_name = axis_name
+
+    def init(self, rng):
+        if not self.affine:
+            return {}
+        del rng
+        # reference init: weight=1, bias=0 (BatchNormalization.reset)
+        return {"weight": jnp.ones((self.n_output,), jnp.float32),
+                "bias": jnp.zeros((self.n_output,), jnp.float32)}
+
+    def init_state(self):
+        return {"running_mean": jnp.zeros((self.n_output,), jnp.float32),
+                "running_var": jnp.ones((self.n_output,), jnp.float32)}
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        axes = tuple(range(x.ndim - 1))  # all but features
+        xf = x.astype(jnp.float32)
+        if training:
+            mean = jnp.mean(xf, axis=axes)
+            mean_sq = jnp.mean(jnp.square(xf), axis=axes)
+            if self.axis_name is not None:
+                # cross-replica moments (not per-shard variances!) — sync-BN
+                mean = lax.pmean(mean, self.axis_name)
+                mean_sq = lax.pmean(mean_sq, self.axis_name)
+            var = mean_sq - jnp.square(mean)
+            m = self.momentum
+            n = xf.size // xf.shape[-1]
+            if self.axis_name is not None:
+                n = n * lax.psum(1, self.axis_name)  # global sample count
+            unbiased = var * n / jnp.maximum(1, n - 1)
+            new_state = {
+                "running_mean": (1 - m) * state["running_mean"] + m * mean,
+                "running_var": (1 - m) * state["running_var"] + m * unbiased,
+            }
+        else:
+            mean, var = state["running_mean"], state["running_var"]
+            new_state = state
+        inv = lax.rsqrt(var + self.eps)
+        if self.affine:
+            scale = inv * params["weight"]
+            shift = params["bias"] - mean * scale
+        else:
+            scale = inv
+            shift = -mean * scale
+        y = xf * scale + shift
+        return y.astype(x.dtype), new_state
+
+
+class SpatialBatchNormalization(BatchNormalization):
+    """BN over NHWC with per-channel stats (reference
+    nn/SpatialBatchNormalization.scala) — identical reduction (all axes but
+    channels), kept as a distinct class for model-zoo parity."""
+
+
+class SpatialCrossMapLRN(SimpleModule):
+    """Local response normalization across channels
+    (reference nn/SpatialCrossMapLRN.scala, 221 LoC):
+    ``y = x / (k + alpha/size * sum_{local window} x^2)^beta``.
+
+    Implemented as a channel-axis reduce_window — one fused XLA op chain; the
+    Pallas variant lives in bigdl_tpu.ops.lrn for the hot path."""
+
+    def __init__(self, size: int = 5, alpha: float = 1.0, beta: float = 0.75,
+                 k: float = 1.0, name: Optional[str] = None):
+        super().__init__(name)
+        self.size, self.alpha, self.beta, self.k = size, alpha, beta, k
+
+    def _forward(self, params, x, *, training, rng):
+        sq = jnp.square(x)
+        half = (self.size - 1) // 2
+        sums = lax.reduce_window(
+            sq, 0.0, lax.add,
+            (1, 1, 1, self.size), (1, 1, 1, 1),
+            ((0, 0), (0, 0), (0, 0), (half, self.size - 1 - half)))
+        denom = jnp.power(self.k + (self.alpha / self.size) * sums, self.beta)
+        return x / denom
+
+
+def _gaussian_kernel2d(size: int, dtype=jnp.float32):
+    """Normalized 2-D gaussian window, sigma = 0.25*size, matching Torch's
+    image.gaussian default the reference layers use."""
+    sigma = 0.25 * size
+    r = jnp.arange(size, dtype=dtype) - (size - 1) / 2.0
+    g = jnp.exp(-0.5 * jnp.square(r / sigma))
+    k = jnp.outer(g, g)
+    return k / jnp.sum(k)
+
+
+class SpatialSubtractiveNormalization(SimpleModule):
+    """Subtract a weighted local mean per channel
+    (reference nn/SpatialSubtractiveNormalization.scala, 196 LoC)."""
+
+    def __init__(self, n_input_plane: int = 1, kernel=None,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.n_input_plane = n_input_plane
+        self.kernel = kernel if kernel is not None else _gaussian_kernel2d(9)
+
+    def _local_mean(self, x):
+        k = jnp.asarray(self.kernel, x.dtype)
+        k = k / jnp.sum(k)
+        kh, kw = k.shape
+        # depthwise conv: same kernel per channel
+        w = jnp.tile(k[:, :, None, None], (1, 1, 1, self.n_input_plane))
+        mean = lax.conv_general_dilated(
+            x, w, (1, 1),
+            padding=((kh // 2, (kh - 1) // 2), (kw // 2, (kw - 1) // 2)),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            feature_group_count=self.n_input_plane)
+        # edge correction: divide by the actual kernel mass inside the image
+        ones = jnp.ones_like(x[:1, :, :, :1])
+        mass = lax.conv_general_dilated(
+            ones, k[:, :, None, None], (1, 1),
+            padding=((kh // 2, (kh - 1) // 2), (kw // 2, (kw - 1) // 2)),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        return mean / jnp.maximum(mass, 1e-8)
+
+    def _forward(self, params, x, *, training, rng):
+        return x - self._local_mean(x)
+
+
+class SpatialDivisiveNormalization(SimpleModule):
+    """Divide by local standard deviation
+    (reference nn/SpatialDivisiveNormalization.scala, 211 LoC)."""
+
+    def __init__(self, n_input_plane: int = 1, kernel=None,
+                 threshold: float = 1e-4, name: Optional[str] = None):
+        super().__init__(name)
+        self.sub = SpatialSubtractiveNormalization(n_input_plane, kernel)
+        self.threshold = threshold
+
+    def _forward(self, params, x, *, training, rng):
+        local_var = self.sub._local_mean(jnp.square(x))
+        local_std = jnp.sqrt(jnp.maximum(local_var, 0.0))
+        # reference thresholds by max(mean(std), threshold) per sample
+        mean_std = jnp.mean(local_std, axis=(1, 2, 3), keepdims=True)
+        denom = jnp.maximum(local_std, jnp.maximum(mean_std, self.threshold))
+        return x / denom
+
+
+class SpatialContrastiveNormalization(SimpleModule):
+    """Subtractive then divisive normalization
+    (reference nn/SpatialContrastiveNormalization.scala)."""
+
+    def __init__(self, n_input_plane: int = 1, kernel=None,
+                 threshold: float = 1e-4, name: Optional[str] = None):
+        super().__init__(name)
+        self.sub = SpatialSubtractiveNormalization(n_input_plane, kernel)
+        self.div = SpatialDivisiveNormalization(n_input_plane, kernel, threshold)
+
+    def _forward(self, params, x, *, training, rng):
+        y = self.sub._forward({}, x, training=training, rng=rng)
+        return self.div._forward({}, y, training=training, rng=rng)
+
+
+class Normalize(SimpleModule):
+    """Lp-normalize rows to unit norm (reference nn/Normalize.scala)."""
+
+    def __init__(self, p: float = 2.0, eps: float = 1e-10,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.p, self.eps = p, eps
+
+    def _forward(self, params, x, *, training, rng):
+        if self.p == float("inf"):
+            n = jnp.max(jnp.abs(x), axis=-1, keepdims=True)
+        else:
+            n = jnp.power(jnp.sum(jnp.power(jnp.abs(x), self.p), axis=-1,
+                                  keepdims=True), 1.0 / self.p)
+        return x / jnp.maximum(n, self.eps)

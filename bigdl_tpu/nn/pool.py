@@ -1,0 +1,99 @@
+"""Pooling layers (reference nn/Spatial{Max,Average}Pooling.scala).
+
+The reference threads each sample across the Engine pool and runs scalar
+loops (SpatialMaxPooling.scala:104-196, NNPrimitive.maxPoolingForward*);
+here each pooling op is one ``lax.reduce_window``, which XLA lowers to a
+vectorized VPU loop with a fused backward.
+
+NHWC layout; ``ceil_mode`` reproduces Torch's ceil output-size convention.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax.numpy as jnp
+from jax import lax
+
+from bigdl_tpu.core.module import SimpleModule
+
+__all__ = ["SpatialMaxPooling", "SpatialAveragePooling"]
+
+
+def _pool_pads(size, k, s, pad, ceil_mode):
+    """Torch pooling geometry: output extent and (lo, hi) padding so that
+    reduce_window reproduces floor/ceil mode exactly."""
+    if ceil_mode:
+        out = int(math.ceil((size + 2 * pad - k) / s)) + 1
+        # Torch: last window must start inside the (padded) input
+        if (out - 1) * s >= size + pad:
+            out -= 1
+    else:
+        out = int(math.floor((size + 2 * pad - k) / s)) + 1
+    needed = (out - 1) * s + k
+    hi = max(0, needed - size - pad)
+    return out, (pad, hi)
+
+
+class _SpatialPool(SimpleModule):
+    def __init__(self, kernel_w: int, kernel_h: int,
+                 stride_w: Optional[int] = None, stride_h: Optional[int] = None,
+                 pad_w: int = 0, pad_h: int = 0, ceil_mode: bool = False,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.kernel_w, self.kernel_h = kernel_w, kernel_h
+        self.stride_w = stride_w if stride_w is not None else kernel_w
+        self.stride_h = stride_h if stride_h is not None else kernel_h
+        self.pad_w, self.pad_h = pad_w, pad_h
+        self.ceil_mode = ceil_mode
+        assert self.pad_w <= self.kernel_w // 2 and self.pad_h <= self.kernel_h // 2
+
+    def ceil(self):
+        """Builder-style toggle mirroring the reference's .ceil()."""
+        self.ceil_mode = True
+        return self
+
+    def _window(self, x):
+        _, h, w, _ = x.shape
+        _, pad_h = _pool_pads(h, self.kernel_h, self.stride_h, self.pad_h,
+                              self.ceil_mode)
+        _, pad_w = _pool_pads(w, self.kernel_w, self.stride_w, self.pad_w,
+                              self.ceil_mode)
+        dims = (1, self.kernel_h, self.kernel_w, 1)
+        strides = (1, self.stride_h, self.stride_w, 1)
+        pads = ((0, 0), pad_h, pad_w, (0, 0))
+        return dims, strides, pads
+
+
+class SpatialMaxPooling(_SpatialPool):
+    """(reference nn/SpatialMaxPooling.scala, 279 LoC)"""
+
+    def _forward(self, params, x, *, training, rng):
+        dims, strides, pads = self._window(x)
+        # init must be a python scalar so XLA recognizes the max-pool special
+        # case (differentiable reduce_window_max primitive)
+        return lax.reduce_window(x, -jnp.inf, lax.max, dims, strides, pads)
+
+
+class SpatialAveragePooling(_SpatialPool):
+    """(reference nn/SpatialAveragePooling.scala, 458 LoC).
+
+    ``count_include_pad`` matches the reference default (padded zeros count
+    in the divisor)."""
+
+    def __init__(self, kernel_w, kernel_h, stride_w=None, stride_h=None,
+                 pad_w=0, pad_h=0, ceil_mode=False, count_include_pad=True,
+                 name=None):
+        super().__init__(kernel_w, kernel_h, stride_w, stride_h, pad_w, pad_h,
+                         ceil_mode, name)
+        self.count_include_pad = count_include_pad
+
+    def _forward(self, params, x, *, training, rng):
+        dims, strides, pads = self._window(x)
+        summed = lax.reduce_window(x, 0.0, lax.add, dims, strides, pads)
+        if self.count_include_pad:
+            return summed / (self.kernel_h * self.kernel_w)
+        ones = jnp.ones(x.shape[1:3], x.dtype)[None, :, :, None]
+        counts = lax.reduce_window(ones, 0.0, lax.add, dims, strides, pads)
+        return summed / counts

@@ -1,0 +1,242 @@
+"""Recurrent layers (reference nn/Recurrent.scala:27-113, nn/RNN.scala:27-90).
+
+The reference runs an explicit Scala time-step loop with cloned cells and
+truncated BPTT (Recurrent.scala:54-62, 66-107). TPU-native form: one
+``lax.scan`` over the time axis — a single compiled loop whose backward is
+derived by XLA, with optional gradient truncation via stop_gradient every
+``bptt_truncate`` steps (the functional equivalent of bpttTruncate).
+
+The reference snapshot has no LSTM/GRU (SURVEY.md §2.4); BASELINE.json's
+"LSTM / BiRNN text classification" config makes them required, so they are
+first-class cells here. Cells are fused-gate formulations: one (x,h) @ W
+matmul computing all gates — the MXU-friendly layout.
+
+Sequence layout: (B, T, F), time axis 1.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from bigdl_tpu.core.module import Module, SimpleModule, uniform_fan_in
+
+__all__ = ["RnnCell", "LSTMCell", "GRUCell", "Recurrent", "BiRecurrent"]
+
+
+class Cell(Module):
+    """A recurrent cell: ``apply(params, state, (x_t, hidden))`` returns
+    ``((y_t, new_hidden), state)``. ``hidden`` is a pytree."""
+
+    hidden_size: int
+
+    def initial_hidden(self, batch: int, dtype=jnp.float32):
+        raise NotImplementedError
+
+
+class RnnCell(Cell, SimpleModule):
+    """Vanilla RNN cell: act(x@Wi + h@Wh + b)
+    (reference nn/RNN.scala:27-90 = ParallelTable(i2h, h2h) + CAddTable +
+    activation, fused into one matmul here)."""
+
+    def __init__(self, input_size: int, hidden_size: int, activation=jnp.tanh,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.input_size, self.hidden_size = input_size, hidden_size
+        self.activation = activation
+
+    def initial_hidden(self, batch: int, dtype=jnp.float32):
+        return jnp.zeros((batch, self.hidden_size), dtype)
+
+    def init(self, rng):
+        k_i, k_h, k_b = jax.random.split(rng, 3)
+        return {
+            "w_ih": uniform_fan_in(k_i, (self.input_size, self.hidden_size),
+                                   self.input_size),
+            "w_hh": uniform_fan_in(k_h, (self.hidden_size, self.hidden_size),
+                                   self.hidden_size),
+            "bias": uniform_fan_in(k_b, (self.hidden_size,), self.hidden_size),
+        }
+
+    def _forward(self, params, x, *, training, rng):
+        x_t, h = x
+        h_new = self.activation(
+            x_t @ params["w_ih"].astype(x_t.dtype)
+            + h @ params["w_hh"].astype(x_t.dtype)
+            + params["bias"].astype(x_t.dtype))
+        return h_new, h_new
+
+
+class LSTMCell(Cell, SimpleModule):
+    """LSTM with fused 4-gate matmul and forget-gate bias 1.0.
+
+    Natural extension of the reference's recurrent path (SURVEY.md §2.4:
+    "LSTM as the natural extension"); gate order [i, f, g, o].
+    """
+
+    def __init__(self, input_size: int, hidden_size: int,
+                 forget_bias: float = 1.0, name: Optional[str] = None):
+        super().__init__(name)
+        self.input_size, self.hidden_size = input_size, hidden_size
+        self.forget_bias = forget_bias
+
+    def initial_hidden(self, batch: int, dtype=jnp.float32):
+        z = jnp.zeros((batch, self.hidden_size), dtype)
+        return (z, z)  # (h, c)
+
+    def init(self, rng):
+        k_i, k_h, k_b = jax.random.split(rng, 3)
+        h4 = 4 * self.hidden_size
+        return {
+            "w_ih": uniform_fan_in(k_i, (self.input_size, h4), self.input_size),
+            "w_hh": uniform_fan_in(k_h, (self.hidden_size, h4), self.hidden_size),
+            "bias": jnp.zeros((h4,), jnp.float32),
+        }
+
+    def _forward(self, params, x, *, training, rng):
+        x_t, (h, c) = x
+        gates = (x_t @ params["w_ih"].astype(x_t.dtype)
+                 + h @ params["w_hh"].astype(x_t.dtype)
+                 + params["bias"].astype(x_t.dtype))
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        i = jax.nn.sigmoid(i)
+        f = jax.nn.sigmoid(f + self.forget_bias)
+        g = jnp.tanh(g)
+        o = jax.nn.sigmoid(o)
+        c_new = f * c + i * g
+        h_new = o * jnp.tanh(c_new)
+        return h_new, (h_new, c_new)
+
+
+class GRUCell(Cell, SimpleModule):
+    """GRU with fused 3-gate matmul, gate order [r, z, n]."""
+
+    def __init__(self, input_size: int, hidden_size: int,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.input_size, self.hidden_size = input_size, hidden_size
+
+    def initial_hidden(self, batch: int, dtype=jnp.float32):
+        return jnp.zeros((batch, self.hidden_size), dtype)
+
+    def init(self, rng):
+        k_i, k_h, k_b = jax.random.split(rng, 3)
+        h3 = 3 * self.hidden_size
+        return {
+            "w_ih": uniform_fan_in(k_i, (self.input_size, h3), self.input_size),
+            "w_hh": uniform_fan_in(k_h, (self.hidden_size, h3), self.hidden_size),
+            "bias": jnp.zeros((h3,), jnp.float32),
+        }
+
+    def _forward(self, params, x, *, training, rng):
+        x_t, h = x
+        xi = x_t @ params["w_ih"].astype(x_t.dtype) + params["bias"].astype(x_t.dtype)
+        hh = h @ params["w_hh"].astype(x_t.dtype)
+        xr, xz, xn = jnp.split(xi, 3, axis=-1)
+        hr, hz, hn = jnp.split(hh, 3, axis=-1)
+        r = jax.nn.sigmoid(xr + hr)
+        z = jax.nn.sigmoid(xz + hz)
+        n = jnp.tanh(xn + r * hn)
+        h_new = (1 - z) * n + z * h
+        return h_new, h_new
+
+
+class Recurrent(Module):
+    """Unroll a cell over the time axis via lax.scan
+    (reference nn/Recurrent.scala container).
+
+    * ``bptt_truncate``: detach the hidden-state gradient every k steps —
+      functional twin of the reference's bpttTruncate (Recurrent.scala:66-107).
+      0 disables truncation (full BPTT).
+    * ``return_sequences``: True -> (B, T, H) outputs (reference behavior —
+      models then Select the last step); False -> last output only.
+    """
+
+    def __init__(self, cell: Cell, bptt_truncate: int = 0,
+                 return_sequences: bool = True, reverse: bool = False,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.cell = cell
+        self.bptt_truncate = bptt_truncate
+        self.return_sequences = return_sequences
+        self.reverse = reverse
+
+    def children(self):
+        return (self.cell,)
+
+    def init(self, rng):
+        return {"cell": self.cell.init(rng)}
+
+    def init_state(self):
+        return {"cell": self.cell.init_state()}
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        batch = x.shape[0]
+        h0 = self.cell.initial_hidden(batch, x.dtype)
+        xs = jnp.swapaxes(x, 0, 1)  # (T, B, F) scan-major
+        if self.reverse:
+            xs = jnp.flip(xs, 0)
+        cell_params = params["cell"]
+        trunc = self.bptt_truncate
+
+        def step(carry, inp):
+            h, t, cell_state = carry
+            x_t = inp
+            if trunc > 0:
+                cut = (t % trunc) == 0
+                h = jax.tree_util.tree_map(
+                    lambda a: lax.select(
+                        jnp.broadcast_to(cut, a.shape),
+                        lax.stop_gradient(a), a), h)
+            step_rng = None if rng is None else jax.random.fold_in(rng, t)
+            (y, h_new), cell_state = self.cell.apply(
+                cell_params, cell_state, (x_t, h),
+                training=training, rng=step_rng)
+            return (h_new, t + 1, cell_state), y
+
+        (_, _, final_cell_state), ys = lax.scan(
+            step, (h0, jnp.asarray(0, jnp.int32), state["cell"]), xs)
+        state = {"cell": final_cell_state}
+        if self.reverse:
+            ys = jnp.flip(ys, 0)
+        if self.return_sequences:
+            return jnp.swapaxes(ys, 0, 1), state  # (B, T, H)
+        idx = 0 if self.reverse else -1
+        return ys[idx], state
+
+
+class BiRecurrent(Module):
+    """Bidirectional wrapper: run two cells over opposite time directions and
+    merge (concat by default, sum optional) — the BiRNN of BASELINE.json's
+    text-classification config."""
+
+    def __init__(self, fwd_cell: Cell, bwd_cell: Cell, merge: str = "concat",
+                 name: Optional[str] = None):
+        super().__init__(name)
+        assert merge in ("concat", "sum")
+        self.fwd = Recurrent(fwd_cell)
+        self.bwd = Recurrent(bwd_cell, reverse=True)
+        self.merge = merge
+
+    def children(self):
+        return (self.fwd, self.bwd)
+
+    def init(self, rng):
+        k_f, k_b = jax.random.split(rng)
+        return {"fwd": self.fwd.init(k_f), "bwd": self.bwd.init(k_b)}
+
+    def init_state(self):
+        return {"fwd": self.fwd.init_state(), "bwd": self.bwd.init_state()}
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        rf = None if rng is None else jax.random.fold_in(rng, 0)
+        rb = None if rng is None else jax.random.fold_in(rng, 1)
+        yf, sf = self.fwd.apply(params["fwd"], state["fwd"], x,
+                                training=training, rng=rf)
+        yb, sb = self.bwd.apply(params["bwd"], state["bwd"], x,
+                                training=training, rng=rb)
+        y = jnp.concatenate([yf, yb], -1) if self.merge == "concat" else yf + yb
+        return y, {"fwd": sf, "bwd": sb}

@@ -1,0 +1,475 @@
+"""Structural, shape, and table layers (reference nn/{Concat,Reshape,...}.scala).
+
+"Tables" (the reference's nested Activity, nn/abstractnn/Activity.scala) are
+plain Python tuples/lists here — JAX pytrees, so they nest through jit/grad
+for free.
+
+Dimension arguments are 0-based (the reference is 1-based Lua convention);
+negative axes follow numpy rules. Batch is axis 0.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.core.module import (
+    Container,
+    Module,
+    SimpleModule,
+    ElementwiseModule,
+    Sequential,
+    Identity,
+    EMPTY_STATE,
+    _child_rng,
+)
+
+__all__ = [
+    "Concat", "ConcatTable", "ParallelTable", "MapTable", "NarrowTable",
+    "FlattenTable", "JoinTable", "MixtureTable", "CriterionTable", "Bottle",
+    "Reshape", "View", "Transpose", "Squeeze", "Unsqueeze", "Select",
+    "SelectTable", "Narrow", "Index", "MaskedSelect", "MaskedFill",
+    "Replicate", "Padding", "SpatialZeroPadding", "Copy", "Contiguous",
+    "Echo", "Max", "Min", "Mean", "Sum", "Dropout",
+    "CAddTable", "CSubTable", "CMulTable", "CDivTable", "CMaxTable",
+    "CMinTable",
+]
+
+
+# --------------------------------------------------------------------------
+# Containers beyond Sequential
+# --------------------------------------------------------------------------
+
+class Concat(Container):
+    """Run children on the same input, concatenate outputs along ``axis``
+    (reference nn/Concat.scala, 297 LoC — its Engine.model.invoke branch
+    threading is XLA's problem now). Default axis: features (last), the NHWC
+    analog of the reference's channel dim."""
+
+    def __init__(self, *modules: Module, axis: int = -1, name=None):
+        super().__init__(*modules, name=name)
+        self.axis = axis
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        outs, new_state = [], {}
+        for i, m in enumerate(self._modules):
+            k = str(i)
+            y, s = m.apply(params[k], state[k], x,
+                           training=training, rng=_child_rng(rng, i))
+            outs.append(y)
+            new_state[k] = s
+        return jnp.concatenate(outs, axis=self.axis), new_state
+
+
+class ConcatTable(Container):
+    """Run children on the same input, output the table of results
+    (reference nn/ConcatTable.scala)."""
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        outs, new_state = [], {}
+        for i, m in enumerate(self._modules):
+            k = str(i)
+            y, s = m.apply(params[k], state[k], x,
+                           training=training, rng=_child_rng(rng, i))
+            outs.append(y)
+            new_state[k] = s
+        return tuple(outs), new_state
+
+
+class ParallelTable(Container):
+    """i-th child consumes i-th table element (reference nn/ParallelTable.scala)."""
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        outs, new_state = [], {}
+        for i, m in enumerate(self._modules):
+            k = str(i)
+            y, s = m.apply(params[k], state[k], x[i],
+                           training=training, rng=_child_rng(rng, i))
+            outs.append(y)
+            new_state[k] = s
+        return tuple(outs), new_state
+
+
+class MapTable(Container):
+    """One shared child applied to every table element (reference
+    nn/MapTable.scala — there the child is *cloned with shared weights*;
+    functionally that is exactly "same params, many inputs")."""
+
+    def __init__(self, module: Module, name=None):
+        super().__init__(module, name=name)
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        m = self._modules[0]
+        outs = []
+        s = state["0"]
+        for i, xi in enumerate(x):
+            y, s = m.apply(params["0"], s, xi,
+                           training=training, rng=_child_rng(rng, i))
+            outs.append(y)
+        return tuple(outs), {"0": s}
+
+
+class NarrowTable(SimpleModule):
+    """Select a length-``length`` slice of the input table starting at
+    ``offset`` (reference nn/NarrowTable.scala). 0-based."""
+
+    def __init__(self, offset: int, length: int = 1, name=None):
+        super().__init__(name)
+        self.offset, self.length = offset, length
+
+    def _forward(self, params, x, *, training, rng):
+        return tuple(x[self.offset:self.offset + self.length])
+
+
+class FlattenTable(SimpleModule):
+    """Flatten nested tables into one flat table (reference nn/FlattenTable.scala)."""
+
+    def _forward(self, params, x, *, training, rng):
+        out = []
+
+        def rec(t):
+            if isinstance(t, (tuple, list)):
+                for e in t:
+                    rec(e)
+            else:
+                out.append(t)
+
+        rec(x)
+        return tuple(out)
+
+
+class JoinTable(SimpleModule):
+    """Concatenate table elements along ``axis`` (reference nn/JoinTable.scala)."""
+
+    def __init__(self, axis: int = -1, name=None):
+        super().__init__(name)
+        self.axis = axis
+
+    def _forward(self, params, x, *, training, rng):
+        return jnp.concatenate(list(x), axis=self.axis)
+
+
+class MixtureTable(SimpleModule):
+    """Mixture-of-experts gate (reference nn/MixtureTable.scala, 220 LoC):
+    input = (gates (B,E), experts) where experts is a table of E tensors
+    (B, ...) or one stacked tensor (B, E, ...); output = sum_e g_e * x_e."""
+
+    def _forward(self, params, x, *, training, rng):
+        gates, experts = x
+        if isinstance(experts, (tuple, list)):
+            experts = jnp.stack(list(experts), axis=1)  # (B, E, ...)
+        g = gates.reshape(gates.shape + (1,) * (experts.ndim - gates.ndim))
+        return jnp.sum(g * experts, axis=1)
+
+
+class CriterionTable(SimpleModule):
+    """Wrap a criterion as a module over a table (input, target)
+    (reference nn/CriterionTable.scala)."""
+
+    def __init__(self, criterion, name=None):
+        super().__init__(name)
+        self.criterion = criterion
+
+    def _forward(self, params, x, *, training, rng):
+        inp, tgt = x
+        return self.criterion.forward(inp, tgt)
+
+
+class Bottle(Container):
+    """Collapse leading dims, apply child, restore (reference nn/Bottle.scala).
+    ``n_input_dims`` counts non-batch dims the child expects."""
+
+    def __init__(self, module: Module, n_input_dims: int = 2, name=None):
+        super().__init__(module, name=name)
+        self.n_input_dims = n_input_dims
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        lead = x.shape[: x.ndim - self.n_input_dims + 1]
+        flat = x.reshape((-1,) + x.shape[x.ndim - self.n_input_dims + 1:])
+        y, s = self._modules[0].apply(params["0"], state["0"], flat,
+                                      training=training, rng=rng)
+        y = y.reshape(lead + y.shape[1:])
+        return y, {"0": s}
+
+
+# --------------------------------------------------------------------------
+# Shape ops
+# --------------------------------------------------------------------------
+
+class Reshape(SimpleModule):
+    """Reshape non-batch dims to ``size`` (reference nn/Reshape.scala;
+    batch_mode=None auto behavior simplified to: axis 0 is always batch)."""
+
+    def __init__(self, size: Sequence[int], name=None):
+        super().__init__(name)
+        self.size = tuple(size)
+
+    def _forward(self, params, x, *, training, rng):
+        return x.reshape((x.shape[0],) + self.size)
+
+
+class View(Reshape):
+    """Alias of Reshape (reference nn/View.scala; no storage aliasing to
+    preserve — XLA decides layout)."""
+
+
+class Transpose(SimpleModule):
+    """Swap listed axis pairs in order (reference nn/Transpose.scala)."""
+
+    def __init__(self, *pairs: tuple[int, int], name=None):
+        super().__init__(name)
+        self.pairs = pairs
+
+    def _forward(self, params, x, *, training, rng):
+        for a, b in self.pairs:
+            x = jnp.swapaxes(x, a, b)
+        return x
+
+
+class Squeeze(SimpleModule):
+    """(reference nn/Squeeze.scala)"""
+
+    def __init__(self, axis: Optional[int] = None, name=None):
+        super().__init__(name)
+        self.axis = axis
+
+    def _forward(self, params, x, *, training, rng):
+        return jnp.squeeze(x, axis=self.axis)
+
+
+class Unsqueeze(SimpleModule):
+    """(reference nn/Unsqueeze.scala)"""
+
+    def __init__(self, axis: int, name=None):
+        super().__init__(name)
+        self.axis = axis
+
+    def _forward(self, params, x, *, training, rng):
+        return jnp.expand_dims(x, self.axis)
+
+
+class Select(SimpleModule):
+    """Select index along an axis, removing it (reference nn/Select.scala)."""
+
+    def __init__(self, axis: int, index: int, name=None):
+        super().__init__(name)
+        self.axis, self.index = axis, index
+
+    def _forward(self, params, x, *, training, rng):
+        return jnp.take(x, self.index, axis=self.axis)
+
+
+class SelectTable(SimpleModule):
+    """Select one element of a table (reference nn/SelectTable.scala)."""
+
+    def __init__(self, index: int, name=None):
+        super().__init__(name)
+        self.index = index
+
+    def _forward(self, params, x, *, training, rng):
+        return x[self.index]
+
+
+class Narrow(SimpleModule):
+    """Static slice along an axis (reference nn/Narrow.scala / Tensor.narrow,
+    tensor/Tensor.scala:420)."""
+
+    def __init__(self, axis: int, offset: int, length: int, name=None):
+        super().__init__(name)
+        self.axis, self.offset, self.length = axis, offset, length
+
+    def _forward(self, params, x, *, training, rng):
+        return jax.lax.slice_in_dim(x, self.offset, self.offset + self.length,
+                                    axis=self.axis)
+
+
+class Index(SimpleModule):
+    """Gather rows by an index tensor: input table (src, idx)
+    (reference nn/Index.scala)."""
+
+    def __init__(self, axis: int = 0, name=None):
+        super().__init__(name)
+        self.axis = axis
+
+    def _forward(self, params, x, *, training, rng):
+        src, idx = x
+        return jnp.take(src, idx.astype(jnp.int32), axis=self.axis)
+
+
+class MaskedSelect(SimpleModule):
+    """Select elements where mask is true, input table (src, mask)
+    (reference nn/MaskedSelect.scala).
+
+    Dynamic output shape is incompatible with XLA tracing; outside jit this
+    returns the 1-D masked values (reference semantics). Inside jit, prefer
+    :class:`MaskedFill` or a fixed-size gather."""
+
+    def _forward(self, params, x, *, training, rng):
+        src, mask = x
+        return src[mask.astype(bool)]
+
+
+class MaskedFill(SimpleModule):
+    """Jit-friendly companion of MaskedSelect: fill masked-out entries with a
+    constant (the pattern the reference implements as maskedFill,
+    tensor/TensorMath.scala:618-636)."""
+
+    def __init__(self, value: float = 0.0, name=None):
+        super().__init__(name)
+        self.value = value
+
+    def _forward(self, params, x, *, training, rng):
+        src, mask = x
+        return jnp.where(mask.astype(bool), src,
+                         jnp.asarray(self.value, src.dtype))
+
+
+class Replicate(SimpleModule):
+    """Insert a new broadcast axis of size n (reference nn/Replicate.scala)."""
+
+    def __init__(self, n_features: int, axis: int = 0, name=None):
+        super().__init__(name)
+        self.n_features, self.axis = n_features, axis
+
+    def _forward(self, params, x, *, training, rng):
+        return jnp.repeat(jnp.expand_dims(x, self.axis), self.n_features,
+                          axis=self.axis)
+
+
+class Padding(SimpleModule):
+    """Pad ``pad`` entries (negative = before, positive = after) along an axis
+    with ``value`` (reference nn/Padding.scala)."""
+
+    def __init__(self, axis: int, pad: int, value: float = 0.0, name=None):
+        super().__init__(name)
+        self.axis, self.pad, self.value = axis, pad, value
+
+    def _forward(self, params, x, *, training, rng):
+        widths = [(0, 0)] * x.ndim
+        ax = self.axis % x.ndim
+        widths[ax] = (-self.pad, 0) if self.pad < 0 else (0, self.pad)
+        return jnp.pad(x, widths, constant_values=self.value)
+
+
+class SpatialZeroPadding(SimpleModule):
+    """Zero-pad H/W of NHWC input (reference nn/SpatialZeroPadding.scala)."""
+
+    def __init__(self, pad_left: int, pad_right: int, pad_top: int,
+                 pad_bottom: int, name=None):
+        super().__init__(name)
+        self.pads = (pad_left, pad_right, pad_top, pad_bottom)
+
+    def _forward(self, params, x, *, training, rng):
+        l, r, t, b = self.pads
+        return jnp.pad(x, [(0, 0), (t, b), (l, r), (0, 0)])
+
+
+class Copy(ElementwiseModule):
+    """Identity-with-copy (reference nn/Copy.scala) — functionally identity;
+    XLA owns buffers, so there is nothing to copy."""
+
+    def _fn(self, x):
+        return x
+
+
+class Contiguous(Copy):
+    """(reference nn/Contiguous.scala) — meaningless under XLA layouts; identity."""
+
+
+class Echo(SimpleModule):
+    """Debug print of shape/dtype during trace (reference nn/Echo.scala)."""
+
+    def _forward(self, params, x, *, training, rng):
+        print(f"[Echo:{self.name}] shape={tuple(x.shape)} dtype={x.dtype}")
+        return x
+
+
+class _Reduce(SimpleModule):
+    _op = None
+
+    def __init__(self, axis: int = 1, keepdims: bool = False, name=None):
+        super().__init__(name)
+        self.axis, self.keepdims = axis, keepdims
+
+    def _forward(self, params, x, *, training, rng):
+        return self._op(x, axis=self.axis, keepdims=self.keepdims)
+
+
+class Max(_Reduce):
+    """(reference nn/Max.scala)"""
+    _op = staticmethod(jnp.max)
+
+
+class Min(_Reduce):
+    """(reference nn/Min.scala)"""
+    _op = staticmethod(jnp.min)
+
+
+class Mean(_Reduce):
+    """(reference nn/Mean.scala)"""
+    _op = staticmethod(jnp.mean)
+
+
+class Sum(_Reduce):
+    """(reference nn/Sum.scala)"""
+    _op = staticmethod(jnp.sum)
+
+
+class Dropout(SimpleModule):
+    """Inverted dropout (reference nn/Dropout.scala — scales by 1/(1-p) at
+    train time, identity at eval; its Engine-threaded noise fill is just one
+    fused random op here)."""
+
+    def __init__(self, p: float = 0.5, name=None):
+        super().__init__(name)
+        assert 0.0 <= p < 1.0
+        self.p = p
+
+    def _forward(self, params, x, *, training, rng):
+        if not training or self.p == 0.0:
+            return x
+        if rng is None:
+            raise ValueError("Dropout needs an rng in training mode")
+        keep = 1.0 - self.p
+        mask = jax.random.bernoulli(rng, keep, x.shape)
+        return jnp.where(mask, x / keep, jnp.zeros_like(x))
+
+
+# --------------------------------------------------------------------------
+# Componentwise table ops (reference nn/C{Add,Sub,Mul,Div,Max,Min}Table.scala)
+# --------------------------------------------------------------------------
+
+class _CTable(SimpleModule):
+    _op = None
+
+    def _forward(self, params, x, *, training, rng):
+        out = x[0]
+        for t in x[1:]:
+            out = self._op(out, t)
+        return out
+
+
+class CAddTable(_CTable):
+    _op = staticmethod(jnp.add)
+
+
+class CSubTable(_CTable):
+    _op = staticmethod(jnp.subtract)
+
+
+class CMulTable(_CTable):
+    _op = staticmethod(jnp.multiply)
+
+
+class CDivTable(_CTable):
+    _op = staticmethod(jnp.divide)
+
+
+class CMaxTable(_CTable):
+    _op = staticmethod(jnp.maximum)
+
+
+class CMinTable(_CTable):
+    _op = staticmethod(jnp.minimum)

@@ -1,0 +1,13 @@
+from bigdl_tpu.optim.method import OptimMethod, SGD, Adagrad, Adam, RMSprop
+from bigdl_tpu.optim.schedules import (
+    LearningRateSchedule, Default, Poly, Step, EpochDecay, EpochStep,
+    Regime, EpochSchedule,
+)
+from bigdl_tpu.optim.triggers import Trigger
+from bigdl_tpu.optim.validation import (
+    ValidationMethod, ValidationResult, AccuracyResult, LossResult,
+    Top1Accuracy, Top5Accuracy, Loss,
+)
+from bigdl_tpu.optim.metrics import Metrics
+from bigdl_tpu.optim.optimizer import Optimizer, TrainedModel
+from bigdl_tpu.optim.validator import Validator

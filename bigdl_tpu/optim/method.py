@@ -1,0 +1,226 @@
+"""Optimization methods (reference optim/{OptimMethod,SGD,Adagrad,LBFGS}.scala).
+
+Functional form: ``opt.init(params) -> opt_state``;
+``opt.update(grads, opt_state, params) -> (new_params, new_opt_state)`` —
+pure, jittable, shardable. The step/epoch counters the reference keeps in its
+``state: Table`` live inside opt_state so schedules evaluate inside jit.
+
+ZeRO-1 note: opt_state has the same pytree structure as params, so sharding
+specs for optimizer-state partitioning (bigdl_tpu.parallel) map 1:1.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.optim.schedules import Default, LearningRateSchedule
+
+__all__ = ["OptimMethod", "SGD", "Adagrad", "Adam", "RMSprop"]
+
+
+class OptimMethod:
+    """Base optimizer (reference optim/OptimMethod.scala:38-47 — its
+    ``optimize(feval, x, config, state)`` contract becomes init/update)."""
+
+    def init(self, params) -> Any:
+        raise NotImplementedError
+
+    def update(self, grads, opt_state, params):
+        """Returns (new_params, new_opt_state)."""
+        raise NotImplementedError
+
+    def set_epoch(self, opt_state, epoch: int):
+        """Record the current epoch into opt_state (driver loop calls this at
+        epoch rollover, mirroring DistriOptimizer's state("epoch"))."""
+        if isinstance(opt_state, dict) and "epoch" in opt_state:
+            return {**opt_state, "epoch": jnp.asarray(epoch, jnp.float32)}
+        return opt_state
+
+    def learning_rate(self, opt_state):
+        """Effective lr at the current step (for logging)."""
+        return None
+
+
+class SGD(OptimMethod):
+    """SGD with weight decay / momentum / dampening / nesterov and pluggable
+    schedules (reference optim/SGD.scala:26-186). Update order matches the
+    reference: grad += wd*w; v = mu*v + (1-damp)*grad;
+    step = grad + mu*v (nesterov) or v; w -= lr*step.
+
+    ``learning_rates``/``weight_decays`` per-parameter tensors
+    (SGD.scala:43) are supported as pytrees matching params.
+    """
+
+    def __init__(self, learning_rate: float = 1e-3, weight_decay: float = 0.0,
+                 momentum: float = 0.0, dampening: Optional[float] = None,
+                 nesterov: bool = False,
+                 schedule: Optional[LearningRateSchedule] = None,
+                 learning_rates=None, weight_decays=None):
+        self.base_lr = learning_rate
+        self.weight_decay = weight_decay
+        self.momentum = momentum
+        self.dampening = momentum if dampening is None else dampening
+        self.nesterov = nesterov
+        if nesterov and (momentum <= 0 or self.dampening != 0):
+            raise ValueError(
+                "nesterov requires momentum > 0 and dampening = 0")
+        self.schedule = schedule if schedule is not None else Default(0.0)
+        self.learning_rates = learning_rates
+        self.weight_decays = weight_decays
+
+    def init(self, params):
+        st = {"step": jnp.zeros((), jnp.float32),
+              "epoch": jnp.zeros((), jnp.float32)}
+        if self.momentum > 0:
+            st["velocity"] = jax.tree_util.tree_map(jnp.zeros_like, params)
+        return st
+
+    def _lr(self, opt_state):
+        return self.schedule(self.base_lr, opt_state["step"], opt_state["epoch"])
+
+    def learning_rate(self, opt_state):
+        return self._lr(opt_state)
+
+    def update(self, grads, opt_state, params):
+        lr = self._lr(opt_state)
+        mu, damp = self.momentum, self.dampening
+
+        def one(g, w, v, plr, pwd):
+            g = g + pwd * w
+            if mu > 0:
+                v_new = mu * v + (1.0 - damp) * g
+                d = g + mu * v_new if self.nesterov else v_new
+            else:
+                v_new = v
+                d = g
+            return w - lr * plr * d, v_new
+
+        vel = opt_state.get("velocity",
+                            jax.tree_util.tree_map(lambda x: 0.0, params))
+        plrs = (self.learning_rates if self.learning_rates is not None
+                else jax.tree_util.tree_map(lambda x: 1.0, params))
+        pwds = (self.weight_decays if self.weight_decays is not None
+                else jax.tree_util.tree_map(lambda x: self.weight_decay, params))
+        out = jax.tree_util.tree_map(one, grads, params, vel, plrs, pwds)
+        new_params = jax.tree_util.tree_map(
+            lambda t: t[0], out, is_leaf=lambda t: isinstance(t, tuple))
+        new_state = dict(opt_state)
+        new_state["step"] = opt_state["step"] + 1
+        if mu > 0:
+            new_state["velocity"] = jax.tree_util.tree_map(
+                lambda t: t[1], out, is_leaf=lambda t: isinstance(t, tuple))
+        return new_params, new_state
+
+
+class Adagrad(OptimMethod):
+    """Adagrad (reference optim/Adagrad.scala): accumulate squared grads,
+    scale by 1/sqrt(acc + eps)."""
+
+    def __init__(self, learning_rate: float = 1e-2, lr_decay: float = 0.0,
+                 weight_decay: float = 0.0, eps: float = 1e-10):
+        self.base_lr = learning_rate
+        self.lr_decay = lr_decay
+        self.weight_decay = weight_decay
+        self.eps = eps
+
+    def init(self, params):
+        return {"step": jnp.zeros((), jnp.float32),
+                "epoch": jnp.zeros((), jnp.float32),
+                "accum": jax.tree_util.tree_map(jnp.zeros_like, params)}
+
+    def learning_rate(self, opt_state):
+        return self.base_lr / (1.0 + opt_state["step"] * self.lr_decay)
+
+    def update(self, grads, opt_state, params):
+        lr = self.learning_rate(opt_state)
+
+        def one(g, w, a):
+            g = g + self.weight_decay * w
+            a_new = a + jnp.square(g)
+            return w - lr * g / (jnp.sqrt(a_new) + self.eps), a_new
+
+        out = jax.tree_util.tree_map(one, grads, params, opt_state["accum"])
+        first = lambda t: t[0]
+        second = lambda t: t[1]
+        is_pair = lambda t: isinstance(t, tuple)
+        return (jax.tree_util.tree_map(first, out, is_leaf=is_pair),
+                {"step": opt_state["step"] + 1,
+                 "epoch": opt_state["epoch"],
+                 "accum": jax.tree_util.tree_map(second, out, is_leaf=is_pair)})
+
+
+class Adam(OptimMethod):
+    """Adam — not in the reference snapshot but table stakes for a complete
+    framework; kept in the same OptimMethod shape."""
+
+    def __init__(self, learning_rate: float = 1e-3, beta1: float = 0.9,
+                 beta2: float = 0.999, eps: float = 1e-8,
+                 weight_decay: float = 0.0,
+                 schedule: Optional[LearningRateSchedule] = None):
+        self.base_lr = learning_rate
+        self.beta1, self.beta2, self.eps = beta1, beta2, eps
+        self.weight_decay = weight_decay
+        self.schedule = schedule if schedule is not None else Default(0.0)
+
+    def init(self, params):
+        z = jax.tree_util.tree_map(jnp.zeros_like, params)
+        return {"step": jnp.zeros((), jnp.float32),
+                "epoch": jnp.zeros((), jnp.float32),
+                "m": z,
+                "v": jax.tree_util.tree_map(jnp.zeros_like, params)}
+
+    def learning_rate(self, opt_state):
+        return self.schedule(self.base_lr, opt_state["step"], opt_state["epoch"])
+
+    def update(self, grads, opt_state, params):
+        t = opt_state["step"] + 1
+        lr = self.schedule(self.base_lr, opt_state["step"], opt_state["epoch"])
+        b1, b2 = self.beta1, self.beta2
+        bc1 = 1.0 - jnp.power(b1, t)
+        bc2 = 1.0 - jnp.power(b2, t)
+
+        def one(g, w, m, v):
+            g = g + self.weight_decay * w
+            m_new = b1 * m + (1 - b1) * g
+            v_new = b2 * v + (1 - b2) * jnp.square(g)
+            upd = (m_new / bc1) / (jnp.sqrt(v_new / bc2) + self.eps)
+            return w - lr * upd, m_new, v_new
+
+        out = jax.tree_util.tree_map(one, grads, params,
+                                     opt_state["m"], opt_state["v"])
+        is_t = lambda t_: isinstance(t_, tuple)
+        pick = lambda i: jax.tree_util.tree_map(lambda t_: t_[i], out,
+                                                is_leaf=is_t)
+        return pick(0), {"step": t, "epoch": opt_state["epoch"],
+                         "m": pick(1), "v": pick(2)}
+
+
+class RMSprop(OptimMethod):
+    """RMSprop — companion method in the same functional shape."""
+
+    def __init__(self, learning_rate: float = 1e-2, decay_rate: float = 0.99,
+                 eps: float = 1e-8):
+        self.base_lr = learning_rate
+        self.decay_rate = decay_rate
+        self.eps = eps
+
+    def init(self, params):
+        return {"step": jnp.zeros((), jnp.float32),
+                "epoch": jnp.zeros((), jnp.float32),
+                "sq": jax.tree_util.tree_map(jnp.zeros_like, params)}
+
+    def update(self, grads, opt_state, params):
+        d = self.decay_rate
+
+        def one(g, w, s):
+            s_new = d * s + (1 - d) * jnp.square(g)
+            return w - self.base_lr * g / (jnp.sqrt(s_new) + self.eps), s_new
+
+        out = jax.tree_util.tree_map(one, grads, params, opt_state["sq"])
+        is_t = lambda t: isinstance(t, tuple)
+        return (jax.tree_util.tree_map(lambda t: t[0], out, is_leaf=is_t),
+                {"step": opt_state["step"] + 1, "epoch": opt_state["epoch"],
+                 "sq": jax.tree_util.tree_map(lambda t: t[1], out, is_leaf=is_t)})

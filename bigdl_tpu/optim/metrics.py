@@ -1,0 +1,52 @@
+"""Named metric counters (reference optim/Metrics.scala:25-117).
+
+The reference aggregates counters across the cluster with Spark
+accumulators; here counters are host-side (per-process), and multi-host
+aggregation — when running under jax.distributed — is a psum over a tiny
+array done by the caller. The API (set/add/summary) matches the reference.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict
+
+__all__ = ["Metrics"]
+
+
+class Metrics:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._sum: Dict[str, float] = {}
+        self._count: Dict[str, int] = {}
+
+    def set(self, name: str, value: float) -> None:
+        with self._lock:
+            self._sum[name] = float(value)
+            self._count[name] = 1
+
+    def add(self, name: str, value: float) -> None:
+        with self._lock:
+            self._sum[name] = self._sum.get(name, 0.0) + float(value)
+            self._count[name] = self._count.get(name, 0) + 1
+
+    def get(self, name: str) -> tuple[float, int]:
+        with self._lock:
+            return self._sum.get(name, 0.0), self._count.get(name, 0)
+
+    def mean(self, name: str) -> float:
+        s, c = self.get(name)
+        return s / c if c else 0.0
+
+    def reset(self) -> None:
+        with self._lock:
+            self._sum.clear()
+            self._count.clear()
+
+    def summary(self, unit: str = "s", scale: float = 1.0) -> str:
+        """Pretty-print all counters (reference Metrics.summary :99)."""
+        with self._lock:
+            lines = [f"  {k}: sum={v / scale:.4g}{unit} "
+                     f"mean={v / max(1, self._count[k]) / scale:.4g}{unit}"
+                     for k, v in sorted(self._sum.items())]
+        return "\n".join(["Metrics:"] + lines)

@@ -1,0 +1,252 @@
+"""Training loop facade (reference optim/Optimizer.scala:30-129,
+DistriOptimizer.scala, LocalOptimizer.scala).
+
+One loop for local and distributed: the reference's LocalOptimizer (clone per
+core, fork-join) and DistriOptimizer (two Spark jobs per iteration, block
+all-reduce) collapse into a single jitted train step; when a
+:class:`~bigdl_tpu.parallel.DataParallel` strategy is supplied, the same step
+is sharded over a device mesh and XLA inserts the gradient all-reduce that
+the reference hand-rolls through the BlockManager (SURVEY.md §3.2).
+
+API parity: ``Optimizer(model, dataset, criterion)`` then
+``set_state/set_optim_method/set_end_when/set_validation/set_checkpoint`` and
+``optimize()`` (reference setters :66-124, factory :151-186). The canonical
+log line "Train N in Xs. Throughput is R records/second. Loss is L"
+(DistriOptimizer.scala:241-244) is preserved.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+from typing import Any, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.core.module import Module
+from bigdl_tpu.core.criterion import Criterion
+from bigdl_tpu.optim.method import OptimMethod, SGD
+from bigdl_tpu.optim.metrics import Metrics
+from bigdl_tpu.optim.triggers import Trigger
+from bigdl_tpu.optim.validation import ValidationMethod
+from bigdl_tpu.utils.file import save_pytree, load_pytree
+
+logger = logging.getLogger("bigdl_tpu")
+
+__all__ = ["Optimizer", "TrainedModel"]
+
+
+class TrainedModel:
+    """What optimize() returns: the module description plus trained pytrees."""
+
+    def __init__(self, module: Module, params, mod_state):
+        self.module = module
+        self.params = params
+        self.mod_state = mod_state
+
+    def predict(self, x, batch_size: Optional[int] = None):
+        return self.module.forward(self.params, x, self.mod_state,
+                                   training=False)
+
+
+class Optimizer:
+    def __init__(self, model: Module, dataset, criterion: Criterion,
+                 optim_method: Optional[OptimMethod] = None,
+                 end_when: Optional[Trigger] = None,
+                 strategy=None, seed: int = 42, log_every: int = 1):
+        self.model = model
+        self.dataset = dataset
+        self.criterion = criterion
+        self.optim_method = optim_method or SGD(learning_rate=1e-2)
+        self.end_when = end_when or Trigger.max_epoch(1)
+        self.strategy = strategy  # None => single-device
+        self.seed = seed
+        self._val_trigger = None
+        self._val_dataset = None
+        self._val_methods: Sequence[ValidationMethod] = ()
+        self._ckpt_trigger = None
+        self._ckpt_path = None
+        self._init_params = None
+        self._init_mod_state = None
+        self._init_opt_state = None
+        self.metrics = Metrics()
+        # log_every > 1 avoids the per-step host<->device loss sync on the
+        # hot path (the float() below blocks until the step finishes, which
+        # serializes dispatch on TPU)
+        self.log_every = max(1, log_every)
+        self._last_val_iter = -1
+        self._last_ckpt_iter = -1
+
+    # ---------------------------------------------------------------- setters
+    def set_end_when(self, trigger: Trigger) -> "Optimizer":
+        self.end_when = trigger
+        return self
+
+    def set_optim_method(self, method: OptimMethod) -> "Optimizer":
+        self.optim_method = method
+        return self
+
+    def set_validation(self, trigger: Trigger, dataset,
+                       methods: Sequence[ValidationMethod]) -> "Optimizer":
+        """(reference Optimizer.setValidation :97-105)"""
+        self._val_trigger = trigger
+        self._val_dataset = dataset
+        self._val_methods = list(methods)
+        return self
+
+    def set_checkpoint(self, trigger: Trigger, path: str) -> "Optimizer":
+        """(reference Optimizer.setCheckpoint :87-94)"""
+        self._ckpt_trigger = trigger
+        self._ckpt_path = path
+        return self
+
+    def set_state(self, params=None, mod_state=None,
+                  opt_state=None) -> "Optimizer":
+        """Warm-start from explicit pytrees (reference setState :66 +
+        --model/--state resume flags)."""
+        self._init_params = params
+        self._init_mod_state = mod_state
+        self._init_opt_state = opt_state
+        return self
+
+    def resume(self, checkpoint_dir: str) -> "Optimizer":
+        """Load the newest model.<n>/state.<n> pair from a directory."""
+        from bigdl_tpu.utils.file import latest_checkpoint
+        m = latest_checkpoint(checkpoint_dir, "model.")
+        s = latest_checkpoint(checkpoint_dir, "state.")
+        if m:
+            blob = load_pytree(m)
+            self._init_params = blob["params"]
+            self._init_mod_state = blob["mod_state"]
+        if s:
+            self._init_opt_state = load_pytree(s)
+        return self
+
+    # ---------------------------------------------------------------- build
+    def _build_step(self):
+        model, criterion, opt = self.model, self.criterion, self.optim_method
+
+        def train_step(params, mod_state, opt_state, x, y, rng):
+            def loss_fn(p):
+                out, new_ms = model.apply(p, mod_state, x,
+                                          training=True, rng=rng)
+                return criterion(out, y), new_ms
+
+            (loss, new_ms), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            if self.strategy is not None:
+                grads, loss = self.strategy.reduce_grads(grads, loss)
+            new_params, new_opt = opt.update(grads, opt_state, params)
+            return new_params, new_ms, new_opt, loss
+
+        if self.strategy is not None:
+            return self.strategy.compile_step(train_step)
+        return jax.jit(train_step, donate_argnums=(0, 1, 2))
+
+    def _build_eval(self):
+        from bigdl_tpu.optim.validator import build_eval_fn
+        return build_eval_fn(self.model, self._val_methods, self.strategy)
+
+    # -------------------------------------------------------------- optimize
+    def optimize(self) -> TrainedModel:
+        rng = jax.random.PRNGKey(self.seed)
+        rng, k_init = jax.random.split(rng)
+        params = (self._init_params if self._init_params is not None
+                  else self.model.init(k_init))
+        mod_state = (self._init_mod_state if self._init_mod_state is not None
+                     else self.model.init_state())
+        opt_state = (self._init_opt_state if self._init_opt_state is not None
+                     else self.optim_method.init(params))
+        if self.strategy is not None:
+            params, mod_state, opt_state = self.strategy.place(
+                params, mod_state, opt_state)
+
+        step_fn = self._build_step()
+        eval_fn = self._build_eval() if self._val_methods else None
+
+        driver = {"epoch": 1, "iteration": 0, "epoch_finished": False,
+                  "loss": float("inf")}
+        wall_start = time.time()
+        records_this_epoch = 0
+
+        while not self.end_when(driver):
+            driver["epoch_finished"] = False
+            epoch_start = time.time()
+            records_this_epoch = 0
+            opt_state = self.optim_method.set_epoch(opt_state, driver["epoch"])
+            for batch in self.dataset:
+                t0 = time.time()
+                x, y = batch
+                if self.strategy is not None:
+                    x, y = self.strategy.shard_batch(x, y)
+                else:
+                    x, y = jnp.asarray(x), jnp.asarray(y)
+                rng, k_step = jax.random.split(rng)
+                params, mod_state, opt_state, loss = step_fn(
+                    params, mod_state, opt_state, x, y, k_step)
+                n = len(x)
+                driver["iteration"] += 1
+                # keep `loss` a device array between log points so step N+1
+                # can dispatch while step N still runs on device
+                driver["loss"] = loss
+                records_this_epoch += n
+                if driver["iteration"] % self.log_every == 0:
+                    loss_f = float(loss)
+                    driver["loss"] = loss_f
+                    dt = time.time() - t0
+                    self.metrics.add("computing time", dt)
+                    logger.info(
+                        "Train %d in %.4fs. Throughput is %.1f "
+                        "records/second. Loss is %.4f",
+                        n, dt, n / max(dt, 1e-9), loss_f)
+                self._maybe_validate(eval_fn, params, mod_state, driver)
+                self._maybe_checkpoint(params, mod_state, opt_state, driver)
+                if self.end_when(driver):
+                    break
+            driver["epoch"] += 1
+            driver["epoch_finished"] = True
+            self.dataset.shuffle()
+            dt_e = time.time() - epoch_start
+            logger.info("Epoch %d done: %d records in %.2fs (%.1f rec/s)",
+                        driver["epoch"] - 1, records_this_epoch, dt_e,
+                        records_this_epoch / max(dt_e, 1e-9))
+            self._maybe_validate(eval_fn, params, mod_state, driver)
+            self._maybe_checkpoint(params, mod_state, opt_state, driver)
+
+        logger.info("Training finished after %d iterations in %.1fs",
+                    driver["iteration"], time.time() - wall_start)
+        return TrainedModel(self.model, params, mod_state)
+
+    # ------------------------------------------------------------- callbacks
+    def _maybe_validate(self, eval_fn, params, mod_state, driver):
+        if (eval_fn is None or self._val_trigger is None
+                or not self._val_trigger(driver)
+                or driver["iteration"] == self._last_val_iter):
+            return None
+        self._last_val_iter = driver["iteration"]
+        from bigdl_tpu.optim.validator import run_evaluation
+        results = run_evaluation(eval_fn, self._val_dataset,
+                                 self._val_methods, params, mod_state,
+                                 self.strategy)
+        for m, r in zip(self._val_methods, results):
+            logger.info("%s is %r", m.name, r)
+        driver["val_results"] = results
+        return results
+
+    def _maybe_checkpoint(self, params, mod_state, opt_state, driver):
+        if (self._ckpt_path is None or self._ckpt_trigger is None
+                or not self._ckpt_trigger(driver)
+                or driver["iteration"] == self._last_ckpt_iter):
+            return
+        self._last_ckpt_iter = driver["iteration"]
+        n = driver["iteration"]
+        if self.strategy is not None:
+            params, mod_state, opt_state = self.strategy.gather(
+                params, mod_state, opt_state)
+        save_pytree({"params": params, "mod_state": mod_state},
+                    os.path.join(self._ckpt_path, f"model.{n}"))
+        save_pytree(opt_state, os.path.join(self._ckpt_path, f"state.{n}"))
+        logger.info("Checkpoint written at iteration %d to %s", n,
+                    self._ckpt_path)

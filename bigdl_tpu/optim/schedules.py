@@ -1,0 +1,106 @@
+"""Learning-rate schedules (reference optim/SGD.scala:103-186).
+
+A schedule is a pure function of (step, epoch) -> multiplier-adjusted lr,
+so it can be evaluated inside a jitted train step from traced counters.
+Hyperparameter names follow the reference (Poly/Step/EpochStep/EpochDecay/
+Default/Regime EpochSchedule).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Sequence
+
+import jax.numpy as jnp
+
+__all__ = [
+    "LearningRateSchedule", "Default", "Poly", "Step", "EpochDecay",
+    "EpochStep", "Regime", "EpochSchedule",
+]
+
+
+class LearningRateSchedule:
+    """lr(base_lr, step, epoch) -> effective learning rate (a jnp scalar)."""
+
+    def __call__(self, base_lr, step, epoch):
+        raise NotImplementedError
+
+
+@dataclass
+class Default(LearningRateSchedule):
+    """base_lr / (1 + step * decay) (reference SGD.Default :174)."""
+
+    decay: float = 0.0
+
+    def __call__(self, base_lr, step, epoch):
+        return base_lr / (1.0 + step * self.decay)
+
+
+@dataclass
+class Poly(LearningRateSchedule):
+    """base_lr * (1 - step/max_iteration)^power, 0 after max_iteration
+    (reference SGD.Poly :119 — the Inception-v1 ImageNet schedule,
+    models/inception/Train.scala:77-83)."""
+
+    power: float
+    max_iteration: int
+
+    def __call__(self, base_lr, step, epoch):
+        frac = jnp.clip(step / self.max_iteration, 0.0, 1.0)
+        return base_lr * jnp.power(1.0 - frac, self.power)
+
+
+@dataclass
+class Step(LearningRateSchedule):
+    """base_lr * gamma^(step // step_size) (reference SGD.Step :134)."""
+
+    step_size: int
+    gamma: float
+
+    def __call__(self, base_lr, step, epoch):
+        return base_lr * jnp.power(self.gamma, jnp.floor(step / self.step_size))
+
+
+@dataclass
+class EpochDecay(LearningRateSchedule):
+    """base_lr * decay_fn(epoch) with a host-side python decay function
+    (reference SGD.EpochDecay :149). The callable must be jnp-traceable."""
+
+    decay_fn: object
+
+    def __call__(self, base_lr, step, epoch):
+        return base_lr * self.decay_fn(epoch)
+
+
+@dataclass
+class EpochStep(LearningRateSchedule):
+    """base_lr * gamma^(epoch // step_size) (reference SGD.EpochStep :160)."""
+
+    step_size: int
+    gamma: float
+
+    def __call__(self, base_lr, step, epoch):
+        return base_lr * jnp.power(self.gamma, jnp.floor(epoch / self.step_size))
+
+
+@dataclass
+class Regime:
+    """[start_epoch, end_epoch] -> lr override (reference SGD.Regime)."""
+
+    start_epoch: int
+    end_epoch: int
+    lr: float
+
+
+@dataclass
+class EpochSchedule(LearningRateSchedule):
+    """Piecewise-constant lr by epoch regime (reference SGD.EpochSchedule :108)."""
+
+    regimes: Sequence[Regime]
+
+    def __call__(self, base_lr, step, epoch):
+        lr = jnp.asarray(base_lr, jnp.float32)
+        for r in self.regimes:
+            hit = (epoch >= r.start_epoch) & (epoch <= r.end_epoch)
+            lr = jnp.where(hit, r.lr, lr)
+        return lr

@@ -1,0 +1,56 @@
+"""Triggers drive end-of-training, validation, and checkpoint cadence
+(reference optim/Trigger.scala:26-70). A trigger is a predicate over the
+driver's scalar state (host-side, never traced)."""
+
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+__all__ = ["Trigger"]
+
+
+class Trigger:
+    def __init__(self, fn: Callable[[Dict], bool], desc: str):
+        self._fn = fn
+        self.desc = desc
+
+    def __call__(self, driver_state: Dict) -> bool:
+        return self._fn(driver_state)
+
+    def __repr__(self):
+        return f"Trigger({self.desc})"
+
+    # -- factories (names match the reference object Trigger) ---------------
+    @staticmethod
+    def every_epoch() -> "Trigger":
+        """Fires at each epoch rollover (reference Trigger.everyEpoch :27)."""
+        return Trigger(lambda s: s.get("epoch_finished", False), "everyEpoch")
+
+    @staticmethod
+    def several_iteration(n: int) -> "Trigger":
+        """Fires every n iterations (reference Trigger.severalIteration :47)."""
+        return Trigger(lambda s: s["iteration"] > 0 and s["iteration"] % n == 0,
+                       f"severalIteration({n})")
+
+    @staticmethod
+    def max_epoch(n: int) -> "Trigger":
+        """True once epoch > n (reference Trigger.maxEpoch :56; epochs are
+        1-based like the reference)."""
+        return Trigger(lambda s: s["epoch"] > n, f"maxEpoch({n})")
+
+    @staticmethod
+    def max_iteration(n: int) -> "Trigger":
+        """(reference Trigger.maxIteration :64)"""
+        return Trigger(lambda s: s["iteration"] >= n, f"maxIteration({n})")
+
+    @staticmethod
+    def min_loss(v: float) -> "Trigger":
+        return Trigger(lambda s: s.get("loss", float("inf")) < v, f"minLoss({v})")
+
+    @staticmethod
+    def and_(*ts: "Trigger") -> "Trigger":
+        return Trigger(lambda s: all(t(s) for t in ts), "and")
+
+    @staticmethod
+    def or_(*ts: "Trigger") -> "Trigger":
+        return Trigger(lambda s: any(t(s) for t in ts), "or")

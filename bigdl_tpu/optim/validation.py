@@ -1,0 +1,126 @@
+"""Validation methods and result monoids
+(reference optim/ValidationMethod.scala:28-213, optim/EvaluateMethods.scala).
+
+Results are monoids (``+``) so they reduce across batches, devices, and hosts
+exactly like the reference reduces them across Spark partitions (:38-51).
+The per-batch computation is jit-friendly: each method has a
+``stats(output, target) -> (correct_or_sum, count)`` device-side part and the
+monoid lives host-side.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["ValidationResult", "AccuracyResult", "LossResult",
+           "ValidationMethod", "Top1Accuracy", "Top5Accuracy", "Loss"]
+
+
+class ValidationResult:
+    def __add__(self, other):
+        raise NotImplementedError
+
+    def result(self) -> tuple[float, int]:
+        raise NotImplementedError
+
+
+class AccuracyResult(ValidationResult):
+    """(reference AccuracyResult — correct/count with + merge)"""
+
+    def __init__(self, correct: int, count: int):
+        self.correct, self.count = int(correct), int(count)
+
+    def __add__(self, other):
+        return AccuracyResult(self.correct + other.correct,
+                              self.count + other.count)
+
+    def result(self):
+        acc = self.correct / self.count if self.count else 0.0
+        return acc, self.count
+
+    def __repr__(self):
+        acc, _ = self.result()
+        return f"AccuracyResult({acc:.4f}, {self.correct}/{self.count})"
+
+    def __eq__(self, other):
+        return (self.correct, self.count) == (other.correct, other.count)
+
+
+class LossResult(ValidationResult):
+    def __init__(self, loss_sum: float, count: int):
+        self.loss_sum, self.count = float(loss_sum), int(count)
+
+    def __add__(self, other):
+        return LossResult(self.loss_sum + other.loss_sum,
+                          self.count + other.count)
+
+    def result(self):
+        mean = self.loss_sum / self.count if self.count else 0.0
+        return mean, self.count
+
+    def __repr__(self):
+        mean, _ = self.result()
+        return f"LossResult({mean:.4f}, n={self.count})"
+
+
+class ValidationMethod:
+    """Device part: :meth:`stats`; host part: :meth:`to_result`."""
+
+    name = "validation"
+
+    def stats(self, output, target):
+        """Returns (value, count) jnp scalars, computed on device."""
+        raise NotImplementedError
+
+    def to_result(self, value, count) -> ValidationResult:
+        raise NotImplementedError
+
+
+class _TopK(ValidationMethod):
+    k = 1
+
+    def stats(self, output, target):
+        # output (B, C) scores or log-probs; target (B,) int labels
+        if self.k == 1:
+            pred = jnp.argmax(output, axis=-1)
+            correct = jnp.sum(pred == target.astype(pred.dtype))
+        else:
+            _, topk = jax.lax.top_k(output, self.k)
+            correct = jnp.sum(
+                jnp.any(topk == target.astype(topk.dtype)[:, None], axis=-1))
+        return correct, output.shape[0]
+
+    def to_result(self, value, count):
+        return AccuracyResult(int(value), int(count))
+
+
+class Top1Accuracy(_TopK):
+    """(reference ValidationMethod.Top1Accuracy :87)"""
+    name = "top1 accuracy"
+    k = 1
+
+
+class Top5Accuracy(_TopK):
+    """(reference ValidationMethod.Top5Accuracy :122)"""
+    name = "top5 accuracy"
+    k = 5
+
+
+import jax  # noqa: E402  (lax.top_k used above)
+
+
+class Loss(ValidationMethod):
+    """Mean criterion value over the validation set (reference
+    ValidationMethod.Loss :202)."""
+
+    name = "loss"
+
+    def __init__(self, criterion):
+        self.criterion = criterion
+
+    def stats(self, output, target):
+        n = output.shape[0]
+        return self.criterion(output, target) * n, n
+
+    def to_result(self, value, count):
+        return LossResult(float(value), int(count))

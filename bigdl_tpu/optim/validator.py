@@ -1,0 +1,60 @@
+"""Standalone model evaluation (reference optim/Validator.scala:24-40,
+LocalValidator.scala:30, DistriValidator.scala:33 — one implementation here;
+the local/distributed split is just whether a parallel strategy is supplied).
+
+The Optimizer's in-training validation reuses these helpers, so batch
+sharding and result accumulation live in exactly one place.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.optim.validation import ValidationMethod
+
+__all__ = ["Validator", "build_eval_fn", "run_evaluation"]
+
+
+def build_eval_fn(model, methods: Sequence[ValidationMethod], strategy=None):
+    """Jit-compile the device-side half of validation."""
+
+    def eval_step(params, mod_state, x, y):
+        out, _ = model.apply(params, mod_state, x, training=False)
+        return [m.stats(out, y) for m in methods]
+
+    if strategy is not None:
+        return strategy.compile_eval(eval_step)
+    return jax.jit(eval_step)
+
+
+def run_evaluation(eval_fn, dataset, methods: Sequence[ValidationMethod],
+                   params, mod_state, strategy=None):
+    """One pass over ``dataset``, reducing each method's (value, count)
+    monoid across batches (the reference reduces across partitions,
+    ValidationMethod.scala:38-51)."""
+    accs = None
+    for batch in dataset:
+        x, y = batch
+        if strategy is not None:
+            x, y = strategy.shard_batch(x, y)
+        else:
+            x, y = jnp.asarray(x), jnp.asarray(y)
+        stats = [(float(v), int(c)) for v, c in eval_fn(params, mod_state, x, y)]
+        accs = stats if accs is None else [
+            (a + v, b + c) for (a, b), (v, c) in zip(accs, stats)]
+    return [m.to_result(v, c) for m, (v, c) in zip(methods, accs or [])]
+
+
+class Validator:
+    def __init__(self, model, dataset, strategy=None):
+        self.model = model
+        self.dataset = dataset
+        self.strategy = strategy
+
+    def test(self, params, mod_state, methods: Sequence[ValidationMethod]):
+        eval_fn = build_eval_fn(self.model, methods, self.strategy)
+        return run_evaluation(eval_fn, self.dataset, methods, params,
+                              mod_state, self.strategy)

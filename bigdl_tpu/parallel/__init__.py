@@ -1,0 +1,4 @@
+from bigdl_tpu.parallel.mesh import (
+    init_distributed, make_mesh, local_mesh, P, NamedSharding,
+)
+from bigdl_tpu.parallel.data_parallel import DataParallel

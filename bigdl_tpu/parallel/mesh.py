@@ -1,0 +1,66 @@
+"""Device-mesh construction and distributed bring-up.
+
+Replaces the reference's Engine.init + Spark topology
+(utils/Engine.scala:305-337): where the reference provisions executor JVMs,
+env vars, and thread pools, the TPU runtime is (1) an optional
+``jax.distributed.initialize`` for multi-host, and (2) a
+``jax.sharding.Mesh`` whose axes name the parallelism dimensions.
+
+Axis conventions used across the framework:
+  * ``data``  — batch / data parallelism (the reference's only inter-node axis)
+  * ``model`` — tensor parallelism (new capability, ICI-friendly)
+  * ``seq``   — sequence/context parallelism for long sequences
+  * ``pipe``  — pipeline stages
+All collectives ride whichever physical links the mesh maps those axes onto;
+keep ``model``/``seq`` on ICI-adjacent devices and ``data`` outermost (DCN).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["init_distributed", "make_mesh", "local_mesh", "P", "NamedSharding"]
+
+
+def init_distributed(coordinator_address: Optional[str] = None,
+                     num_processes: Optional[int] = None,
+                     process_id: Optional[int] = None) -> None:
+    """Multi-host control-plane bring-up (the analog of the reference's
+    Engine.init(onSpark=true) executor rendezvous, Engine.scala:305-337).
+    No-op when running single-process."""
+    if num_processes is None or num_processes <= 1:
+        return
+    jax.distributed.initialize(coordinator_address=coordinator_address,
+                               num_processes=num_processes,
+                               process_id=process_id)
+
+
+def make_mesh(axes: dict[str, int], devices: Optional[Sequence] = None) -> Mesh:
+    """Build a named mesh, e.g. ``make_mesh({'data': 4, 'model': 2})``.
+
+    Axis sizes must multiply to the device count; size -1 means "fill with
+    the remaining devices"."""
+    devices = list(devices if devices is not None else jax.devices())
+    names = list(axes.keys())
+    sizes = list(axes.values())
+    if -1 in sizes:
+        known = int(np.prod([s for s in sizes if s != -1]))
+        sizes[sizes.index(-1)] = len(devices) // known
+    total = int(np.prod(sizes))
+    if total != len(devices):
+        raise ValueError(f"mesh {dict(zip(names, sizes))} needs {total} "
+                         f"devices, have {len(devices)}")
+    arr = np.asarray(devices).reshape(sizes)
+    return Mesh(arr, tuple(names))
+
+
+def local_mesh(data_axis: str = "data") -> Mesh:
+    """All local devices on one data axis — the 'LocalOptimizer' topology
+    (one host, batch split across chips like the reference splits across
+    cores, LocalOptimizer.scala:65-105)."""
+    return make_mesh({data_axis: len(jax.devices())})

@@ -1,0 +1,1 @@
+from bigdl_tpu.utils.gradcheck import check_gradients, numerical_grad

@@ -1,0 +1,55 @@
+"""Checkpoint IO (reference utils/File.scala:27-131, Optimizer.saveModel/
+saveState :137-149).
+
+The reference Java-serializes the module graph; here checkpoints are pytrees
+of numpy arrays in a ``np.savez`` archive with a pickled treedef — portable,
+no framework objects inside. The two-artifact convention (``model.<n>`` for
+params+state, ``state.<n>`` for optimizer state) is preserved.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any
+
+import jax
+import numpy as np
+
+__all__ = ["save_pytree", "load_pytree", "latest_checkpoint"]
+
+
+def save_pytree(tree: Any, path: str) -> None:
+    """Write a pytree of arrays to ``path`` (.npz + embedded treedef)."""
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    arrays = {f"leaf_{i}": np.asarray(x) for i, x in enumerate(leaves)}
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        np.savez(f, __treedef__=np.frombuffer(
+            pickle.dumps(treedef), dtype=np.uint8), **arrays)
+    os.replace(tmp, path)
+
+
+def load_pytree(path: str) -> Any:
+    with np.load(path, allow_pickle=False) as z:
+        treedef = pickle.loads(z["__treedef__"].tobytes())
+        leaves = [z[f"leaf_{i}"] for i in range(len(z.files) - 1)]
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+def latest_checkpoint(directory: str, prefix: str = "model.") -> str | None:
+    """Find the highest-numbered ``<prefix><n>`` file (resume helper,
+    reference models/lenet/Train.scala:55-67 --model/--state flags)."""
+    if not os.path.isdir(directory):
+        return None
+    best, best_n = None, -1
+    for f in os.listdir(directory):
+        if f.startswith(prefix):
+            try:
+                n = int(f[len(prefix):])
+            except ValueError:
+                continue
+            if n > best_n:
+                best, best_n = os.path.join(directory, f), n
+    return best

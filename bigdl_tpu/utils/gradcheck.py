@@ -1,0 +1,55 @@
+"""Finite-difference gradient checking.
+
+Parity with the reference's GradientChecker (dl/src/test/scala/.../nn/
+GradientChecker.scala, used at 1e-4 perturbation / 1e-2 tolerance). Even with
+JAX autodiff this stays in the framework test kit: it catches custom-VJP and
+Pallas-kernel bugs that autodiff alone cannot (SURVEY.md §4 lesson (d)).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bigdl_tpu.core.pytree import flatten_params
+
+__all__ = ["check_gradients", "numerical_grad"]
+
+
+def numerical_grad(loss_fn: Callable[[jnp.ndarray], jnp.ndarray],
+                   flat: jnp.ndarray, eps: float = 1e-4,
+                   max_entries: int = 200) -> np.ndarray:
+    """Central finite differences on a flat vector. For large vectors, checks
+    a deterministic subsample of ``max_entries`` coordinates."""
+    flat = np.asarray(flat, np.float64)
+    n = flat.size
+    idx = (np.arange(n) if n <= max_entries
+           else np.linspace(0, n - 1, max_entries).astype(np.int64))
+    out = np.zeros(idx.size)
+    for j, i in enumerate(idx):
+        d = np.zeros_like(flat)
+        d[i] = eps
+        lo = float(loss_fn(jnp.asarray(flat - d, jnp.float32)))
+        hi = float(loss_fn(jnp.asarray(flat + d, jnp.float32)))
+        out[j] = (hi - lo) / (2 * eps)
+    return idx, out
+
+
+def check_gradients(loss_fn: Callable, params, eps: float = 1e-3,
+                    rtol: float = 2e-2, atol: float = 5e-3,
+                    max_entries: int = 200) -> None:
+    """Assert autodiff grads of ``loss_fn(params)`` match finite differences.
+
+    ``loss_fn`` takes the params pytree and returns a scalar.
+    """
+    flat, unravel = flatten_params(params)
+
+    def flat_loss(v):
+        return loss_fn(unravel(v))
+
+    auto = np.asarray(jax.grad(flat_loss)(flat), np.float64)
+    idx, num = numerical_grad(flat_loss, flat, eps, max_entries)
+    np.testing.assert_allclose(auto[idx], num, rtol=rtol, atol=atol)

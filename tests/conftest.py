@@ -1,0 +1,37 @@
+"""Test harness: force an 8-device CPU platform so multi-chip sharding logic
+is exercised without TPU hardware — the analog of the reference testing
+multi-node logic on local-mode Spark (SURVEY.md §4: Engine.init(4,4,true) +
+SparkContext("local[1]")).
+
+Note: we select CPU via ``jax.config.update('jax_platforms', 'cpu')`` rather
+than the JAX_PLATFORMS env var — in this environment the axon TPU plugin
+hangs at import when JAX_PLATFORMS is set.
+"""
+
+import os
+import sys
+
+# Must be in the environment before the first backend initialization.
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return jax.random.PRNGKey(0)
+
+
+@pytest.fixture(autouse=True)
+def _np_seed():
+    np.random.seed(0)

@@ -1,0 +1,104 @@
+"""Activation zoo vs torch-CPU oracle — the TPU-framework analog of the
+reference's golden Torch7 specs (dl/src/test/scala/.../torch/*Spec.scala)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as F
+
+from bigdl_tpu import nn
+
+X = np.random.RandomState(1).randn(4, 7).astype(np.float32) * 3
+
+
+def _cmp(module, torch_fn, x=X, atol=1e-5):
+    ours = np.asarray(module.forward(module.init(jax.random.PRNGKey(0)),
+                                     jnp.asarray(x)))
+    theirs = torch_fn(torch.from_numpy(x)).numpy()
+    np.testing.assert_allclose(ours, theirs, atol=atol, rtol=1e-5)
+
+
+@pytest.mark.parametrize("mod,fn", [
+    (nn.ReLU(), F.relu),
+    (nn.ReLU6(), F.relu6),
+    (nn.Tanh(), torch.tanh),
+    (nn.Sigmoid(), torch.sigmoid),
+    (nn.LogSigmoid(), F.logsigmoid),
+    (nn.ELU(), F.elu),
+    (nn.LeakyReLU(0.1), lambda t: F.leaky_relu(t, 0.1)),
+    (nn.SoftPlus(), F.softplus),
+    (nn.SoftPlus(2.0), lambda t: F.softplus(t, beta=2.0)),
+    (nn.SoftSign(), F.softsign),
+    (nn.HardTanh(), F.hardtanh),
+    (nn.HardShrink(0.5), lambda t: F.hardshrink(t, 0.5)),
+    (nn.SoftShrink(0.5), lambda t: F.softshrink(t, 0.5)),
+    (nn.TanhShrink(), F.tanhshrink),
+    (nn.SoftMax(), lambda t: F.softmax(t, -1)),
+    (nn.SoftMin(), lambda t: F.softmin(t, -1)),
+    (nn.LogSoftMax(), lambda t: F.log_softmax(t, -1)),
+    (nn.Abs(), torch.abs),
+    (nn.Square(), torch.square),
+    (nn.Exp(), torch.exp),
+    (nn.Clamp(-2, 2), lambda t: torch.clamp(t, -2, 2)),
+])
+def test_activation_matches_torch(mod, fn):
+    _cmp(mod, fn)
+
+
+def test_sqrt_log_positive():
+    x = np.abs(X) + 0.5
+    _cmp(nn.Sqrt(), torch.sqrt, x)
+    _cmp(nn.Log(), torch.log, x)
+
+
+def test_power():
+    x = np.abs(X) + 0.1
+    mod = nn.Power(2.0, scale=1.5, shift=0.5)
+    ours = np.asarray(mod.forward({}, jnp.asarray(x)))
+    np.testing.assert_allclose(ours, (0.5 + 1.5 * x) ** 2, rtol=1e-5)
+
+
+def test_threshold():
+    mod = nn.Threshold(0.5, -1.0)
+    out = np.asarray(mod.forward({}, jnp.asarray(X)))
+    exp = np.where(X > 0.5, X, -1.0)
+    np.testing.assert_allclose(out, exp)
+
+
+def test_prelu_shared_and_per_channel(rng):
+    x = jnp.asarray(X)
+    shared = nn.PReLU()
+    p = shared.init(rng)
+    out = shared.forward(p, x)
+    exp = np.where(X >= 0, X, 0.25 * X)
+    np.testing.assert_allclose(np.asarray(out), exp, rtol=1e-6)
+
+    per = nn.PReLU(7)
+    p2 = per.init(rng)
+    out2 = per.forward(p2, x)
+    np.testing.assert_allclose(np.asarray(out2), exp, rtol=1e-6)
+
+
+def test_rrelu_modes(rng):
+    mod = nn.RReLU()
+    x = jnp.asarray(X)
+    # eval: deterministic mean slope
+    out = mod.forward({}, x, training=False)
+    slope = (1 / 8 + 1 / 3) / 2
+    np.testing.assert_allclose(np.asarray(out),
+                               np.where(X >= 0, X, slope * X), rtol=1e-6)
+    # train: slopes within [lower, upper]
+    out_t = np.asarray(mod.forward({}, x, training=True, rng=rng))
+    neg = X < 0
+    ratios = out_t[neg] / X[neg]
+    assert (ratios >= 1 / 8 - 1e-6).all() and (ratios <= 1 / 3 + 1e-6).all()
+
+
+def test_gradient_reversal(rng):
+    mod = nn.GradientReversal(lam=2.0)
+    x = jnp.asarray(X)
+    np.testing.assert_allclose(np.asarray(mod.forward({}, x)), X)
+    g = jax.grad(lambda t: jnp.sum(mod.forward({}, t)))(x)
+    np.testing.assert_allclose(np.asarray(g), -2.0 * np.ones_like(X))

@@ -1,0 +1,137 @@
+"""Conv/pool layers vs torch oracle (reference torch/SpatialConvolutionSpec
+etc.). Ours are NHWC; torch is NCHW — tests transpose at the boundary."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as F
+
+from bigdl_tpu import nn
+from bigdl_tpu.utils import check_gradients
+
+R = np.random.RandomState(11)
+
+
+def nhwc(x_nchw):
+    return np.ascontiguousarray(np.transpose(x_nchw, (0, 2, 3, 1)))
+
+
+def nchw(x_nhwc):
+    return np.ascontiguousarray(np.transpose(x_nhwc, (0, 3, 1, 2)))
+
+
+def torch_weight(p):  # HWIO -> OIHW
+    return torch.from_numpy(np.ascontiguousarray(
+        np.transpose(np.asarray(p["weight"]), (3, 2, 0, 1))))
+
+
+@pytest.mark.parametrize("stride,pad,groups", [
+    (1, 0, 1), (2, 1, 1), (1, 2, 1), (1, 0, 2), (2, 1, 4),
+])
+def test_spatial_convolution_vs_torch(rng, stride, pad, groups):
+    cin, cout, k = 4, 8, 3
+    mod = nn.SpatialConvolution(cin, cout, k, k, stride, stride, pad, pad,
+                                n_group=groups)
+    p = mod.init(rng)
+    x = R.randn(2, cin, 9, 9).astype(np.float32)
+    ours = nchw(np.asarray(mod.forward(p, jnp.asarray(nhwc(x)))))
+    theirs = F.conv2d(torch.from_numpy(x), torch_weight(p),
+                      torch.from_numpy(np.asarray(p["bias"])),
+                      stride=stride, padding=pad, groups=groups).numpy()
+    np.testing.assert_allclose(ours, theirs, atol=1e-4)
+
+
+def test_dilated_convolution_vs_torch(rng):
+    mod = nn.SpatialDilatedConvolution(3, 5, 3, 3, 1, 1, 2, 2,
+                                       dilation_w=2, dilation_h=2)
+    p = mod.init(rng)
+    x = R.randn(2, 3, 10, 10).astype(np.float32)
+    ours = nchw(np.asarray(mod.forward(p, jnp.asarray(nhwc(x)))))
+    theirs = F.conv2d(torch.from_numpy(x), torch_weight(p),
+                      torch.from_numpy(np.asarray(p["bias"])),
+                      padding=2, dilation=2).numpy()
+    np.testing.assert_allclose(ours, theirs, atol=1e-4)
+
+
+@pytest.mark.parametrize("stride,pad,adj", [(2, 1, 0), (2, 1, 1), (1, 0, 0)])
+def test_full_convolution_vs_torch(rng, stride, pad, adj):
+    cin, cout, k = 3, 5, 3
+    mod = nn.SpatialFullConvolution(cin, cout, k, k, stride, stride,
+                                    pad, pad, adj, adj)
+    p = mod.init(rng)
+    x = R.randn(2, cin, 6, 6).astype(np.float32)
+    ours = nchw(np.asarray(mod.forward(p, jnp.asarray(nhwc(x)))))
+    # our HWIO weight (kh,kw,cin,cout) -> torch transposed-conv IOHW
+    # with spatially *unflipped* kernel: conv_transpose2d's kernel is applied
+    # flipped relative to the gradient formulation, matching our flip.
+    w = torch.from_numpy(np.ascontiguousarray(
+        np.transpose(np.asarray(p["weight"]), (2, 3, 0, 1))))
+    theirs = F.conv_transpose2d(
+        torch.from_numpy(x), w, torch.from_numpy(np.asarray(p["bias"])),
+        stride=stride, padding=pad, output_padding=adj).numpy()
+    np.testing.assert_allclose(ours, theirs, atol=1e-4)
+
+
+def test_convolution_map_depthwise(rng):
+    table = nn.SpatialConvolutionMap.one_to_one(3)
+    mod = nn.SpatialConvolutionMap(table, 3, 3, pad_w=1, pad_h=1)
+    p = mod.init(rng)
+    x = R.randn(2, 3, 6, 6).astype(np.float32)
+    ours = nchw(np.asarray(mod.forward(p, jnp.asarray(nhwc(x)))))
+    # depthwise equivalent in torch: groups=3 conv with masked weights
+    w_full = np.transpose(np.asarray(p["weight"]), (3, 2, 0, 1))  # OIHW
+    w_dw = np.stack([w_full[i, i] for i in range(3)])[:, None]  # (3,1,3,3)
+    theirs = F.conv2d(torch.from_numpy(x), torch.from_numpy(w_dw),
+                      torch.from_numpy(np.asarray(p["bias"])),
+                      padding=1, groups=3).numpy()
+    np.testing.assert_allclose(ours, theirs, atol=1e-4)
+
+
+def test_temporal_convolution(rng):
+    mod = nn.TemporalConvolution(6, 4, 3, pad_w=1)
+    p = mod.init(rng)
+    x = R.randn(2, 10, 6).astype(np.float32)
+    ours = np.asarray(mod.forward(p, jnp.asarray(x)))
+    w = torch.from_numpy(np.ascontiguousarray(
+        np.transpose(np.asarray(p["weight"]), (2, 1, 0))))  # (out,in,k)
+    theirs = F.conv1d(torch.from_numpy(x.transpose(0, 2, 1)), w,
+                      torch.from_numpy(np.asarray(p["bias"])),
+                      padding=1).numpy().transpose(0, 2, 1)
+    np.testing.assert_allclose(ours, theirs, atol=1e-4)
+
+
+@pytest.mark.parametrize("k,s,pad,ceil", [
+    (2, 2, 0, False), (3, 2, 1, False), (3, 2, 1, True), (3, 1, 0, False),
+])
+def test_max_pooling_vs_torch(k, s, pad, ceil):
+    x = R.randn(2, 3, 7, 7).astype(np.float32)
+    mod = nn.SpatialMaxPooling(k, k, s, s, pad, pad, ceil_mode=ceil)
+    ours = nchw(np.asarray(mod.forward({}, jnp.asarray(nhwc(x)))))
+    theirs = F.max_pool2d(torch.from_numpy(x), k, s, pad,
+                          ceil_mode=ceil).numpy()
+    np.testing.assert_allclose(ours, theirs, atol=1e-6)
+
+
+@pytest.mark.parametrize("k,s,pad,ceil", [
+    (2, 2, 0, False), (3, 2, 1, False), (3, 2, 1, True),
+])
+def test_avg_pooling_vs_torch(k, s, pad, ceil):
+    x = R.randn(2, 3, 7, 7).astype(np.float32)
+    mod = nn.SpatialAveragePooling(k, k, s, s, pad, pad, ceil_mode=ceil)
+    ours = nchw(np.asarray(mod.forward({}, jnp.asarray(nhwc(x)))))
+    theirs = F.avg_pool2d(torch.from_numpy(x), k, s, pad,
+                          ceil_mode=ceil).numpy()
+    np.testing.assert_allclose(ours, theirs, atol=1e-6)
+
+
+def test_conv_gradcheck(rng):
+    mod = nn.SpatialConvolution(2, 3, 3, 3, pad_w=1, pad_h=1)
+    p = mod.init(rng)
+    x = jnp.asarray(R.randn(2, 5, 5, 2).astype(np.float32))
+
+    def loss(params):
+        return jnp.sum(jnp.square(mod.forward(params, x)))
+
+    check_gradients(loss, p)

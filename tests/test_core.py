@@ -1,0 +1,87 @@
+"""Core module-system tests (analog of the reference's structural specs,
+e.g. nn/SequentialSpec / ContainerSpec)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bigdl_tpu import nn
+from bigdl_tpu.core import (
+    Sequential, Identity, Lambda, flatten_params, tree_size,
+)
+
+
+def test_sequential_chain(rng):
+    model = Sequential(
+        nn.Linear(4, 8),
+        nn.ReLU(),
+        nn.Linear(8, 2),
+    )
+    params = model.init(rng)
+    state = model.init_state()
+    x = jnp.ones((3, 4))
+    y, new_state = model.apply(params, state, x)
+    assert y.shape == (3, 2)
+    assert set(params.keys()) == {"0", "1", "2"}
+    assert params["1"] == {}  # ReLU paramless
+    assert tree_size(params) == 4 * 8 + 8 + 8 * 2 + 2
+
+
+def test_sequential_add_builder(rng):
+    model = Sequential()
+    model.add(nn.Linear(4, 4)).add(nn.Tanh())
+    params = model.init(rng)
+    y = model.forward(params, jnp.zeros((2, 4)))
+    assert y.shape == (2, 4)
+
+
+def test_flatten_params_roundtrip(rng):
+    model = Sequential(nn.Linear(3, 5), nn.Linear(5, 2))
+    params = model.init(rng)
+    flat, unravel = flatten_params(params)
+    assert flat.shape == (3 * 5 + 5 + 5 * 2 + 2,)
+    rt = unravel(flat)
+    for k in params:
+        for pk in params[k]:
+            np.testing.assert_array_equal(params[k][pk], rt[k][pk])
+
+
+def test_identity_lambda(rng):
+    x = jnp.arange(6.0).reshape(2, 3)
+    np.testing.assert_array_equal(Identity().forward({}, x), x)
+    np.testing.assert_array_equal(
+        Lambda(lambda t: t * 2).forward({}, x), x * 2)
+
+
+def test_named_modules(rng):
+    model = Sequential(nn.Linear(2, 2), Sequential(nn.ReLU()))
+    names = [n for n, _ in model.named_modules()]
+    assert len(names) == 4  # root, linear, inner seq, relu
+
+
+def test_apply_is_jittable(rng):
+    model = Sequential(nn.Linear(4, 4), nn.Tanh())
+    params = model.init(rng)
+    state = model.init_state()
+
+    @jax.jit
+    def f(p, s, x):
+        return model.apply(p, s, x)
+
+    y, _ = f(params, state, jnp.ones((2, 4)))
+    assert y.shape == (2, 4)
+
+
+def test_grad_flows_through_sequential(rng):
+    model = Sequential(nn.Linear(4, 4), nn.Tanh(), nn.Linear(4, 1))
+    params = model.init(rng)
+    state = model.init_state()
+
+    def loss(p):
+        y, _ = model.apply(p, state, jnp.ones((2, 4)))
+        return jnp.sum(y)
+
+    g = jax.grad(loss)(params)
+    assert any(float(jnp.abs(x).sum()) > 0
+               for x in jax.tree_util.tree_leaves(g))

@@ -1,0 +1,178 @@
+"""Criterion zoo vs torch oracle (reference: torch/*CriterionSpec.scala)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import torch
+import torch.nn.functional as F
+
+from bigdl_tpu import nn
+
+R = np.random.RandomState(3)
+B, C = 6, 5
+LOGITS = R.randn(B, C).astype(np.float32)
+LABELS = R.randint(0, C, size=(B,))
+
+
+def test_class_nll():
+    logp = F.log_softmax(torch.from_numpy(LOGITS), -1)
+    ours = nn.ClassNLLCriterion()(jnp.asarray(logp.numpy()),
+                                  jnp.asarray(LABELS))
+    theirs = F.nll_loss(logp, torch.from_numpy(LABELS))
+    np.testing.assert_allclose(float(ours), float(theirs), rtol=1e-5)
+
+
+def test_class_nll_weighted():
+    w = np.abs(R.randn(C)).astype(np.float32) + 0.1
+    logp = F.log_softmax(torch.from_numpy(LOGITS), -1)
+    ours = nn.ClassNLLCriterion(weights=jnp.asarray(w))(
+        jnp.asarray(logp.numpy()), jnp.asarray(LABELS))
+    theirs = F.nll_loss(logp, torch.from_numpy(LABELS),
+                        weight=torch.from_numpy(w))
+    np.testing.assert_allclose(float(ours), float(theirs), rtol=1e-5)
+
+
+def test_cross_entropy():
+    ours = nn.CrossEntropyCriterion()(jnp.asarray(LOGITS), jnp.asarray(LABELS))
+    theirs = F.cross_entropy(torch.from_numpy(LOGITS),
+                             torch.from_numpy(LABELS))
+    np.testing.assert_allclose(float(ours), float(theirs), rtol=1e-5)
+
+
+def test_mse_abs_smoothl1():
+    a = R.randn(4, 3).astype(np.float32)
+    b = R.randn(4, 3).astype(np.float32)
+    ta, tb = torch.from_numpy(a), torch.from_numpy(b)
+    ja, jb = jnp.asarray(a), jnp.asarray(b)
+    np.testing.assert_allclose(float(nn.MSECriterion()(ja, jb)),
+                               float(F.mse_loss(ta, tb)), rtol=1e-5)
+    np.testing.assert_allclose(float(nn.AbsCriterion()(ja, jb)),
+                               float(F.l1_loss(ta, tb)), rtol=1e-5)
+    np.testing.assert_allclose(float(nn.SmoothL1Criterion()(ja, jb)),
+                               float(F.smooth_l1_loss(ta, tb)), rtol=1e-5)
+
+
+def test_bce():
+    p = np.clip(R.rand(4, 3).astype(np.float32), 0.01, 0.99)
+    t = (R.rand(4, 3) > 0.5).astype(np.float32)
+    ours = nn.BCECriterion()(jnp.asarray(p), jnp.asarray(t))
+    theirs = F.binary_cross_entropy(torch.from_numpy(p), torch.from_numpy(t))
+    np.testing.assert_allclose(float(ours), float(theirs), rtol=1e-4)
+
+
+def test_kldiv():
+    logp = F.log_softmax(torch.from_numpy(LOGITS), -1)
+    t = F.softmax(torch.from_numpy(R.randn(B, C).astype(np.float32)), -1)
+    ours = nn.DistKLDivCriterion()(jnp.asarray(logp.numpy()),
+                                   jnp.asarray(t.numpy()))
+    # reference (DistKLDivCriterion.scala) divides by element count = "mean"
+    theirs = F.kl_div(logp, t, reduction="mean")
+    np.testing.assert_allclose(float(ours), float(theirs), rtol=1e-4)
+
+
+def test_margin_criterion():
+    x = R.randn(8).astype(np.float32)
+    y = np.sign(R.randn(8)).astype(np.float32)
+    ours = nn.MarginCriterion()(jnp.asarray(x), jnp.asarray(y))
+    exp = np.maximum(0, 1 - y * x).mean()
+    np.testing.assert_allclose(float(ours), exp, rtol=1e-5)
+
+
+def test_soft_margin():
+    x = R.randn(8).astype(np.float32)
+    y = np.sign(R.randn(8)).astype(np.float32)
+    ours = nn.SoftMarginCriterion()(jnp.asarray(x), jnp.asarray(y))
+    theirs = F.soft_margin_loss(torch.from_numpy(x), torch.from_numpy(y))
+    np.testing.assert_allclose(float(ours), float(theirs), rtol=1e-5)
+
+
+def test_hinge_embedding():
+    x = np.abs(R.randn(8)).astype(np.float32)
+    y = np.sign(R.randn(8)).astype(np.float32)
+    ours = nn.HingeEmbeddingCriterion()(jnp.asarray(x), jnp.asarray(y))
+    theirs = F.hinge_embedding_loss(torch.from_numpy(x),
+                                    torch.from_numpy(y))
+    np.testing.assert_allclose(float(ours), float(theirs), rtol=1e-5)
+
+
+def test_margin_ranking():
+    x1 = R.randn(8).astype(np.float32)
+    x2 = R.randn(8).astype(np.float32)
+    y = np.sign(R.randn(8)).astype(np.float32)
+    ours = nn.MarginRankingCriterion(margin=0.5)(
+        (jnp.asarray(x1), jnp.asarray(x2)), jnp.asarray(y))
+    theirs = F.margin_ranking_loss(torch.from_numpy(x1), torch.from_numpy(x2),
+                                   torch.from_numpy(y), margin=0.5)
+    np.testing.assert_allclose(float(ours), float(theirs), rtol=1e-5)
+
+
+def test_cosine_embedding():
+    x1 = R.randn(6, 4).astype(np.float32)
+    x2 = R.randn(6, 4).astype(np.float32)
+    y = np.sign(R.randn(6)).astype(np.float32)
+    ours = nn.CosineEmbeddingCriterion(margin=0.2)(
+        (jnp.asarray(x1), jnp.asarray(x2)), jnp.asarray(y))
+    theirs = F.cosine_embedding_loss(
+        torch.from_numpy(x1), torch.from_numpy(x2), torch.from_numpy(y),
+        margin=0.2)
+    np.testing.assert_allclose(float(ours), float(theirs), rtol=1e-4)
+
+
+def test_multi_margin():
+    ours = nn.MultiMarginCriterion()(jnp.asarray(LOGITS), jnp.asarray(LABELS))
+    theirs = F.multi_margin_loss(torch.from_numpy(LOGITS),
+                                 torch.from_numpy(LABELS))
+    np.testing.assert_allclose(float(ours), float(theirs), rtol=1e-5)
+
+
+def test_multilabel_soft_margin():
+    t = (R.rand(B, C) > 0.5).astype(np.float32)
+    ours = nn.MultiLabelSoftMarginCriterion()(jnp.asarray(LOGITS),
+                                              jnp.asarray(t))
+    theirs = F.multilabel_soft_margin_loss(torch.from_numpy(LOGITS),
+                                           torch.from_numpy(t))
+    np.testing.assert_allclose(float(ours), float(theirs), rtol=1e-4)
+
+
+def test_multilabel_margin():
+    # one sample, labels {0, 2}, padded with -1 (torch uses -1 padding too)
+    x = np.asarray([[0.1, 0.2, 0.4, 0.8]], np.float32)
+    t = np.asarray([[0, 2, -1, -1]], np.int64)
+    ours = nn.MultiLabelMarginCriterion()(jnp.asarray(x), jnp.asarray(t))
+    theirs = F.multilabel_margin_loss(torch.from_numpy(x),
+                                      torch.from_numpy(t))
+    np.testing.assert_allclose(float(ours), float(theirs), rtol=1e-5)
+
+
+def test_parallel_and_multi_criterion():
+    a = jnp.asarray(R.randn(4, 3).astype(np.float32))
+    b = jnp.asarray(R.randn(4, 3).astype(np.float32))
+    mse = nn.MSECriterion()
+    multi = nn.MultiCriterion().add(mse, 0.5).add(nn.AbsCriterion(), 2.0)
+    exp = 0.5 * float(mse(a, b)) + 2.0 * float(nn.AbsCriterion()(a, b))
+    np.testing.assert_allclose(float(multi(a, b)), exp, rtol=1e-6)
+
+    par = nn.ParallelCriterion().add(mse).add(nn.AbsCriterion())
+    got = float(par((a, a), (b, b)))
+    exp = float(mse(a, b)) + float(nn.AbsCriterion()(a, b))
+    np.testing.assert_allclose(got, exp, rtol=1e-6)
+
+
+def test_l1_cost_penalty():
+    x = jnp.asarray(R.randn(5).astype(np.float32))
+    np.testing.assert_allclose(float(nn.L1Cost()(x, None)),
+                               float(jnp.sum(jnp.abs(x))), rtol=1e-6)
+    np.testing.assert_allclose(float(nn.L1Penalty(0.3)(x)),
+                               0.3 * float(jnp.sum(jnp.abs(x))), rtol=1e-6)
+
+
+def test_grad_through_criterion():
+    x = jnp.asarray(LOGITS)
+
+    def loss(z):
+        return nn.CrossEntropyCriterion()(z, jnp.asarray(LABELS))
+
+    g = np.asarray(jax.grad(loss)(x))
+    tx = torch.from_numpy(LOGITS).requires_grad_(True)
+    F.cross_entropy(tx, torch.from_numpy(LABELS)).backward()
+    np.testing.assert_allclose(g, tx.grad.numpy(), atol=1e-5)

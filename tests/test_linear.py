@@ -1,0 +1,141 @@
+"""Linear-family layers vs torch oracle + gradient checks."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import torch
+import torch.nn.functional as F
+
+from bigdl_tpu import nn
+from bigdl_tpu.utils import check_gradients
+
+R = np.random.RandomState(7)
+
+
+def test_linear_matches_torch(rng):
+    mod = nn.Linear(5, 3)
+    p = mod.init(rng)
+    x = R.randn(4, 5).astype(np.float32)
+    ours = np.asarray(mod.forward(p, jnp.asarray(x)))
+    theirs = F.linear(torch.from_numpy(x),
+                      torch.from_numpy(np.asarray(p["weight"]).T),
+                      torch.from_numpy(np.asarray(p["bias"]))).numpy()
+    np.testing.assert_allclose(ours, theirs, atol=1e-5)
+
+
+def test_linear_init_scale(rng):
+    mod = nn.Linear(100, 50)
+    p = mod.init(rng)
+    stdv = 1 / np.sqrt(100)
+    w = np.asarray(p["weight"])
+    assert w.min() >= -stdv and w.max() <= stdv
+    assert w.std() > stdv / 3  # actually spread out
+
+
+def test_linear_gradcheck(rng):
+    mod = nn.Linear(4, 3)
+    p = mod.init(rng)
+    x = jnp.asarray(R.randn(2, 4).astype(np.float32))
+
+    def loss(params):
+        return jnp.sum(jnp.square(mod.forward(params, x)))
+
+    check_gradients(loss, p)
+
+
+def test_bilinear(rng):
+    mod = nn.Bilinear(3, 4, 2)
+    p = mod.init(rng)
+    x1 = R.randn(5, 3).astype(np.float32)
+    x2 = R.randn(5, 4).astype(np.float32)
+    ours = np.asarray(mod.forward(p, (jnp.asarray(x1), jnp.asarray(x2))))
+    tb = torch.nn.Bilinear(3, 4, 2)
+    with torch.no_grad():
+        tb.weight.copy_(torch.from_numpy(np.asarray(p["weight"])))
+        tb.bias.copy_(torch.from_numpy(np.asarray(p["bias"])))
+    theirs = tb(torch.from_numpy(x1), torch.from_numpy(x2)).detach().numpy()
+    np.testing.assert_allclose(ours, theirs, atol=1e-5)
+
+
+def test_cmul_cadd_mul_add(rng):
+    x = jnp.asarray(R.randn(3, 4).astype(np.float32))
+    cm = nn.CMul((4,))
+    p = cm.init(rng)
+    np.testing.assert_allclose(np.asarray(cm.forward(p, x)),
+                               np.asarray(x) * np.asarray(p["weight"]),
+                               rtol=1e-6)
+    ca = nn.CAdd((4,))
+    p = ca.init(rng)
+    np.testing.assert_allclose(np.asarray(ca.forward(p, x)),
+                               np.asarray(x) + np.asarray(p["bias"]),
+                               rtol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(nn.MulConstant(2.5).forward({}, x)), np.asarray(x) * 2.5)
+    np.testing.assert_allclose(
+        np.asarray(nn.AddConstant(1.5).forward({}, x)), np.asarray(x) + 1.5)
+
+
+def test_mm_mv():
+    a = jnp.asarray(R.randn(2, 3, 4).astype(np.float32))
+    b = jnp.asarray(R.randn(2, 4, 5).astype(np.float32))
+    out = nn.MM().forward({}, (a, b))
+    np.testing.assert_allclose(np.asarray(out),
+                               np.asarray(a) @ np.asarray(b), atol=1e-5)
+    out_t = nn.MM(trans_a=True).forward({}, (jnp.swapaxes(a, 1, 2), b))
+    np.testing.assert_allclose(np.asarray(out_t),
+                               np.asarray(a) @ np.asarray(b), atol=1e-5)
+    v = jnp.asarray(R.randn(2, 4).astype(np.float32))
+    mv = nn.MV().forward({}, (a, v))
+    np.testing.assert_allclose(
+        np.asarray(mv), np.einsum("bij,bj->bi", np.asarray(a), np.asarray(v)),
+        atol=1e-5)
+
+
+def test_distance_layers():
+    a = R.randn(6, 5).astype(np.float32)
+    b = R.randn(6, 5).astype(np.float32)
+    ja, jb = jnp.asarray(a), jnp.asarray(b)
+    np.testing.assert_allclose(
+        np.asarray(nn.DotProduct().forward({}, (ja, jb))),
+        (a * b).sum(-1), atol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(nn.CosineDistance().forward({}, (ja, jb))),
+        F.cosine_similarity(torch.from_numpy(a), torch.from_numpy(b)).numpy(),
+        atol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(nn.PairwiseDistance(2).forward({}, (ja, jb))),
+        F.pairwise_distance(torch.from_numpy(a), torch.from_numpy(b),
+                            p=2).numpy(),
+        atol=1e-4)
+
+
+def test_lookup_table(rng):
+    mod = nn.LookupTable(10, 4)
+    p = mod.init(rng)
+    idx = jnp.asarray([[0, 3], [9, 1]])
+    out = mod.forward(p, idx)
+    assert out.shape == (2, 2, 4)
+    np.testing.assert_allclose(np.asarray(out[0, 1]),
+                               np.asarray(p["weight"])[3], rtol=1e-6)
+
+
+def test_lookup_table_max_norm(rng):
+    mod = nn.LookupTable(10, 4, max_norm=1.0)
+    p = {"weight": jnp.ones((10, 4)) * 5}
+    out = mod.forward(p, jnp.asarray([0]))
+    assert abs(float(jnp.linalg.norm(out[0])) - 1.0) < 1e-5
+
+
+def test_cosine_euclidean(rng):
+    x = jnp.asarray(R.randn(3, 5).astype(np.float32))
+    cos = nn.Cosine(5, 4)
+    p = cos.init(rng)
+    out = np.asarray(cos.forward(p, x))
+    assert out.shape == (3, 4)
+    assert np.abs(out).max() <= 1.0 + 1e-5
+    euc = nn.Euclidean(5, 4)
+    p = euc.init(rng)
+    out = np.asarray(euc.forward(p, x))
+    w = np.asarray(p["weight"])
+    exp = np.linalg.norm(np.asarray(x)[:, None, :] - w[None], axis=-1)
+    np.testing.assert_allclose(out, exp, atol=1e-4)

@@ -1,0 +1,109 @@
+"""Normalization layers vs torch oracle."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import torch
+import torch.nn.functional as F
+
+from bigdl_tpu import nn
+
+R = np.random.RandomState(5)
+
+
+def nhwc(x):
+    return np.ascontiguousarray(np.transpose(x, (0, 2, 3, 1)))
+
+
+def nchw(x):
+    return np.ascontiguousarray(np.transpose(x, (0, 3, 1, 2)))
+
+
+def test_batchnorm_train_matches_torch(rng):
+    mod = nn.BatchNormalization(4)
+    p, s = mod.init(rng), mod.init_state()
+    x = R.randn(8, 4).astype(np.float32) * 2 + 1
+    y, s_new = mod.apply(p, s, jnp.asarray(x), training=True)
+
+    tb = torch.nn.BatchNorm1d(4, momentum=0.1)
+    theirs = tb(torch.from_numpy(x)).detach().numpy()
+    np.testing.assert_allclose(np.asarray(y), theirs, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(s_new["running_mean"]),
+                               tb.running_mean.numpy(), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(s_new["running_var"]),
+                               tb.running_var.numpy(), atol=1e-4)
+
+
+def test_batchnorm_eval_uses_running_stats(rng):
+    mod = nn.BatchNormalization(4)
+    p = mod.init(rng)
+    s = {"running_mean": jnp.asarray([1.0, 2.0, 3.0, 4.0]),
+         "running_var": jnp.asarray([1.0, 4.0, 9.0, 16.0])}
+    x = np.zeros((2, 4), np.float32)
+    y, _ = mod.apply(p, s, jnp.asarray(x), training=False)
+    exp = (0 - np.asarray([1, 2, 3, 4])) / np.sqrt(
+        np.asarray([1, 4, 9, 16]) + 1e-5)
+    np.testing.assert_allclose(np.asarray(y), np.tile(exp, (2, 1)),
+                               atol=1e-5)
+
+
+def test_spatial_batchnorm_vs_torch(rng):
+    mod = nn.SpatialBatchNormalization(3)
+    p, s = mod.init(rng), mod.init_state()
+    x = R.randn(4, 3, 5, 5).astype(np.float32)
+    y, _ = mod.apply(p, s, jnp.asarray(nhwc(x)), training=True)
+    tb = torch.nn.BatchNorm2d(3)
+    theirs = tb(torch.from_numpy(x)).detach().numpy()
+    np.testing.assert_allclose(nchw(np.asarray(y)), theirs, atol=1e-4)
+
+
+def test_lrn_vs_torch():
+    mod = nn.SpatialCrossMapLRN(size=5, alpha=1e-4, beta=0.75, k=1.0)
+    x = R.randn(2, 7, 4, 4).astype(np.float32)
+    ours = nchw(np.asarray(mod.forward({}, jnp.asarray(nhwc(x)))))
+    theirs = F.local_response_norm(torch.from_numpy(x), 5, alpha=1e-4,
+                                   beta=0.75, k=1.0).numpy()
+    np.testing.assert_allclose(ours, theirs, atol=1e-5)
+
+
+def test_normalize_l2():
+    x = R.randn(3, 6).astype(np.float32)
+    ours = np.asarray(nn.Normalize(2).forward({}, jnp.asarray(x)))
+    theirs = F.normalize(torch.from_numpy(x), p=2, dim=-1).numpy()
+    np.testing.assert_allclose(ours, theirs, atol=1e-5)
+
+
+def test_subtractive_normalization_zero_mean():
+    # constant image -> exactly zero output everywhere (mean == value)
+    x = np.full((1, 12, 12, 1), 3.0, np.float32)
+    mod = nn.SpatialSubtractiveNormalization(1)
+    out = np.asarray(mod.forward({}, jnp.asarray(x)))
+    np.testing.assert_allclose(out, np.zeros_like(out), atol=1e-4)
+
+
+def test_divisive_normalization_scale_invariance():
+    x = R.randn(1, 12, 12, 1).astype(np.float32)
+    mod = nn.SpatialDivisiveNormalization(1)
+    y1 = np.asarray(mod.forward({}, jnp.asarray(x)))
+    y2 = np.asarray(mod.forward({}, jnp.asarray(x * 10)))
+    np.testing.assert_allclose(y1, y2, atol=1e-3)
+
+
+def test_contrastive_composes():
+    x = R.randn(1, 10, 10, 1).astype(np.float32)
+    mod = nn.SpatialContrastiveNormalization(1)
+    out = np.asarray(mod.forward({}, jnp.asarray(x)))
+    assert out.shape == x.shape and np.isfinite(out).all()
+
+
+def test_batchnorm_grad_flows(rng):
+    mod = nn.SpatialBatchNormalization(3)
+    p, s = mod.init(rng), mod.init_state()
+    x = jnp.asarray(R.randn(4, 5, 5, 3).astype(np.float32))
+
+    def loss(params):
+        y, _ = mod.apply(params, s, x, training=True)
+        return jnp.sum(jnp.square(y))
+
+    g = jax.grad(loss)(p)
+    assert float(jnp.abs(g["weight"]).sum()) > 0

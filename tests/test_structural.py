@@ -1,0 +1,156 @@
+"""Structural/table layers."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bigdl_tpu import nn
+from bigdl_tpu.core import Sequential
+
+R = np.random.RandomState(2)
+X = jnp.asarray(R.randn(2, 4).astype(np.float32))
+
+
+def test_concat(rng):
+    m = nn.Concat(nn.Linear(4, 3), nn.Linear(4, 5), axis=-1)
+    p = m.init(rng)
+    y = m.forward(p, X)
+    assert y.shape == (2, 8)
+
+
+def test_concat_table_parallel_table(rng):
+    ct = nn.ConcatTable(nn.Identity(), nn.Identity())
+    y = ct.forward(ct.init(rng), X)
+    assert isinstance(y, tuple) and len(y) == 2
+
+    pt = nn.ParallelTable(nn.Linear(4, 2), nn.Linear(4, 3))
+    p = pt.init(rng)
+    y = pt.forward(p, (X, X))
+    assert y[0].shape == (2, 2) and y[1].shape == (2, 3)
+
+
+def test_map_table_shares_params(rng):
+    mt = nn.MapTable(nn.Linear(4, 3))
+    p = mt.init(rng)
+    y = mt.forward(p, (X, X))
+    np.testing.assert_allclose(np.asarray(y[0]), np.asarray(y[1]))
+
+
+def test_join_flatten_narrow_table(rng):
+    t = (X, X + 1)
+    joined = nn.JoinTable(axis=-1).forward({}, t)
+    assert joined.shape == (2, 8)
+    nested = (X, (X + 1, X + 2))
+    flat = nn.FlattenTable().forward({}, nested)
+    assert len(flat) == 3
+    nt = nn.NarrowTable(1, 1).forward({}, (X, X + 1, X + 2))
+    np.testing.assert_allclose(np.asarray(nt[0]), np.asarray(X) + 1)
+
+
+def test_mixture_table():
+    gates = jnp.asarray([[0.3, 0.7], [1.0, 0.0]])
+    e1 = jnp.ones((2, 3))
+    e2 = jnp.ones((2, 3)) * 2
+    out = nn.MixtureTable().forward({}, (gates, (e1, e2)))
+    np.testing.assert_allclose(np.asarray(out[0]), [1.7, 1.7, 1.7],
+                               rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(out[1]), [1.0, 1.0, 1.0],
+                               rtol=1e-6)
+
+
+def test_shape_ops():
+    x = jnp.arange(24.0).reshape(2, 3, 4)
+    assert nn.Reshape([12]).forward({}, x).shape == (2, 12)
+    assert nn.View([4, 3]).forward({}, x).shape == (2, 4, 3)
+    assert nn.Transpose((1, 2)).forward({}, x).shape == (2, 4, 3)
+    assert nn.Squeeze().forward({}, x[:, :1, :1]).shape == (2,)
+    assert nn.Unsqueeze(1).forward({}, x).shape == (2, 1, 3, 4)
+    assert nn.Select(1, 0).forward({}, x).shape == (2, 4)
+    assert nn.Narrow(2, 1, 2).forward({}, x).shape == (2, 3, 2)
+    assert nn.Replicate(5, 1).forward({}, x).shape == (2, 5, 3, 4)
+
+
+def test_padding_ops():
+    x = jnp.ones((1, 2, 2, 1))
+    y = nn.SpatialZeroPadding(1, 1, 2, 2).forward({}, x)
+    assert y.shape == (1, 6, 4, 1)
+    assert float(y[0, 0, 0, 0]) == 0.0
+    y2 = nn.Padding(1, -2, value=9.0).forward({}, jnp.ones((1, 2)))
+    assert y2.shape == (1, 4) and float(y2[0, 0]) == 9.0
+    y3 = nn.Padding(1, 2).forward({}, jnp.ones((1, 2)))
+    assert y3.shape == (1, 4) and float(y3[0, -1]) == 0.0
+
+
+def test_select_index_masked():
+    t = (X, X * 2)
+    np.testing.assert_allclose(
+        np.asarray(nn.SelectTable(1).forward({}, t)), np.asarray(X) * 2)
+    src = jnp.asarray([[1.0, 2.0], [3.0, 4.0]])
+    idx = jnp.asarray([1, 0])
+    out = nn.Index(0).forward({}, (src, idx))
+    np.testing.assert_allclose(np.asarray(out), [[3, 4], [1, 2]])
+    mask = jnp.asarray([[True, False], [False, True]])
+    out = nn.MaskedSelect().forward({}, (src, mask))
+    np.testing.assert_allclose(np.asarray(out), [1.0, 4.0])
+    out = nn.MaskedFill(-1.0).forward({}, (src, mask))
+    np.testing.assert_allclose(np.asarray(out), [[1, -1], [-1, 4]])
+
+
+def test_reductions():
+    x = jnp.asarray(R.randn(3, 5).astype(np.float32))
+    np.testing.assert_allclose(np.asarray(nn.Max(1).forward({}, x)),
+                               np.asarray(x).max(1), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(nn.Min(1).forward({}, x)),
+                               np.asarray(x).min(1), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(nn.Mean(0).forward({}, x)),
+                               np.asarray(x).mean(0), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(nn.Sum(1).forward({}, x)),
+                               np.asarray(x).sum(1), rtol=1e-5)
+
+
+def test_ctable_ops():
+    a = jnp.asarray([[2.0, 4.0]])
+    b = jnp.asarray([[1.0, 2.0]])
+    np.testing.assert_allclose(np.asarray(nn.CAddTable().forward({}, (a, b))),
+                               [[3, 6]])
+    np.testing.assert_allclose(np.asarray(nn.CSubTable().forward({}, (a, b))),
+                               [[1, 2]])
+    np.testing.assert_allclose(np.asarray(nn.CMulTable().forward({}, (a, b))),
+                               [[2, 8]])
+    np.testing.assert_allclose(np.asarray(nn.CDivTable().forward({}, (a, b))),
+                               [[2, 2]])
+    np.testing.assert_allclose(np.asarray(nn.CMaxTable().forward({}, (a, b))),
+                               [[2, 4]])
+    np.testing.assert_allclose(np.asarray(nn.CMinTable().forward({}, (a, b))),
+                               [[1, 2]])
+
+
+def test_dropout(rng):
+    x = jnp.ones((1000,))
+    d = nn.Dropout(0.5)
+    # eval: identity
+    np.testing.assert_allclose(np.asarray(d.forward({}, x)), 1.0)
+    # train: inverted scaling keeps expectation ~1
+    y = np.asarray(d.forward({}, x, training=True, rng=rng))
+    assert abs(y.mean() - 1.0) < 0.1
+    assert set(np.unique(y)).issubset({0.0, 2.0})
+
+
+def test_bottle(rng):
+    m = nn.Bottle(nn.Linear(4, 3), n_input_dims=2)
+    x = jnp.asarray(R.randn(2, 5, 4).astype(np.float32))
+    p = m.init(rng)
+    y = m.forward(p, x)
+    assert y.shape == (2, 5, 3)
+
+
+def test_residual_block_pattern(rng):
+    """ConcatTable + CAddTable = the ResNet shortcut idiom."""
+    block = Sequential(
+        nn.ConcatTable(nn.Linear(4, 4), nn.Identity()),
+        nn.CAddTable(),
+    )
+    p = block.init(rng)
+    y = block.forward(p, X)
+    assert y.shape == (2, 4)

@@ -1,0 +1,110 @@
+"""End-to-end training slice (reference optim/DistriOptimizerSpec trains tiny
+MLPs to convergence; models/lenet is BASELINE config 1)."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bigdl_tpu import nn
+from bigdl_tpu.core import Sequential
+from bigdl_tpu.dataset import BatchDataSet
+from bigdl_tpu.models.lenet import lenet5
+from bigdl_tpu.optim import (
+    Optimizer, SGD, Trigger, Top1Accuracy, Loss, Validator,
+)
+from bigdl_tpu.utils.file import save_pytree, load_pytree, latest_checkpoint
+
+
+def _xor_data(n=256):
+    rng = np.random.RandomState(0)
+    x = rng.rand(n, 2).astype(np.float32)
+    y = ((x[:, 0] > 0.5) ^ (x[:, 1] > 0.5)).astype(np.int32)
+    # map into the two blobs pattern the reference spec uses
+    return x * 2 - 1, y
+
+
+def test_mlp_converges_on_xor():
+    x, y = _xor_data()
+    ds = BatchDataSet(x, y, batch_size=32, shuffle=True)
+    model = Sequential(
+        nn.Linear(2, 16), nn.Tanh(), nn.Linear(16, 2), nn.LogSoftMax())
+    opt = Optimizer(model, ds, nn.ClassNLLCriterion(),
+                    optim_method=SGD(learning_rate=0.5, momentum=0.9),
+                    end_when=Trigger.max_epoch(60))
+    trained = opt.optimize()
+    val = Validator(model, BatchDataSet(x, y, batch_size=64))
+    (res,) = val.test(trained.params, trained.mod_state, [Top1Accuracy()])
+    acc, _ = res.result()
+    assert acc > 0.95, f"XOR accuracy {acc}"
+
+
+def test_lenet_learns_synthetic_mnist(tmp_path):
+    """LeNet-5 separates two synthetic digit-like classes quickly."""
+    rng = np.random.RandomState(1)
+    n = 256
+    y = rng.randint(0, 2, n).astype(np.int32)
+    x = rng.randn(n, 28, 28, 1).astype(np.float32) * 0.1
+    # class 0: bright top-left block; class 1: bright bottom-right block
+    x[y == 0, 4:12, 4:12] += 1.0
+    x[y == 1, 16:24, 16:24] += 1.0
+
+    ds = BatchDataSet(x, y, batch_size=32, shuffle=True)
+    model = lenet5(10)
+    opt = Optimizer(model, ds, nn.ClassNLLCriterion(),
+                    optim_method=SGD(learning_rate=0.1, momentum=0.9),
+                    end_when=Trigger.max_epoch(4))
+    ckpt = str(tmp_path / "ckpt")
+    opt.set_checkpoint(Trigger.every_epoch(), ckpt)
+    opt.set_validation(Trigger.every_epoch(), BatchDataSet(x, y, 64),
+                       [Top1Accuracy(), Loss(nn.ClassNLLCriterion())])
+    trained = opt.optimize()
+
+    val = Validator(model, BatchDataSet(x, y, 64))
+    (res,) = val.test(trained.params, trained.mod_state, [Top1Accuracy()])
+    acc, _ = res.result()
+    assert acc > 0.9, f"LeNet synthetic accuracy {acc}"
+
+    # checkpoints exist and are loadable; resume path works
+    mp = latest_checkpoint(ckpt, "model.")
+    sp = latest_checkpoint(ckpt, "state.")
+    assert mp and sp
+    blob = load_pytree(mp)
+    assert "params" in blob and "mod_state" in blob
+    st = load_pytree(sp)
+    assert "step" in st
+
+    # resumed optimizer starts from the saved weights
+    opt2 = Optimizer(model, ds, nn.ClassNLLCriterion(),
+                     optim_method=SGD(learning_rate=0.1),
+                     end_when=Trigger.max_iteration(1))
+    opt2.resume(ckpt)
+    t2 = opt2.optimize()
+    assert t2.params is not None
+
+
+def test_pytree_io_roundtrip(tmp_path):
+    tree = {"a": jnp.arange(5.0), "b": {"c": jnp.ones((2, 3))},
+            "t": (jnp.zeros(2), jnp.ones(1))}
+    p = str(tmp_path / "x.npz")
+    save_pytree(tree, p)
+    back = load_pytree(p)
+    np.testing.assert_array_equal(np.asarray(tree["b"]["c"]), back["b"]["c"])
+    np.testing.assert_array_equal(np.asarray(tree["t"][1]), back["t"][1])
+
+
+def test_classnll_training_reduces_loss():
+    x, y = _xor_data(128)
+    ds = BatchDataSet(x, y, batch_size=128)
+    model = Sequential(nn.Linear(2, 8), nn.ReLU(), nn.Linear(8, 2),
+                       nn.LogSoftMax())
+    opt = Optimizer(model, ds, nn.ClassNLLCriterion(),
+                    optim_method=SGD(learning_rate=0.3),
+                    end_when=Trigger.max_iteration(50))
+    losses = []
+    orig = Optimizer._maybe_validate
+
+    trained = opt.optimize()
+    # loss recorded in driver state via metrics
+    assert opt.metrics.mean("computing time") > 0
