@@ -1,9 +1,15 @@
 """Benchmark entry point — prints ONE JSON line for the driver.
 
-Measures sync-SGD training throughput (fwd+bwd+update, the reference's
-"records/second" metric, DistriOptimizer.scala:241-244) on the flagship
-image model. BASELINE.json publishes no reference absolute numbers
-(`published: {}`), so vs_baseline is 0.0 until a reference number exists.
+Measures sync-SGD training throughput (fwd+bwd+update — the reference's
+"records/second" metric, DistriOptimizer.scala:241-244) on ResNet-50, the
+BASELINE.json north-star config ("ResNet-50 on ImageNet, sync-SGD",
+images/sec/chip). Runs in bf16 compute with fp32 params — the TPU-native
+replacement for the reference's truncated-fp16 gradient codec.
+
+BASELINE.json publishes no reference absolute number (`published: {}`), so
+vs_baseline is 0.0.
+
+Usage: python bench.py [model] [batch] — model in {resnet50, lenet}.
 """
 
 import json
@@ -13,18 +19,32 @@ import time
 import numpy as np
 
 
+def build(model_name: str):
+    from bigdl_tpu import nn
+    from bigdl_tpu import models
+
+    if model_name == "lenet":
+        return models.lenet5(10), (28, 28, 1), nn.ClassNLLCriterion()
+    if model_name == "resnet50":
+        return models.resnet50(1000), (224, 224, 3), nn.ClassNLLCriterion()
+    raise SystemExit(f"unknown model {model_name}")
+
+
 def main() -> None:
     import jax
     import jax.numpy as jnp
 
-    from bigdl_tpu import nn
-    from bigdl_tpu.models.lenet import lenet5
     from bigdl_tpu.optim import SGD
 
-    batch = 512
-    model = lenet5(10)
-    crit = nn.ClassNLLCriterion()
-    opt = SGD(learning_rate=0.05, momentum=0.9)
+    on_tpu = jax.default_backend() == "tpu"
+    model_name = sys.argv[1] if len(sys.argv) > 1 else "resnet50"
+    default_batch = 128 if on_tpu else 4
+    batch = int(sys.argv[2]) if len(sys.argv) > 2 else default_batch
+    iters = 20 if on_tpu else 3
+    compute_dtype = jnp.bfloat16 if on_tpu else jnp.float32
+
+    model, in_shape, crit = build(model_name)
+    opt = SGD(learning_rate=0.1, momentum=0.9, weight_decay=1e-4)
 
     rng = jax.random.PRNGKey(0)
     params = model.init(rng)
@@ -32,34 +52,37 @@ def main() -> None:
     opt_state = opt.init(params)
 
     x = jnp.asarray(np.random.RandomState(0)
-                    .randn(batch, 28, 28, 1).astype(np.float32))
-    y = jnp.asarray(np.random.RandomState(1).randint(0, 10, batch))
+                    .randn(batch, *in_shape).astype(np.float32)
+                    ).astype(compute_dtype)
+    y = jnp.asarray(np.random.RandomState(1).randint(
+        0, 1000 if model_name == "resnet50" else 10, batch))
 
     @jax.jit
-    def step(params, mod_state, opt_state, x, y):
+    def step(params, mod_state, opt_state, x, y, rng):
         def loss_fn(p):
-            out, ms = model.apply(p, mod_state, x, training=True)
-            return crit(out, y), ms
+            out, ms = model.apply(p, mod_state, x, training=True, rng=rng)
+            return crit(out.astype(jnp.float32), y), ms
 
         (loss, ms), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
         new_params, new_opt = opt.update(grads, opt_state, params)
         return new_params, ms, new_opt, loss
 
-    # warmup / compile
-    params, mod_state, opt_state, loss = step(params, mod_state, opt_state, x, y)
-    jax.block_until_ready(loss)
+    k = jax.random.PRNGKey(2)
+    params, mod_state, opt_state, loss = step(params, mod_state, opt_state,
+                                              x, y, k)
+    jax.block_until_ready(loss)  # compile + warmup
 
-    iters = 30
     t0 = time.perf_counter()
-    for _ in range(iters):
+    for i in range(iters):
         params, mod_state, opt_state, loss = step(params, mod_state,
-                                                  opt_state, x, y)
+                                                  opt_state, x, y, k)
     jax.block_until_ready(loss)
     dt = time.perf_counter() - t0
     ips = batch * iters / dt
 
     print(json.dumps({
-        "metric": "lenet5_mnist_train_throughput",
+        "metric": f"{model_name}_train_throughput_b{batch}"
+                  f"_{'bf16' if compute_dtype == jnp.bfloat16 else 'f32'}",
         "value": round(ips, 1),
         "unit": "images/sec/chip",
         "vs_baseline": 0.0,

@@ -4,4 +4,4 @@ from bigdl_tpu.dataset.transformer import (
 from bigdl_tpu.dataset.dataset import (
     DataSet, LocalArrayDataSet, BatchDataSet, MiniBatch,
 )
-from bigdl_tpu.dataset import mnist, image
+from bigdl_tpu.dataset import mnist, cifar, image, text

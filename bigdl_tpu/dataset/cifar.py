@@ -1,0 +1,46 @@
+"""CIFAR-10 binary reader (reference models/vgg & resnet pipelines load
+CIFAR via dataset/image BGR transformers; the on-disk format here is the
+standard cifar-10-binary 3073-byte records: 1 label + 3072 CHW pixels).
+
+Returns NHWC uint8 images (N, 32, 32, 3) in RGB order and int32 labels.
+The reference's per-channel training stats are exposed as TRAIN_MEAN/STD
+(models/vgg/Train uses 0.4-ish RGB means over [0,1] pixels).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Sequence
+
+import numpy as np
+
+__all__ = ["load_cifar10", "TRAIN_MEAN", "TRAIN_STD"]
+
+# RGB, over pixels scaled to [0,1]
+TRAIN_MEAN = (0.4914, 0.4822, 0.4465)
+TRAIN_STD = (0.2470, 0.2435, 0.2616)
+
+_REC = 3073
+
+
+def _read_bin(path: str):
+    raw = np.fromfile(path, np.uint8)
+    assert raw.size % _REC == 0, f"{path}: not a cifar-10 binary file"
+    raw = raw.reshape(-1, _REC)
+    labels = raw[:, 0].astype(np.int32)
+    imgs = raw[:, 1:].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+    return imgs, labels
+
+
+def load_cifar10(folder: str, train: bool = True):
+    names = ([f"data_batch_{i}.bin" for i in range(1, 6)] if train
+             else ["test_batch.bin"])
+    imgs, labels = [], []
+    for n in names:
+        p = os.path.join(folder, n)
+        if not os.path.exists(p):
+            raise FileNotFoundError(p)
+        i, l = _read_bin(p)
+        imgs.append(i)
+        labels.append(l)
+    return np.concatenate(imgs), np.concatenate(labels)

@@ -1,0 +1,82 @@
+"""Text pipeline (reference dataset/text/: LabeledSentence,
+LabeledSentenceToSample; models/rnn/Utils.scala WordTokenizer + dictionary).
+
+Provides tokenization, dictionary building with vocab-size cap (rare words
+-> UNK), fixed-length padding (the reference pads sentences to max length,
+dataset/text/LabeledSentenceToSample.scala), and one-hot/ids batch export.
+"""
+
+from __future__ import annotations
+
+import re
+from collections import Counter
+from typing import Iterable, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["tokenize", "Dictionary", "pad_sequences", "LabeledSentence",
+           "sentences_to_ids"]
+
+PAD, UNK = "<pad>", "<unk>"
+_WORD_RE = re.compile(r"[A-Za-z']+|[.,!?;]")
+
+
+def tokenize(text: str) -> list[str]:
+    """Simple word tokenizer (reference WordTokenizer in models/rnn/Utils)."""
+    return _WORD_RE.findall(text.lower())
+
+
+class LabeledSentence:
+    """(tokens, label) pair (reference dataset/text/LabeledSentence)."""
+
+    __slots__ = ("data", "label")
+
+    def __init__(self, data: Sequence, label: int):
+        self.data = list(data)
+        self.label = label
+
+
+class Dictionary:
+    """Word->id mapping capped at vocab_size by frequency
+    (reference models/rnn/Utils dictionary builder: keeps the vocabSize most
+    frequent words, the rest map to UNK). id 0 = PAD, id 1 = UNK."""
+
+    def __init__(self, corpus_tokens: Iterable[Sequence[str]],
+                 vocab_size: Optional[int] = None):
+        counts = Counter()
+        for toks in corpus_tokens:
+            counts.update(toks)
+        most = counts.most_common(vocab_size)
+        self.word2id = {PAD: 0, UNK: 1}
+        for w, _ in most:
+            self.word2id[w] = len(self.word2id)
+        self.id2word = {i: w for w, i in self.word2id.items()}
+
+    def __len__(self):
+        return len(self.word2id)
+
+    def lookup(self, word: str) -> int:
+        return self.word2id.get(word, 1)
+
+    def ids(self, tokens: Sequence[str]) -> list[int]:
+        return [self.lookup(t) for t in tokens]
+
+
+def pad_sequences(seqs: Sequence[Sequence[int]], max_len: int,
+                  pad_id: int = 0, truncate_from_end: bool = True):
+    """Fixed-length (N, max_len) int32 — static shapes for XLA (reference
+    LabeledSentenceToSample pads to the batch max; we pad to a fixed
+    max_len because jit recompiles per shape)."""
+    out = np.full((len(seqs), max_len), pad_id, np.int32)
+    for i, s in enumerate(seqs):
+        s = list(s)[:max_len] if truncate_from_end else list(s)[-max_len:]
+        out[i, :len(s)] = s
+    return out
+
+
+def sentences_to_ids(sentences: Sequence[LabeledSentence],
+                     dictionary: Dictionary, max_len: int):
+    """-> (ids (N, max_len) int32, labels (N,) int32)"""
+    ids = pad_sequences([dictionary.ids(s.data) for s in sentences], max_len)
+    labels = np.asarray([s.label for s in sentences], np.int32)
+    return ids, labels

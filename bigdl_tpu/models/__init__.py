@@ -1,0 +1,16 @@
+"""Model zoo (reference bigdl/models/: lenet, vgg, inception, resnet, rnn,
+autoencoder + example/loadmodel AlexNet)."""
+
+from bigdl_tpu.models.lenet import lenet5
+from bigdl_tpu.models.vgg import vgg_for_cifar10, vgg16, vgg19
+from bigdl_tpu.models.resnet import (
+    resnet, resnet_cifar, resnet50, basic_block, bottleneck_block,
+)
+from bigdl_tpu.models.inception import (
+    inception_v1, inception_v1_no_aux, inception_v2, inception_module,
+)
+from bigdl_tpu.models.alexnet import alexnet
+from bigdl_tpu.models.autoencoder import autoencoder
+from bigdl_tpu.models.rnn import (
+    simple_rnn, lstm_classifier, birnn_classifier, text_cnn,
+)

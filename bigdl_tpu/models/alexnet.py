@@ -1,0 +1,41 @@
+"""AlexNet (reference example/loadmodel/Model.scala builds AlexNet for Caffe
+import validation). Single-tower Caffe variant, NHWC."""
+
+from __future__ import annotations
+
+from bigdl_tpu.core.module import Sequential
+from bigdl_tpu import nn
+
+__all__ = ["alexnet"]
+
+
+def alexnet(class_num: int = 1000) -> Sequential:
+    m = Sequential(name="AlexNet")
+    m.add(nn.SpatialConvolution(3, 96, 11, 11, 4, 4, name="conv1"))
+    m.add(nn.ReLU())
+    m.add(nn.SpatialCrossMapLRN(5, 0.0001, 0.75))
+    m.add(nn.SpatialMaxPooling(3, 3, 2, 2))
+    m.add(nn.SpatialConvolution(96, 256, 5, 5, 1, 1, 2, 2, n_group=2,
+                                name="conv2"))
+    m.add(nn.ReLU())
+    m.add(nn.SpatialCrossMapLRN(5, 0.0001, 0.75))
+    m.add(nn.SpatialMaxPooling(3, 3, 2, 2))
+    m.add(nn.SpatialConvolution(256, 384, 3, 3, 1, 1, 1, 1, name="conv3"))
+    m.add(nn.ReLU())
+    m.add(nn.SpatialConvolution(384, 384, 3, 3, 1, 1, 1, 1, n_group=2,
+                                name="conv4"))
+    m.add(nn.ReLU())
+    m.add(nn.SpatialConvolution(384, 256, 3, 3, 1, 1, 1, 1, n_group=2,
+                                name="conv5"))
+    m.add(nn.ReLU())
+    m.add(nn.SpatialMaxPooling(3, 3, 2, 2))
+    m.add(nn.Reshape([256 * 6 * 6]))
+    m.add(nn.Linear(256 * 6 * 6, 4096, name="fc6"))
+    m.add(nn.ReLU())
+    m.add(nn.Dropout(0.5))
+    m.add(nn.Linear(4096, 4096, name="fc7"))
+    m.add(nn.ReLU())
+    m.add(nn.Dropout(0.5))
+    m.add(nn.Linear(4096, class_num, name="fc8"))
+    m.add(nn.LogSoftMax())
+    return m

@@ -1,0 +1,22 @@
+"""Fully-connected autoencoder on MNIST
+(reference models/autoencoder/Autoencoder.scala: 784 -> 32 -> 784 with ReLU
+hidden and Sigmoid output, trained with MSE)."""
+
+from __future__ import annotations
+
+from bigdl_tpu.core.module import Sequential
+from bigdl_tpu import nn
+
+__all__ = ["autoencoder"]
+
+
+def autoencoder(class_num: int = 32) -> Sequential:
+    """class_num = bottleneck width (the reference's classNum arg)."""
+    return Sequential(
+        nn.Reshape([28 * 28]),
+        nn.Linear(28 * 28, class_num),
+        nn.ReLU(),
+        nn.Linear(class_num, 28 * 28),
+        nn.Sigmoid(),
+        name="Autoencoder",
+    )

@@ -1,0 +1,182 @@
+"""Inception/GoogLeNet models (reference models/inception/Model.scala, 395
+LoC: Inception_v1_NoAuxClassifier, Inception_v1, Inception_v2).
+
+An inception module is a 4-branch Concat along channels (reference builds it
+with Concat + Sequential branches; identical structure here over NHWC, so
+the channel concat is axis -1). Aux-classifier variants return a 3-tuple
+(main, aux1, aux2) trained with ParallelCriterion weights (1.0, 0.3, 0.3)
+as in the reference Train pipeline.
+"""
+
+from __future__ import annotations
+
+from bigdl_tpu.core.module import Sequential, Module
+from bigdl_tpu import nn
+
+__all__ = ["inception_module", "inception_v1_no_aux", "inception_v1",
+           "inception_v2"]
+
+
+def inception_module(cin: int, config, with_bn: bool = False) -> Sequential:
+    """config = [[c1x1], [c3x3_reduce, c3x3], [c5x5_reduce, c5x5],
+    [pool_proj]] (reference Inception layer builder Model.scala)."""
+
+    def conv(ci, co, k, pad=0):
+        mods = [nn.SpatialConvolution(ci, co, k, k, 1, 1, pad, pad,
+                                      init="xavier",
+                                      with_bias=not with_bn)]
+        if with_bn:
+            mods.append(nn.SpatialBatchNormalization(co, eps=1e-3))
+        mods.append(nn.ReLU())
+        return mods
+
+    b1 = Sequential(*conv(cin, config[0][0], 1))
+    b2 = Sequential(*conv(cin, config[1][0], 1), *conv(config[1][0],
+                                                       config[1][1], 3, 1))
+    b3 = Sequential(*conv(cin, config[2][0], 1), *conv(config[2][0],
+                                                       config[2][1], 5, 2))
+    b4 = Sequential(nn.SpatialMaxPooling(3, 3, 1, 1, 1, 1).ceil(),
+                    *conv(cin, config[3][0], 1))
+    return Sequential(nn.Concat(b1, b2, b3, b4, axis=-1))
+
+
+def _stem(with_bn: bool = False) -> list:
+    mods = [
+        nn.SpatialConvolution(3, 64, 7, 7, 2, 2, 3, 3, init="xavier"),
+        nn.ReLU(),
+        nn.SpatialMaxPooling(3, 3, 2, 2).ceil(),
+        nn.SpatialCrossMapLRN(5, 0.0001, 0.75),
+        nn.SpatialConvolution(64, 64, 1, 1, init="xavier"),
+        nn.ReLU(),
+        nn.SpatialConvolution(64, 192, 3, 3, 1, 1, 1, 1, init="xavier"),
+        nn.ReLU(),
+        nn.SpatialCrossMapLRN(5, 0.0001, 0.75),
+        nn.SpatialMaxPooling(3, 3, 2, 2).ceil(),
+    ]
+    return mods
+
+
+# GoogLeNet table (Szegedy et al. 2014), as laid out in the reference's
+# Inception_v1 builder: per-module [1x1, [3x3r, 3x3], [5x5r, 5x5], pool].
+_V1_CFG = {
+    "3a": (192, [[64], [96, 128], [16, 32], [32]]),
+    "3b": (256, [[128], [128, 192], [32, 96], [64]]),
+    "4a": (480, [[192], [96, 208], [16, 48], [64]]),
+    "4b": (512, [[160], [112, 224], [24, 64], [64]]),
+    "4c": (512, [[128], [128, 256], [24, 64], [64]]),
+    "4d": (512, [[112], [144, 288], [32, 64], [64]]),
+    "4e": (528, [[256], [160, 320], [32, 128], [128]]),
+    "5a": (832, [[256], [160, 320], [32, 128], [128]]),
+    "5b": (832, [[384], [192, 384], [48, 128], [128]]),
+}
+
+
+def inception_v1_no_aux(class_num: int = 1000) -> Sequential:
+    """(reference Inception_v1_NoAuxClassifier) 224x224x3 -> classes."""
+    m = Sequential(name="Inception_v1_NoAux")
+    for mod in _stem():
+        m.add(mod)
+    for key in ("3a", "3b"):
+        m.add(inception_module(*_V1_CFG[key]))
+    m.add(nn.SpatialMaxPooling(3, 3, 2, 2).ceil())
+    for key in ("4a", "4b", "4c", "4d", "4e"):
+        m.add(inception_module(*_V1_CFG[key]))
+    m.add(nn.SpatialMaxPooling(3, 3, 2, 2).ceil())
+    for key in ("5a", "5b"):
+        m.add(inception_module(*_V1_CFG[key]))
+    m.add(nn.SpatialAveragePooling(7, 7, 1, 1))
+    m.add(nn.Dropout(0.4))
+    m.add(nn.Reshape([1024]))
+    m.add(nn.Linear(1024, class_num, init="xavier"))
+    m.add(nn.LogSoftMax())
+    return m
+
+
+def _aux_head(cin: int, class_num: int) -> Sequential:
+    """(reference aux classifier: avgpool5/3 + conv1x1(128) + fc1024 +
+    dropout 0.7 + fc classes)"""
+    return Sequential(
+        nn.SpatialAveragePooling(5, 5, 3, 3),
+        nn.SpatialConvolution(cin, 128, 1, 1, init="xavier"),
+        nn.ReLU(),
+        nn.Reshape([128 * 4 * 4]),
+        nn.Linear(128 * 4 * 4, 1024),
+        nn.ReLU(),
+        nn.Dropout(0.7),
+        nn.Linear(1024, class_num),
+        nn.LogSoftMax(),
+    )
+
+
+def inception_v1(class_num: int = 1000) -> Sequential:
+    """Full GoogLeNet with two aux classifiers (reference Inception_v1).
+    Output = (main, aux1, aux2) log-prob table; train with
+    ParallelCriterion(repeat_target=True) weighted (1.0, 0.3, 0.3)."""
+    trunk1 = Sequential(name="trunk1")  # up to 4a output
+    for mod in _stem():
+        trunk1.add(mod)
+    for key in ("3a", "3b"):
+        trunk1.add(inception_module(*_V1_CFG[key]))
+    trunk1.add(nn.SpatialMaxPooling(3, 3, 2, 2).ceil())
+    trunk1.add(inception_module(*_V1_CFG["4a"]))
+
+    trunk2 = Sequential(name="trunk2")  # 4b..4d
+    for key in ("4b", "4c", "4d"):
+        trunk2.add(inception_module(*_V1_CFG[key]))
+
+    trunk3 = Sequential(name="trunk3")  # 4e..5b + head
+    trunk3.add(inception_module(*_V1_CFG["4e"]))
+    trunk3.add(nn.SpatialMaxPooling(3, 3, 2, 2).ceil())
+    for key in ("5a", "5b"):
+        trunk3.add(inception_module(*_V1_CFG[key]))
+    trunk3.add(nn.SpatialAveragePooling(7, 7, 1, 1))
+    trunk3.add(nn.Dropout(0.4))
+    trunk3.add(nn.Reshape([1024]))
+    trunk3.add(nn.Linear(1024, class_num, init="xavier"))
+    trunk3.add(nn.LogSoftMax())
+
+    # (main, aux1, aux2): trunk1 -> split(aux1 | trunk2 -> split(aux2 | trunk3))
+    inner = Sequential(
+        nn.ConcatTable(
+            Sequential(trunk2,
+                       nn.ConcatTable(trunk3, _aux_head(528, class_num))),
+            _aux_head(512, class_num),
+        ),
+        nn.FlattenTable(),
+    )
+    m = Sequential(trunk1, inner,
+                   nn.Lambda(lambda t: (t[0], t[2], t[1]), name="reorder"),
+                   name="Inception_v1")
+    return m
+
+
+def inception_v2(class_num: int = 1000) -> Sequential:
+    """BN-Inception (reference Inception_v2): v1 topology with
+    batch-normalized inception modules and no LRN. Single output."""
+    m = Sequential(name="Inception_v2")
+    m.add(nn.SpatialConvolution(3, 64, 7, 7, 2, 2, 3, 3, with_bias=False,
+                                init="xavier"))
+    m.add(nn.SpatialBatchNormalization(64, eps=1e-3))
+    m.add(nn.ReLU())
+    m.add(nn.SpatialMaxPooling(3, 3, 2, 2).ceil())
+    m.add(nn.SpatialConvolution(64, 64, 1, 1, with_bias=False, init="xavier"))
+    m.add(nn.SpatialBatchNormalization(64, eps=1e-3))
+    m.add(nn.ReLU())
+    m.add(nn.SpatialConvolution(64, 192, 3, 3, 1, 1, 1, 1, with_bias=False,
+                                init="xavier"))
+    m.add(nn.SpatialBatchNormalization(192, eps=1e-3))
+    m.add(nn.ReLU())
+    m.add(nn.SpatialMaxPooling(3, 3, 2, 2).ceil())
+    for key in ("3a", "3b"):
+        m.add(inception_module(*_V1_CFG[key], with_bn=True))
+    m.add(nn.SpatialMaxPooling(3, 3, 2, 2).ceil())
+    for key in ("4a", "4b", "4c", "4d", "4e"):
+        m.add(inception_module(*_V1_CFG[key], with_bn=True))
+    m.add(nn.SpatialMaxPooling(3, 3, 2, 2).ceil())
+    for key in ("5a", "5b"):
+        m.add(inception_module(*_V1_CFG[key], with_bn=True))
+    m.add(nn.SpatialAveragePooling(7, 7, 1, 1))
+    m.add(nn.Reshape([1024]))
+    m.add(nn.Linear(1024, class_num, init="xavier"))
+    m.add(nn.LogSoftMax())
+    return m

@@ -1,0 +1,158 @@
+"""ResNet (reference models/resnet/ResNet.scala, 283 LoC).
+
+Builder supports the reference's CIFAR-10 recipe (depth = 6n+2 basic blocks,
+shortcutType A/B) and the ImageNet bottleneck family (ResNet-18/34/50/101/
+152, shortcutType B) — ResNet-50 is the BASELINE north-star model.
+
+The reference's "optnet" memory tricks (shareGradInput, ResNet.scala:62-100,
+SpatialShareConvolution) are buffer-aliasing workarounds for the JVM; under
+XLA, buffer reuse is the compiler's memory planner, and the rematerialization
+analog is `jax.checkpoint` applied per residual stage (see
+``bigdl_tpu.core.remat``-style usage in train configs).
+
+Init parity: convs use the He-style/Xavier reset the reference applies in
+``modelInit`` (ResNet.scala:102+); final-block BN gamma zero-init
+(zero_init_residual) is exposed as an option.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+import jax.numpy as jnp
+
+from bigdl_tpu.core.module import Sequential
+from bigdl_tpu import nn
+
+__all__ = ["resnet", "resnet_cifar", "resnet50", "basic_block",
+           "bottleneck_block"]
+
+
+def _conv_bn(cin, cout, k, stride=1, pad=0, relu=True, gamma_init=1.0):
+    m = [nn.SpatialConvolution(cin, cout, k, k, stride, stride, pad, pad,
+                               with_bias=False, init="xavier"),
+         nn.SpatialBatchNormalization(cout, gamma_init=gamma_init)]
+    if relu:
+        m.append(nn.ReLU())
+    return m
+
+
+def _shortcut(cin, cout, stride, shortcut_type: str):
+    """Shortcut types (reference ResNet.scala shortcutType A/B/C):
+    A = zero-padded identity (parameter-free, CIFAR paper),
+    B = 1x1 conv when shape changes else identity,
+    C = 1x1 conv always."""
+    changed = cin != cout or stride != 1
+    if shortcut_type == "C" or (shortcut_type == "B" and changed):
+        return Sequential(*_conv_bn(cin, cout, 1, stride, 0, relu=False))
+    if changed:  # type A
+        pool = []
+        if stride != 1:
+            pool.append(nn.SpatialAveragePooling(1, 1, stride, stride))
+        pad_c = cout - cin
+        pool.append(nn.Padding(-1, pad_c, value=0.0))  # pad channels (NHWC)
+        return Sequential(*pool)
+    return nn.Identity()
+
+
+def basic_block(cin, cout, stride=1, shortcut_type="B", zero_init=False):
+    """3x3 + 3x3 (reference basicBlock). ``zero_init`` zero-initializes the
+    final BN gamma so the block starts as identity (zero-init-residual)."""
+    main = Sequential(
+        *_conv_bn(cin, cout, 3, stride, 1),
+        *_conv_bn(cout, cout, 3, 1, 1, relu=False,
+                  gamma_init=0.0 if zero_init else 1.0),
+    )
+    return Sequential(
+        nn.ConcatTable(main, _shortcut(cin, cout, stride, shortcut_type)),
+        nn.CAddTable(),
+        nn.ReLU(),
+    )
+
+
+def bottleneck_block(cin, planes, stride=1, shortcut_type="B",
+                     expansion=4, zero_init=False):
+    """1x1 reduce, 3x3, 1x1 expand (reference bottleneck)."""
+    cout = planes * expansion
+    main = Sequential(
+        *_conv_bn(cin, planes, 1),
+        *_conv_bn(planes, planes, 3, stride, 1),
+        *_conv_bn(planes, cout, 1, relu=False,
+                  gamma_init=0.0 if zero_init else 1.0),
+    )
+    return Sequential(
+        nn.ConcatTable(main, _shortcut(cin, cout, stride, shortcut_type)),
+        nn.CAddTable(),
+        nn.ReLU(),
+    )
+
+
+_IMAGENET_CFG = {
+    18: ("basic", [2, 2, 2, 2]),
+    34: ("basic", [3, 4, 6, 3]),
+    50: ("bottleneck", [3, 4, 6, 3]),
+    101: ("bottleneck", [3, 4, 23, 3]),
+    152: ("bottleneck", [3, 8, 36, 3]),
+}
+
+
+def resnet(depth: int = 50, class_num: int = 1000,
+           shortcut_type: str = "B", zero_init_residual: bool = False
+           ) -> Sequential:
+    """ImageNet ResNet (reference ResNet.apply with DataSet.ImageNet).
+    Input (B, 224, 224, 3) NHWC."""
+    kind, layers = _IMAGENET_CFG[depth]
+    m = Sequential(name=f"ResNet{depth}")
+    m.add(nn.SpatialConvolution(3, 64, 7, 7, 2, 2, 3, 3, with_bias=False,
+                                init="xavier"))
+    m.add(nn.SpatialBatchNormalization(64))
+    m.add(nn.ReLU())
+    m.add(nn.SpatialMaxPooling(3, 3, 2, 2, 1, 1))
+    cin = 64
+    for stage, n_blocks in enumerate(layers):
+        planes = 64 * (2 ** stage)
+        for b in range(n_blocks):
+            stride = 2 if (stage > 0 and b == 0) else 1
+            if kind == "basic":
+                m.add(basic_block(cin, planes, stride, shortcut_type,
+                                  zero_init=zero_init_residual))
+                cin = planes
+            else:
+                m.add(bottleneck_block(cin, planes, stride, shortcut_type,
+                                       zero_init=zero_init_residual))
+                cin = planes * 4
+    m.add(nn.SpatialAveragePooling(7, 7, 1, 1))
+    m.add(nn.Reshape([cin]))
+    m.add(nn.Linear(cin, class_num, init="xavier"))
+    m.add(nn.LogSoftMax())
+    return m
+
+
+def resnet_cifar(depth: int = 20, class_num: int = 10,
+                 shortcut_type: str = "A") -> Sequential:
+    """CIFAR-10 ResNet, depth = 6n+2 (reference ResNet.apply CIFAR path;
+    recipe in models/resnet/README: depth 20, shortcut A). Input
+    (B, 32, 32, 3)."""
+    assert (depth - 2) % 6 == 0, "CIFAR depth must be 6n+2"
+    n = (depth - 2) // 6
+    m = Sequential(name=f"ResNet{depth}-cifar")
+    m.add(nn.SpatialConvolution(3, 16, 3, 3, 1, 1, 1, 1, with_bias=False,
+                                init="xavier"))
+    m.add(nn.SpatialBatchNormalization(16))
+    m.add(nn.ReLU())
+    cin = 16
+    for stage, planes in enumerate([16, 32, 64]):
+        for b in range(n):
+            stride = 2 if (stage > 0 and b == 0) else 1
+            m.add(basic_block(cin, planes, stride, shortcut_type))
+            cin = planes
+    m.add(nn.SpatialAveragePooling(8, 8, 1, 1))
+    m.add(nn.Reshape([64]))
+    m.add(nn.Linear(64, class_num, init="xavier"))
+    m.add(nn.LogSoftMax())
+    return m
+
+
+def resnet50(class_num: int = 1000) -> Sequential:
+    return resnet(50, class_num)

@@ -1,0 +1,92 @@
+"""Recurrent text models.
+
+* ``simple_rnn`` — (reference models/rnn/Model.scala:23-37: SimpleRNN =
+  Recurrent(RnnCell+Tanh) + select last step + Linear + LogSoftMax), for
+  char/word-level next-token prediction on tiny-shakespeare.
+* ``lstm_classifier`` / ``birnn_classifier`` — the "LSTM / BiRNN text
+  classification" BASELINE config: embedding -> (Bi)LSTM -> last state ->
+  Linear -> LogSoftMax. Not in the reference snapshot (no LSTM exists
+  there, SURVEY.md §2.4); built from the same recurrent path.
+* ``text_cnn`` — the reference's text-classification example
+  (example/textclassification/TextClassifier.scala: GloVe embeddings +
+  conv/pool stack), using native TemporalConvolution.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from bigdl_tpu.core.module import Sequential
+from bigdl_tpu import nn
+
+__all__ = ["simple_rnn", "lstm_classifier", "birnn_classifier", "text_cnn"]
+
+
+def simple_rnn(input_size: int, hidden_size: int, output_size: int,
+               bptt_truncate: int = 2) -> Sequential:
+    """Input: one-hot (B, T, input_size); output (B, output_size) log-probs
+    for the last step (reference trains next-word prediction with
+    perplexity loss)."""
+    return Sequential(
+        nn.Recurrent(nn.RnnCell(input_size, hidden_size, jnp.tanh),
+                     bptt_truncate=bptt_truncate, return_sequences=False),
+        nn.Linear(hidden_size, output_size),
+        nn.LogSoftMax(),
+        name="SimpleRNN",
+    )
+
+
+def lstm_classifier(vocab_size: int, embed_dim: int, hidden_size: int,
+                    class_num: int) -> Sequential:
+    return Sequential(
+        nn.LookupTable(vocab_size, embed_dim),
+        nn.Recurrent(nn.LSTMCell(embed_dim, hidden_size),
+                     return_sequences=False),
+        nn.Linear(hidden_size, class_num),
+        nn.LogSoftMax(),
+        name="LSTMClassifier",
+    )
+
+
+def birnn_classifier(vocab_size: int, embed_dim: int, hidden_size: int,
+                     class_num: int) -> Sequential:
+    return Sequential(
+        nn.LookupTable(vocab_size, embed_dim),
+        # final state of each direction (fwd@T-1 ++ bwd@0) — each half has
+        # consumed the whole sequence
+        nn.BiRecurrent(nn.LSTMCell(embed_dim, hidden_size),
+                       nn.LSTMCell(embed_dim, hidden_size),
+                       return_sequences=False),
+        nn.Linear(2 * hidden_size, class_num),
+        nn.LogSoftMax(),
+        name="BiRNNClassifier",
+    )
+
+
+def text_cnn(seq_len: int, embed_dim: int, class_num: int,
+             filters: int = 128) -> Sequential:
+    """(reference TextClassifier.scala:40-220 — three conv5/maxpool5 stages
+    then a dense head; input is pre-embedded (B, T, embed_dim))."""
+    m = Sequential(name="TextCNN")
+    cin = embed_dim
+    t = seq_len
+    for _ in range(2):
+        m.add(nn.TemporalConvolution(cin, filters, 5))
+        m.add(nn.ReLU())
+        m.add(nn.TemporalMaxPooling(5, 5))
+        cin = filters
+        t = (t - 4 - 5) // 5 + 1  # valid conv k=5, then pool k=s=5
+    if t < 5:
+        # t >= 5 after two stages requires t1 >= 29, i.e. seq_len >= 149
+        raise ValueError(f"seq_len={seq_len} too short for the 3-stage "
+                         f"TextCNN (needs >= 149; reference uses 500)")
+    m.add(nn.TemporalConvolution(cin, filters, 5))
+    m.add(nn.ReLU())
+    t = t - 4
+    m.add(nn.TemporalMaxPooling(t, t))
+    m.add(nn.Reshape([filters]))
+    m.add(nn.Linear(filters, 100))
+    m.add(nn.ReLU())
+    m.add(nn.Linear(100, class_num))
+    m.add(nn.LogSoftMax())
+    return m

@@ -1,0 +1,75 @@
+"""VGG models (reference models/vgg/Model.scala:25-184): VggForCifar10
+(conv-BN-ReLU blocks + 512-unit classifier head), Vgg_16 and Vgg_19 for
+ImageNet. NHWC; convs are bias-free where followed by BN."""
+
+from __future__ import annotations
+
+from bigdl_tpu.core.module import Sequential
+from bigdl_tpu import nn
+
+__all__ = ["vgg_for_cifar10", "vgg16", "vgg19"]
+
+
+def _conv_bn_relu(seq: Sequential, cin: int, cout: int) -> int:
+    seq.add(nn.SpatialConvolution(cin, cout, 3, 3, 1, 1, 1, 1,
+                                  with_bias=False))
+    seq.add(nn.SpatialBatchNormalization(cout, eps=1e-3))
+    seq.add(nn.ReLU())
+    return cout
+
+
+def vgg_for_cifar10(class_num: int = 10, dropout: bool = True) -> Sequential:
+    """(reference Model.scala VggForCifar10 :25-78) — conv stacks
+    [64,64] [128,128] [256x3] [512x3] [512x3] each followed by 2x2 maxpool,
+    then Linear(512,512)+BN+ReLU+Dropout(0.5)+Linear(512,classes)."""
+    m = Sequential(name="VggForCifar10")
+    c = 3
+    for block in ([64, 64], [128, 128], [256, 256, 256],
+                  [512, 512, 512], [512, 512, 512]):
+        for cout in block:
+            c = _conv_bn_relu(m, c, cout)
+        m.add(nn.SpatialMaxPooling(2, 2, 2, 2).ceil())
+    m.add(nn.Reshape([512]))
+    m.add(nn.Linear(512, 512))
+    m.add(nn.BatchNormalization(512))
+    m.add(nn.ReLU())
+    if dropout:
+        m.add(nn.Dropout(0.5))
+    m.add(nn.Linear(512, class_num))
+    m.add(nn.LogSoftMax())
+    return m
+
+
+def _vgg_imagenet(cfg, class_num: int, name: str) -> Sequential:
+    """(reference Vgg_16/Vgg_19 :80-184 — plain conv+ReLU, no BN, 224x224
+    inputs, classifier 4096-4096-classes with dropout)"""
+    m = Sequential(name=name)
+    c = 3
+    for block in cfg:
+        for cout in block:
+            m.add(nn.SpatialConvolution(c, cout, 3, 3, 1, 1, 1, 1))
+            m.add(nn.ReLU())
+            c = cout
+        m.add(nn.SpatialMaxPooling(2, 2, 2, 2))
+    m.add(nn.Reshape([512 * 7 * 7]))
+    m.add(nn.Linear(512 * 7 * 7, 4096))
+    m.add(nn.ReLU())
+    m.add(nn.Dropout(0.5))
+    m.add(nn.Linear(4096, 4096))
+    m.add(nn.ReLU())
+    m.add(nn.Dropout(0.5))
+    m.add(nn.Linear(4096, class_num))
+    m.add(nn.LogSoftMax())
+    return m
+
+
+def vgg16(class_num: int = 1000) -> Sequential:
+    return _vgg_imagenet([[64, 64], [128, 128], [256, 256, 256],
+                          [512, 512, 512], [512, 512, 512]],
+                         class_num, "Vgg_16")
+
+
+def vgg19(class_num: int = 1000) -> Sequential:
+    return _vgg_imagenet([[64, 64], [128, 128], [256, 256, 256, 256],
+                          [512, 512, 512, 512], [512, 512, 512, 512]],
+                         class_num, "Vgg_19")

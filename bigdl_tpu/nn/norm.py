@@ -49,18 +49,21 @@ class BatchNormalization(Module):
 
     def __init__(self, n_output: int, eps: float = 1e-5, momentum: float = 0.1,
                  affine: bool = True, axis_name: Optional[str] = None,
-                 name: Optional[str] = None):
+                 gamma_init: float = 1.0, name: Optional[str] = None):
         super().__init__(name)
         self.n_output = n_output
         self.eps, self.momentum, self.affine = eps, momentum, affine
         self.axis_name = axis_name
+        self.gamma_init = gamma_init
 
     def init(self, rng):
         if not self.affine:
             return {}
         del rng
-        # reference init: weight=1, bias=0 (BatchNormalization.reset)
-        return {"weight": jnp.ones((self.n_output,), jnp.float32),
+        # reference init: weight=1, bias=0 (BatchNormalization.reset);
+        # gamma_init=0 gives the zero-init-residual recipe for ResNet
+        return {"weight": jnp.full((self.n_output,), self.gamma_init,
+                                   jnp.float32),
                 "bias": jnp.zeros((self.n_output,), jnp.float32)}
 
     def init_state(self):

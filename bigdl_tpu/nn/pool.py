@@ -18,7 +18,8 @@ from jax import lax
 
 from bigdl_tpu.core.module import SimpleModule
 
-__all__ = ["SpatialMaxPooling", "SpatialAveragePooling"]
+__all__ = ["SpatialMaxPooling", "SpatialAveragePooling",
+           "TemporalMaxPooling"]
 
 
 def _pool_pads(size, k, s, pad, ceil_mode):
@@ -97,3 +98,19 @@ class SpatialAveragePooling(_SpatialPool):
         ones = jnp.ones(x.shape[1:3], x.dtype)[None, :, :, None]
         counts = lax.reduce_window(ones, 0.0, lax.add, dims, strides, pads)
         return summed / counts
+
+
+class TemporalMaxPooling(SimpleModule):
+    """Max-pool over the time axis of (B, T, C) sequences (Torch
+    TemporalMaxPooling; the reference emulates it by reshaping through
+    SpatialMaxPooling in its text-classification example)."""
+
+    def __init__(self, k_w: int, d_w: Optional[int] = None, name=None):
+        super().__init__(name)
+        self.k_w = k_w
+        self.d_w = d_w if d_w is not None else k_w
+
+    def _forward(self, params, x, *, training, rng):
+        return lax.reduce_window(x, -jnp.inf, lax.max,
+                                 (1, self.k_w, 1), (1, self.d_w, 1),
+                                 ((0, 0), (0, 0), (0, 0)))

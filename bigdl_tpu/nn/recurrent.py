@@ -211,15 +211,22 @@ class Recurrent(Module):
 class BiRecurrent(Module):
     """Bidirectional wrapper: run two cells over opposite time directions and
     merge (concat by default, sum optional) — the BiRNN of BASELINE.json's
-    text-classification config."""
+    text-classification config.
+
+    ``return_sequences=False`` returns the *final state of each direction*:
+    fwd output at t=T-1 concat bwd output at t=0 — each half having consumed
+    the full sequence. (Slicing t=-1 of the full output would hand you a
+    backward state that has seen only one token.)
+    """
 
     def __init__(self, fwd_cell: Cell, bwd_cell: Cell, merge: str = "concat",
-                 name: Optional[str] = None):
+                 return_sequences: bool = True, name: Optional[str] = None):
         super().__init__(name)
         assert merge in ("concat", "sum")
         self.fwd = Recurrent(fwd_cell)
         self.bwd = Recurrent(bwd_cell, reverse=True)
         self.merge = merge
+        self.return_sequences = return_sequences
 
     def children(self):
         return (self.fwd, self.bwd)
@@ -238,5 +245,7 @@ class BiRecurrent(Module):
                                 training=training, rng=rf)
         yb, sb = self.bwd.apply(params["bwd"], state["bwd"], x,
                                 training=training, rng=rb)
+        if not self.return_sequences:
+            yf, yb = yf[:, -1], yb[:, 0]  # final state of each direction
         y = jnp.concatenate([yf, yb], -1) if self.merge == "concat" else yf + yb
         return y, {"fwd": sf, "bwd": sb}

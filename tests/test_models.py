@@ -1,0 +1,186 @@
+"""Model zoo: shapes, gradient flow, and a quick learning check per family
+(reference models/{AlexNetSpec,InceptionSpec,ResNetSpec}.scala check forward
+shapes/values; full-size ImageNet models are exercised at reduced spatial
+size where the topology allows)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bigdl_tpu import nn, models
+
+R = np.random.RandomState(21)
+
+
+def _forward(model, shape, training=False):
+    p = model.init(jax.random.PRNGKey(0))
+    s = model.init_state()
+    x = jnp.asarray(R.randn(*shape).astype(np.float32))
+    rng = jax.random.PRNGKey(1)
+    y, _ = model.apply(p, s, x, training=training, rng=rng)
+    return y, p
+
+
+def test_lenet_shape():
+    y, _ = _forward(models.lenet5(10), (2, 28, 28, 1))
+    assert y.shape == (2, 10)
+    np.testing.assert_allclose(np.asarray(jnp.exp(y).sum(-1)), 1.0,
+                               rtol=1e-4)
+
+
+def test_vgg_cifar_shape():
+    y, p = _forward(models.vgg_for_cifar10(10), (2, 32, 32, 3),
+                    training=True)
+    assert y.shape == (2, 10)
+
+
+def test_resnet_cifar_shape_and_depth():
+    m = models.resnet_cifar(depth=20, shortcut_type="A")
+    y, p = _forward(m, (2, 32, 32, 3), training=True)
+    assert y.shape == (2, 10)
+    with pytest.raises(AssertionError):
+        models.resnet_cifar(depth=21)
+
+
+def test_resnet_shortcut_b_cifar():
+    m = models.resnet_cifar(depth=8, shortcut_type="B")
+    y, _ = _forward(m, (2, 32, 32, 3), training=True)
+    assert y.shape == (2, 10)
+
+
+def test_resnet50_imagenet_shape():
+    m = models.resnet50(1000)
+    y, p = _forward(m, (1, 224, 224, 3), training=True)
+    assert y.shape == (1, 1000)
+    n_params = sum(int(x.size) for x in jax.tree_util.tree_leaves(p))
+    assert abs(n_params - 25_557_032) / 25_557_032 < 0.02, n_params
+
+
+def test_inception_v1_no_aux_shape():
+    y, _ = _forward(models.inception_v1_no_aux(1000), (1, 224, 224, 3))
+    assert y.shape == (1, 1000)
+
+
+def test_inception_v1_aux_outputs():
+    m = models.inception_v1(1000)
+    p = m.init(jax.random.PRNGKey(0))
+    s = m.init_state()
+    x = jnp.asarray(R.randn(1, 224, 224, 3).astype(np.float32))
+    (main, a1, a2), _ = m.apply(p, s, x, training=True,
+                                rng=jax.random.PRNGKey(1))
+    assert main.shape == (1, 1000)
+    assert a1.shape == (1, 1000) and a2.shape == (1, 1000)
+    # trains with ParallelCriterion(repeat_target=True)
+    crit = nn.ParallelCriterion(repeat_target=True)
+    crit.add(nn.ClassNLLCriterion(), 1.0)
+    crit.add(nn.ClassNLLCriterion(), 0.3)
+    crit.add(nn.ClassNLLCriterion(), 0.3)
+    loss = crit((main, a1, a2), jnp.asarray([3]))
+    assert np.isfinite(float(loss))
+
+
+def test_inception_v2_shape():
+    y, _ = _forward(models.inception_v2(1000), (1, 224, 224, 3),
+                    training=True)
+    assert y.shape == (1, 1000)
+
+
+def test_alexnet_shape():
+    y, _ = _forward(models.alexnet(1000), (1, 227, 227, 3))
+    assert y.shape == (1, 1000)
+
+
+def test_autoencoder_reconstruction_learns():
+    m = models.autoencoder(32)
+    p = m.init(jax.random.PRNGKey(0))
+    x = jnp.asarray(R.rand(16, 28, 28, 1).astype(np.float32))
+    crit = nn.MSECriterion()
+
+    def loss(params):
+        return crit(m.forward(params, x), x.reshape(16, -1))
+
+    l0 = float(loss(p))
+    g = jax.grad(loss)(p)
+    p2 = jax.tree_util.tree_map(lambda w, gw: w - 0.5 * gw, p, g)
+    assert float(loss(p2)) < l0
+
+
+def test_simple_rnn_shape():
+    m = models.simple_rnn(input_size=20, hidden_size=16, output_size=20)
+    p = m.init(jax.random.PRNGKey(0))
+    x = jax.nn.one_hot(jnp.asarray(R.randint(0, 20, (3, 7))), 20)
+    y = m.forward(p, x)
+    assert y.shape == (3, 20)
+
+
+def test_lstm_and_birnn_classifiers_learn():
+    """Tiny sentiment task: class = which half of the vocab dominates."""
+    vocab, embed, hidden, classes, T = 30, 8, 16, 2, 12
+    rng = np.random.RandomState(3)
+    n = 128
+    y = rng.randint(0, 2, n).astype(np.int32)
+    ids = np.where(y[:, None] == 0,
+                   rng.randint(2, 16, (n, T)),
+                   rng.randint(16, 30, (n, T))).astype(np.int32)
+
+    for build in (models.lstm_classifier, models.birnn_classifier):
+        m = build(vocab, embed, hidden, classes)
+        p = m.init(jax.random.PRNGKey(0))
+        crit = nn.ClassNLLCriterion()
+
+        @jax.jit
+        def step(params, x, t):
+            def loss(q):
+                return crit(m.forward(q, x), t)
+            l, g = jax.value_and_grad(loss)(params)
+            return l, jax.tree_util.tree_map(lambda w, gw: w - 0.5 * gw,
+                                             params, g)
+
+        x = jnp.asarray(ids)
+        t = jnp.asarray(y)
+        l0, p = step(p, x, t)
+        for _ in range(30):
+            l, p = step(p, x, t)
+        assert float(l) < 0.3 * float(l0), (build.__name__, float(l0),
+                                            float(l))
+
+
+def test_text_cnn_shape():
+    m = models.text_cnn(seq_len=500, embed_dim=16, class_num=5)
+    p = m.init(jax.random.PRNGKey(0))
+    x = jnp.asarray(R.randn(2, 500, 16).astype(np.float32))
+    y = m.forward(p, x)
+    assert y.shape == (2, 5)
+    with pytest.raises(ValueError):
+        models.text_cnn(seq_len=50, embed_dim=16, class_num=5)
+
+
+def test_text_pipeline():
+    from bigdl_tpu.dataset.text import (tokenize, Dictionary, pad_sequences,
+                                        LabeledSentence, sentences_to_ids)
+    docs = ["The quick brown fox.", "the lazy dog!", "quick quick fox"]
+    toks = [tokenize(d) for d in docs]
+    assert toks[0] == ["the", "quick", "brown", "fox", "."]
+    d = Dictionary(toks, vocab_size=4)
+    assert len(d) == 6  # pad, unk + 4
+    assert d.lookup("the") != 1 and d.lookup("zebra") == 1
+    sents = [LabeledSentence(t, i % 2) for i, t in enumerate(toks)]
+    ids, labels = sentences_to_ids(sents, d, max_len=6)
+    assert ids.shape == (3, 6) and labels.tolist() == [0, 1, 0]
+    assert ids[1, -1] == 0  # padded
+
+
+def test_cifar_reader(tmp_path):
+    from bigdl_tpu.dataset.cifar import load_cifar10
+    rng = np.random.RandomState(0)
+    for name in [f"data_batch_{i}.bin" for i in range(1, 6)] + [
+            "test_batch.bin"]:
+        rec = np.zeros((4, 3073), np.uint8)
+        rec[:, 0] = rng.randint(0, 10, 4)
+        rec[:, 1:] = rng.randint(0, 256, (4, 3072))
+        rec.tofile(str(tmp_path / name))
+    imgs, labels = load_cifar10(str(tmp_path), train=True)
+    assert imgs.shape == (20, 32, 32, 3) and labels.shape == (20,)
+    imgs_t, _ = load_cifar10(str(tmp_path), train=False)
+    assert imgs_t.shape == (4, 32, 32, 3)
