@@ -10,4 +10,5 @@ from bigdl_tpu.nn.pool import *  # noqa: F401,F403
 from bigdl_tpu.nn.norm import *  # noqa: F401,F403
 from bigdl_tpu.nn.structural import *  # noqa: F401,F403
 from bigdl_tpu.nn.recurrent import *  # noqa: F401,F403
+from bigdl_tpu.nn.attention import *  # noqa: F401,F403
 from bigdl_tpu.nn.criterion import *  # noqa: F401,F403

@@ -1,0 +1,334 @@
+"""Attention and transformer layers.
+
+The reference snapshot predates attention entirely (SURVEY.md §5
+"Long-context / sequence parallelism: Absent" — its only sequence model is
+the scanned RNN, nn/Recurrent.scala:27-113). This module is therefore
+designed TPU-first rather than for parity: batched bf16-friendly matmuls
+shaped for the MXU, a pluggable inner attention function so the same layer
+can run
+
+* the plain XLA path (``dot_product_attention`` below — XLA fuses the
+  softmax chain),
+* a Pallas flash-attention kernel (``bigdl_tpu.ops.flash_attention``), or
+* ring attention over a ``seq`` mesh axis
+  (``bigdl_tpu.parallel.sequence.ring_attention``) for long-context
+  sequence parallelism.
+
+Shapes: inputs are (batch, seq, d_model); heads are folded into the batch
+dimension for the two attention matmuls so they are large MXU-friendly
+contractions.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.core.module import (
+    Module,
+    SimpleModule,
+    Sequential,
+    xavier_uniform,
+)
+
+__all__ = [
+    "dot_product_attention",
+    "LayerNorm",
+    "MultiHeadAttention",
+    "PositionalEncoding",
+    "TransformerEncoderLayer",
+    "TransformerEncoder",
+]
+
+AttnFn = Callable[..., jax.Array]
+
+_NEG_INF = -1e30  # finite mask value: a fully-masked query row softmaxes to
+                  # uniform-over-garbage instead of NaN, and (below) its
+                  # probabilities are re-zeroed explicitly
+
+
+def dot_product_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = False,
+    mask: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Scaled dot-product attention. q,k,v: (..., seq, head_dim).
+
+    Softmax statistics are computed in fp32 regardless of input dtype
+    (bf16-safe), the matmuls stay in the input dtype for the MXU.
+    """
+    head_dim = q.shape[-1]
+    scale = 1.0 / math.sqrt(head_dim)
+    # bf16 inputs: multiply on the MXU in bf16, accumulate in fp32
+    logits = jnp.einsum("...qd,...kd->...qk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    valid = None
+    if causal:
+        q_len, k_len = logits.shape[-2], logits.shape[-1]
+        # bottom-right aligned (flash convention): with q_len < k_len the
+        # queries are the suffix of the key sequence, so query i sees keys
+        # <= (k_len - q_len) + i
+        offset = k_len - q_len
+        valid = (jnp.arange(q_len)[:, None] + offset
+                 >= jnp.arange(k_len)[None, :])
+    if mask is not None:
+        valid = mask if valid is None else jnp.logical_and(valid, mask)
+    if valid is not None:
+        logits = jnp.where(valid, logits, _NEG_INF)
+    weights = jax.nn.softmax(logits, axis=-1)
+    if valid is not None:
+        # zero out fully-masked rows rather than leaving uniform noise
+        weights = jnp.where(valid, weights, 0.0)
+    return jnp.einsum("...qk,...kd->...qd", weights.astype(q.dtype), v)
+
+
+class LayerNorm(SimpleModule):
+    """Layer normalization over the last dimension.
+
+    Not in the reference (its normalizations are batch/spatial —
+    nn/BatchNormalization.scala); required substrate for transformers.
+    Statistics in fp32, output cast back to the input dtype.
+    """
+
+    def __init__(self, dim: int, eps: float = 1e-5,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.dim = dim
+        self.eps = eps
+
+    def init(self, rng):
+        del rng
+        return {"weight": jnp.ones((self.dim,)),
+                "bias": jnp.zeros((self.dim,))}
+
+    def _forward(self, params, x, *, training, rng):
+        xf = x.astype(jnp.float32)
+        mean = jnp.mean(xf, axis=-1, keepdims=True)
+        var = jnp.var(xf, axis=-1, keepdims=True)
+        y = (xf - mean) * jax.lax.rsqrt(var + self.eps)
+        y = y * params["weight"] + params["bias"]
+        return y.astype(x.dtype)
+
+
+class MultiHeadAttention(SimpleModule):
+    """Multi-head (self- or cross-) attention.
+
+    ``attn_impl`` swaps the inner attention: None -> plain XLA path;
+    "flash" -> Pallas flash-attention kernel; or any callable with the
+    ``dot_product_attention`` signature (ring attention passes a shard_map'd
+    callable here).
+    """
+
+    def __init__(
+        self,
+        d_model: int,
+        num_heads: int,
+        causal: bool = False,
+        attn_impl: Optional[AttnFn | str] = None,
+        param_dtype=jnp.float32,
+        name: Optional[str] = None,
+    ):
+        super().__init__(name)
+        if d_model % num_heads:
+            raise ValueError(f"d_model {d_model} not divisible by "
+                             f"num_heads {num_heads}")
+        self.d_model = d_model
+        self.num_heads = num_heads
+        self.head_dim = d_model // num_heads
+        self.causal = causal
+        self.param_dtype = param_dtype
+        if attn_impl == "flash":
+            from bigdl_tpu.ops import flash_attention
+            attn_impl = flash_attention
+        self.attn_fn: AttnFn = attn_impl or dot_product_attention
+
+    def init(self, rng):
+        ks = jax.random.split(rng, 4)
+        d = self.d_model
+        mk = lambda k: xavier_uniform(k, (d, d), d, d, self.param_dtype)
+        return {
+            "wq": mk(ks[0]), "wk": mk(ks[1]), "wv": mk(ks[2]),
+            "wo": mk(ks[3]),
+            "bq": jnp.zeros((d,), self.param_dtype),
+            "bk": jnp.zeros((d,), self.param_dtype),
+            "bv": jnp.zeros((d,), self.param_dtype),
+            "bo": jnp.zeros((d,), self.param_dtype),
+        }
+
+    def _split_heads(self, x):
+        b, s, _ = x.shape
+        return x.reshape(b, s, self.num_heads, self.head_dim).transpose(
+            0, 2, 1, 3)
+
+    def _merge_heads(self, x):
+        b, h, s, d = x.shape
+        return x.transpose(0, 2, 1, 3).reshape(b, s, h * d)
+
+    def _forward(self, params, x, *, training, rng):
+        # input forms: tensor (self-attention); (q_in, kv_in) (cross);
+        # (q_in, kv_in, mask) where mask is (b, s_k) key-padding bool or a
+        # broadcastable (b|1, h|1, s_q, s_k) attention mask
+        mask = None
+        if isinstance(x, (tuple, list)):
+            q_in, kv_in = x[0], x[1]
+            mask = x[2] if len(x) > 2 else None
+        else:
+            q_in = kv_in = x
+        dt = q_in.dtype
+        q = q_in @ params["wq"].astype(dt) + params["bq"].astype(dt)
+        k = kv_in @ params["wk"].astype(dt) + params["bk"].astype(dt)
+        v = kv_in @ params["wv"].astype(dt) + params["bv"].astype(dt)
+        q, k, v = map(self._split_heads, (q, k, v))
+        if mask is not None and mask.ndim == 2:  # (b, s_k) key-padding
+            mask = mask[:, None, None, :]
+        o = self.attn_fn(q, k, v, causal=self.causal, mask=mask)
+        o = self._merge_heads(o)
+        return o @ params["wo"].astype(dt) + params["bo"].astype(dt)
+
+
+class PositionalEncoding(SimpleModule):
+    """Sinusoidal positional encoding added to (batch, seq, d_model).
+
+    The table is precomputed once for ``max_len`` positions (a trace-time
+    constant — XLA folds the slice); sequences longer than ``max_len``
+    raise at trace time.
+    """
+
+    def __init__(self, d_model: int, max_len: int = 4096,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.d_model = d_model
+        self.max_len = max_len
+        import numpy as np
+        pos = np.arange(max_len)[:, None].astype(np.float32)
+        dim = np.arange(0, d_model, 2).astype(np.float32)
+        angle = pos / np.power(10000.0, dim / d_model)  # (max_len, ceil(d/2))
+        pe = np.zeros((max_len, d_model), np.float32)
+        pe[:, 0::2] = np.sin(angle)
+        pe[:, 1::2] = np.cos(angle)[:, : d_model // 2]
+        self._table = pe
+
+    def _forward(self, params, x, *, training, rng):
+        del params, training, rng
+        seq = x.shape[-2]
+        if seq > self.max_len:
+            raise ValueError(f"sequence length {seq} exceeds "
+                             f"max_len {self.max_len}")
+        return x + jnp.asarray(self._table[:seq]).astype(x.dtype)
+
+
+class TransformerEncoderLayer(Module):
+    """Pre-LN transformer block: x + MHA(LN(x)); x + MLP(LN(x)).
+
+    Pre-LN (not the post-LN of the original paper) — trains stably without
+    warmup, the standard choice for TPU LLM stacks.
+    """
+
+    def __init__(
+        self,
+        d_model: int,
+        num_heads: int,
+        d_ff: Optional[int] = None,
+        causal: bool = False,
+        dropout: float = 0.0,
+        attn_impl: Optional[AttnFn | str] = None,
+        name: Optional[str] = None,
+    ):
+        super().__init__(name)
+        d_ff = d_ff or 4 * d_model
+        self.d_model, self.d_ff = d_model, d_ff
+        from bigdl_tpu.nn.structural import Dropout
+        self.dropout = dropout
+        self.drop = Dropout(dropout) if dropout > 0.0 else None
+        self.ln1 = LayerNorm(d_model)
+        self.ln2 = LayerNorm(d_model)
+        self.mha = MultiHeadAttention(d_model, num_heads, causal=causal,
+                                      attn_impl=attn_impl)
+        # keep the MLP as explicit params (not a Sequential) for stable
+        # checkpoint keys
+        self._mlp_dims = (d_model, d_ff)
+
+    def children(self):
+        return (self.ln1, self.mha, self.ln2)
+
+    def init(self, rng):
+        ks = jax.random.split(rng, 5)
+        d, f = self._mlp_dims
+        return {
+            "ln1": self.ln1.init(ks[0]),
+            "mha": self.mha.init(ks[1]),
+            "ln2": self.ln2.init(ks[2]),
+            "w1": xavier_uniform(ks[3], (d, f), d, f),
+            "b1": jnp.zeros((f,)),
+            "w2": xavier_uniform(ks[4], (f, d), f, d),
+            "b2": jnp.zeros((d,)),
+        }
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        # (x, mask) threads a key-padding mask through the stack; the same
+        # form is returned so Sequential/TransformerEncoder chains it
+        mask = None
+        if isinstance(x, (tuple, list)):
+            x, mask = x[0], x[1]
+        dt = x.dtype
+        h = self.ln1.forward(params["ln1"], x)
+        h = self.mha.forward(params["mha"],
+                             h if mask is None else (h, h, mask),
+                             training=training, rng=rng)
+        if self.drop is not None:
+            rng, k = (jax.random.split(rng) if rng is not None
+                      else (None, None))
+            h = self.drop.forward({}, h, training=training, rng=k)
+        x = x + h
+        h = self.ln2.forward(params["ln2"], x)
+        h = h @ params["w1"].astype(dt) + params["b1"].astype(dt)
+        h = jax.nn.gelu(h)
+        h = h @ params["w2"].astype(dt) + params["b2"].astype(dt)
+        if self.drop is not None:
+            rng, k = (jax.random.split(rng) if rng is not None
+                      else (None, None))
+            h = self.drop.forward({}, h, training=training, rng=k)
+        y = x + h
+        return (y if mask is None else (y, mask)), state
+
+
+class TransformerEncoder(Sequential):
+    """Stack of encoder layers with optional remat.
+
+    ``remat=True`` wraps each layer in ``jax.checkpoint`` — the
+    HBM-for-FLOPs trade that long-context training needs.
+    """
+
+    def __init__(self, num_layers: int, d_model: int, num_heads: int,
+                 d_ff: Optional[int] = None, causal: bool = False,
+                 dropout: float = 0.0,
+                 attn_impl: Optional[AttnFn | str] = None,
+                 remat: bool = False, name: Optional[str] = None):
+        layers = [
+            TransformerEncoderLayer(d_model, num_heads, d_ff, causal,
+                                    dropout, attn_impl)
+            for _ in range(num_layers)
+        ]
+        super().__init__(*layers, name=name)
+        self.remat = remat
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        if not self.remat:
+            return super().apply(params, state, x, training=training, rng=rng)
+        new_state = {}
+        for i, m in enumerate(self._modules):
+            k = str(i)
+            fn = jax.checkpoint(
+                lambda p, s, h, r, m=m: m.apply(p, s, h, training=training,
+                                                rng=r),
+                static_argnums=())
+            r = None if rng is None else jax.random.fold_in(rng, i)
+            x, s = fn(params[k], state[k], x, r)
+            new_state[k] = s
+        return x, new_state

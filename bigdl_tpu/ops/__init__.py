@@ -1,0 +1,13 @@
+"""Custom TPU kernels (Pallas) with XLA fallbacks.
+
+The reference's custom-kernel layer is the MKL JNI shim
+(native/mkl/src/main/c/jni/mkl.c — 34 VML/BLAS wrappers, SURVEY.md §2.1);
+under XLA nearly all of those lower to fused HLO automatically, so this
+package only holds kernels where hand-tiling beats the compiler: flash
+attention (and, as they land, LRN and other fused ops). Every kernel has a
+pure-XLA fallback used off-TPU so the API is always importable.
+"""
+
+from bigdl_tpu.ops.attention_kernel import flash_attention
+
+__all__ = ["flash_attention"]

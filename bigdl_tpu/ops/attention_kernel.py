@@ -1,0 +1,21 @@
+"""Flash attention for TPU.
+
+Currently the XLA-path implementation (blockwise-fused by the compiler); the
+hand-tiled Pallas kernel lands behind the same signature so callers —
+``nn.MultiHeadAttention(attn_impl="flash")`` — never change.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+from bigdl_tpu.nn import attention as _dense
+
+
+def flash_attention(q, k, v, *, causal: bool = False,
+                    mask: Optional[jax.Array] = None):
+    """(b, h, s, d) attention; falls back to the dense XLA path until the
+    Pallas kernel is wired in."""
+    return _dense.dot_product_attention(q, k, v, causal=causal, mask=mask)

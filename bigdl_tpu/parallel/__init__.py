@@ -2,3 +2,4 @@ from bigdl_tpu.parallel.mesh import (
     init_distributed, make_mesh, local_mesh, P, NamedSharding,
 )
 from bigdl_tpu.parallel.data_parallel import DataParallel
+from bigdl_tpu.parallel.sequence import ring_attention, make_ring_attention

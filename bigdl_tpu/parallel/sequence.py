@@ -1,0 +1,99 @@
+"""Sequence/context parallelism: ring attention over a mesh axis.
+
+The reference has no attention and no sequence parallelism (SURVEY.md §2.7:
+"Sequence/context parallel — NO"); long sequences there meant truncated BPTT
+(nn/Recurrent.scala:66-107). This module is the TPU-native long-context
+design the brief requires: the sequence dimension is sharded over a ``seq``
+mesh axis, each device holds one block of Q/K/V, and K/V blocks rotate
+around the ring via ``ppermute`` while each device accumulates its Q-block's
+attention with an online (streaming) softmax — compute overlaps with ICI
+transfer, memory per device is O(seq/N), and the result is bit-equivalent
+(up to fp reassociation) to full attention.
+
+Usage: ``attn = make_ring_attention(mesh, "seq")`` then pass it as
+``attn_impl=`` to :class:`bigdl_tpu.nn.MultiHeadAttention`, with the
+(batch, seq, d_model) activations sharded ``P(None, "seq", None)``.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["ring_attention", "make_ring_attention"]
+
+_NEG_INF = -1e30  # finite mask value: keeps exp() well-defined in blocks
+                  # that are entirely masked out (true -inf would NaN)
+
+
+def ring_attention(q, k, v, *, axis_name: str, causal: bool = False):
+    """Blockwise ring attention. Must run inside shard_map with the seq
+    dimension of q/k/v (shape ...,(b,h,s_local,d)) sharded on ``axis_name``.
+    """
+    n = jax.lax.psum(1, axis_name)
+    my = jax.lax.axis_index(axis_name)
+    s_q = q.shape[-2]
+    s_k = k.shape[-2]
+    scale = 1.0 / (q.shape[-1] ** 0.5)
+
+    # global positions of my q rows
+    q_pos = my * s_q + jnp.arange(s_q)
+
+    def step(carry, t):
+        kb, vb, m, l, o = carry
+        # after t hops of "send to next", I hold the block born on (my - t)
+        src = (my - t) % n
+        # bf16 multiply on the MXU, fp32 accumulate — same numerics as the
+        # dense path's preferred_element_type
+        logits = jnp.einsum("...qd,...kd->...qk", q, kb,
+                            preferred_element_type=jnp.float32) * scale
+        if causal:
+            k_pos = src * s_k + jnp.arange(s_k)
+            valid = q_pos[:, None] >= k_pos[None, :]
+            logits = jnp.where(valid, logits, _NEG_INF)
+        blk_max = jnp.max(logits, axis=-1, keepdims=True)
+        new_m = jnp.maximum(m, blk_max)
+        p = jnp.exp(logits - new_m)
+        if causal:
+            p = jnp.where(valid, p, 0.0)
+        corr = jnp.exp(m - new_m)
+        l = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+        o = o * corr + jnp.einsum("...qk,...kd->...qd", p,
+                                  vb.astype(jnp.float32))
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        kb = jax.lax.ppermute(kb, axis_name, perm)
+        vb = jax.lax.ppermute(vb, axis_name, perm)
+        return (kb, vb, new_m, l, o), None
+
+    m0 = jnp.full(q.shape[:-1] + (1,), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros(q.shape[:-1] + (1,), jnp.float32)
+    o0 = jnp.zeros(q.shape, jnp.float32)
+    (_, _, _, l, o), _ = _scan_steps(step, (k, v, m0, l0, o0), n)
+    return (o / jnp.maximum(l, 1e-30)).astype(q.dtype)
+
+
+def _scan_steps(step, carry, n):
+    return jax.lax.scan(step, carry, jnp.arange(n))
+
+
+def make_ring_attention(mesh: Mesh, seq_axis: str = "seq",
+                        batch_axis: Optional[str] = None):
+    """Wrap :func:`ring_attention` in shard_map so it can be passed directly
+    as ``attn_impl`` to MultiHeadAttention. q/k/v are (b, h, s, d); s is
+    sharded on ``seq_axis`` (and b on ``batch_axis`` when given)."""
+    spec = P(batch_axis, None, seq_axis, None)
+
+    def attn(q, k, v, *, causal: bool = False, mask=None):
+        if mask is not None:
+            raise NotImplementedError(
+                "ring attention supports causal masking only")
+        fn = functools.partial(ring_attention, axis_name=seq_axis,
+                               causal=causal)
+        return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                             out_specs=spec, check_vma=False)(q, k, v)
+
+    return attn

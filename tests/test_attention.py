@@ -1,0 +1,170 @@
+"""Attention layers + ring-attention sequence parallelism.
+
+Ring attention is validated against the dense reference implementation on
+the 8-device CPU mesh (the multi-chip-without-hardware strategy of
+SURVEY.md §4) — same numerics up to fp32 reassociation.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bigdl_tpu import nn
+from bigdl_tpu.nn.attention import (
+    LayerNorm,
+    MultiHeadAttention,
+    PositionalEncoding,
+    TransformerEncoder,
+    TransformerEncoderLayer,
+    dot_product_attention,
+)
+from bigdl_tpu.parallel import make_mesh
+from bigdl_tpu.parallel.sequence import make_ring_attention
+
+
+def test_layernorm(rng):
+    ln = LayerNorm(16)
+    p = ln.init(rng)
+    x = jax.random.normal(rng, (4, 16)) * 3 + 1
+    y = ln.forward(p, x)
+    np.testing.assert_allclose(np.mean(y, -1), 0, atol=1e-5)
+    np.testing.assert_allclose(np.std(y, -1), 1, atol=1e-3)
+
+
+def test_dot_product_attention_softmax():
+    q = jnp.ones((1, 1, 3, 4))
+    k = jnp.zeros((1, 1, 5, 4))
+    v = jnp.arange(5.0).reshape(1, 1, 5, 1) * jnp.ones((1, 1, 5, 4))
+    # uniform weights -> mean of v
+    out = dot_product_attention(q, k, v)
+    np.testing.assert_allclose(out[0, 0, 0, 0], 2.0, atol=1e-6)
+
+
+def test_causal_mask():
+    rng = jax.random.PRNGKey(1)
+    q = jax.random.normal(rng, (2, 2, 6, 8))
+    out = dot_product_attention(q, q, q, causal=True)
+    # position 0 attends only to itself -> equals v[0]
+    np.testing.assert_allclose(out[:, :, 0, :], q[:, :, 0, :], atol=1e-5)
+
+
+def test_mha_shapes_and_grad(rng):
+    mha = MultiHeadAttention(32, 4, causal=True)
+    p = mha.init(rng)
+    x = jax.random.normal(rng, (2, 10, 32))
+    y = mha.forward(p, x)
+    assert y.shape == (2, 10, 32)
+
+    def loss(p):
+        return jnp.sum(mha.forward(p, x) ** 2)
+
+    g = jax.grad(loss)(p)
+    assert all(jnp.all(jnp.isfinite(v)) for v in jax.tree_util.tree_leaves(g))
+
+
+def test_mha_cross_attention(rng):
+    mha = MultiHeadAttention(16, 2)
+    p = mha.init(rng)
+    q_in = jax.random.normal(rng, (2, 5, 16))
+    kv = jax.random.normal(jax.random.fold_in(rng, 1), (2, 9, 16))
+    y = mha.forward(p, (q_in, kv))
+    assert y.shape == (2, 5, 16)
+
+
+def test_positional_encoding():
+    pe = PositionalEncoding(8)
+    x = jnp.zeros((1, 4, 8))
+    y = pe.forward({}, x)
+    assert y.shape == x.shape
+    # position 0: sin(0)=0, cos(0)=1
+    np.testing.assert_allclose(y[0, 0, 0::2], 0.0, atol=1e-6)
+    np.testing.assert_allclose(y[0, 0, 1::2], 1.0, atol=1e-6)
+
+
+def test_transformer_encoder_forward_and_remat(rng):
+    enc = TransformerEncoder(2, 16, 2, causal=True)
+    enc_r = TransformerEncoder(2, 16, 2, causal=True, remat=True)
+    p = enc.init(rng)
+    x = jax.random.normal(rng, (2, 7, 16))
+    y = enc.forward(p, x)
+    y_r = enc_r.forward(p, x)
+    assert y.shape == (2, 7, 16)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(y_r), atol=1e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_matches_dense(causal):
+    mesh = make_mesh({"seq": 8})
+    attn = make_ring_attention(mesh, "seq")
+    rng = jax.random.PRNGKey(3)
+    kq, kk, kv = jax.random.split(rng, 3)
+    b, h, s, d = 2, 2, 32, 8  # s=32 over 8 devices -> 4 per device
+    q = jax.random.normal(kq, (b, h, s, d))
+    k = jax.random.normal(kk, (b, h, s, d))
+    v = jax.random.normal(kv, (b, h, s, d))
+    got = attn(q, k, v, causal=causal)
+    want = dot_product_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_ring_attention_in_mha_grad():
+    mesh = make_mesh({"seq": 8})
+    attn = make_ring_attention(mesh, "seq")
+    mha = MultiHeadAttention(16, 2, causal=True, attn_impl=attn)
+    mha_ref = MultiHeadAttention(16, 2, causal=True)
+    p = mha.init(jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 16, 16))
+
+    y = mha.forward(p, x)
+    y_ref = mha_ref.forward(p, x)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(y_ref), atol=1e-5)
+
+    g = jax.grad(lambda p: jnp.sum(mha.forward(p, x) ** 2))(p)
+    g_ref = jax.grad(lambda p: jnp.sum(mha_ref.forward(p, x) ** 2))(p)
+    for a, b_ in zip(jax.tree_util.tree_leaves(g),
+                     jax.tree_util.tree_leaves(g_ref)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   atol=1e-4, rtol=1e-4)
+
+
+def test_causal_cross_attention_bottom_right():
+    # q is the 2-suffix of a 6-key sequence: row 0 must see keys 0..4
+    rng = jax.random.PRNGKey(5)
+    k = jax.random.normal(rng, (1, 1, 6, 4))
+    q = k[:, :, 4:, :]
+    out = dot_product_attention(q, k, k, causal=True)
+    want_row0 = dot_product_attention(q[:, :, :1], k[:, :, :5], k[:, :, :5])
+    np.testing.assert_allclose(np.asarray(out[:, :, 0]),
+                               np.asarray(want_row0[:, :, 0]), atol=1e-6)
+
+
+def test_key_padding_mask_ignores_pads():
+    rng = jax.random.PRNGKey(6)
+    mha = MultiHeadAttention(16, 2)
+    p = mha.init(rng)
+    x = jax.random.normal(rng, (2, 8, 16))
+    mask = jnp.ones((2, 8), bool).at[:, 6:].set(False)
+    y_masked = mha.forward(p, (x, x, mask))
+    # altering the padded positions must not change the output of valid ones
+    x2 = x.at[:, 6:].set(99.0)
+    y2 = mha.forward(p, (x2, x2, mask))
+    np.testing.assert_allclose(np.asarray(y_masked[:, :6]),
+                               np.asarray(y2[:, :6]), atol=1e-5)
+
+
+def test_encoder_mask_threading(rng):
+    enc = TransformerEncoder(2, 16, 2)
+    p = enc.init(rng)
+    x = jax.random.normal(rng, (2, 8, 16))
+    mask = jnp.ones((2, 8), bool).at[:, 5:].set(False)
+    y, m = enc.forward(p, (x, mask))
+    assert y.shape == x.shape and m is mask
+
+
+def test_bf16_logits_accumulate_fp32():
+    q = (jax.random.normal(jax.random.PRNGKey(7), (1, 1, 4, 8))
+         .astype(jnp.bfloat16))
+    out = dot_product_attention(q, q, q)
+    assert out.dtype == jnp.bfloat16
