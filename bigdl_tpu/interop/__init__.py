@@ -1,0 +1,11 @@
+from bigdl_tpu.interop.torchfile import (
+    load_t7, save_t7, TorchObject, load_torch_params,
+)
+from bigdl_tpu.interop.caffe import (
+    parse_caffemodel, parse_prototxt, load_caffe,
+)
+
+__all__ = [
+    "load_t7", "save_t7", "TorchObject", "load_torch_params",
+    "parse_caffemodel", "parse_prototxt", "load_caffe",
+]

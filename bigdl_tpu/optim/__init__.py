@@ -8,6 +8,7 @@ from bigdl_tpu.optim.validation import (
     ValidationMethod, ValidationResult, AccuracyResult, LossResult,
     Top1Accuracy, Top5Accuracy, Loss,
 )
+from bigdl_tpu.optim.lbfgs import LBFGS, line_search_wolfe
 from bigdl_tpu.optim.metrics import Metrics
 from bigdl_tpu.optim.optimizer import Optimizer, TrainedModel
 from bigdl_tpu.optim.validator import Validator

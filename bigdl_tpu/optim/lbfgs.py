@@ -1,0 +1,248 @@
+"""L-BFGS with strong-Wolfe line search (reference optim/LBFGS.scala:26-287,
+optim/LineSearch.scala `lswolfe`).
+
+The reference's L-BFGS consumes a ``feval: x -> (loss, grad)`` closure and
+iterates full-batch quasi-Newton steps with an optional Wolfe line search.
+That contract survives here unchanged — it is the one optimizer whose inner
+loop (line search with data-dependent trip count) should NOT live inside a
+single ``jit``: the *feval* is jitted (one XLA computation per probe), while
+the two-loop recursion and the line search run as cheap host code on flat
+vectors. History buffers (s, y, rho) are kept as device arrays so the
+two-loop recursion is a handful of fused dot/axpy kernels.
+
+API::
+
+    opt = LBFGS(max_iter=100, line_search=True)
+    params, losses = opt.optimize(feval, params)
+
+where ``feval(params) -> (loss, grads)`` over the full batch — typically
+``jax.jit(jax.value_and_grad(loss_fn))``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.flatten_util import ravel_pytree
+
+__all__ = ["LBFGS", "line_search_wolfe"]
+
+
+def _cubic_interpolate(x1, f1, g1, x2, f2, g2, bounds=None):
+    """Minimizer of the cubic through (x1,f1,g1),(x2,f2,g2); falls back to
+    bisection when the cubic has no minimum in the bracket (same fallback the
+    reference's lswolfe uses, optim/LineSearch.scala)."""
+    if bounds is not None:
+        lo, hi = bounds
+    else:
+        lo, hi = (x1, x2) if x1 <= x2 else (x2, x1)
+    d1 = g1 + g2 - 3 * (f1 - f2) / (x1 - x2)
+    d2_sq = d1 * d1 - g1 * g2
+    if d2_sq >= 0:
+        d2 = d2_sq ** 0.5
+        if x1 <= x2:
+            t = x2 - (x2 - x1) * ((g2 + d2 - d1) / (g2 - g1 + 2 * d2))
+        else:
+            t = x1 - (x1 - x2) * ((g1 + d2 - d1) / (g1 - g2 + 2 * d2))
+        return min(max(t, lo), hi)
+    return (lo + hi) / 2.0
+
+
+def line_search_wolfe(feval_dir: Callable[[float], tuple[float, float]],
+                      t: float, f0: float, g0: float,
+                      c1: float = 1e-4, c2: float = 0.9,
+                      tol_change: float = 1e-9, max_ls: int = 25):
+    """Strong-Wolfe line search along a fixed direction.
+
+    ``feval_dir(t) -> (f(x + t*d), f'(x + t*d)·d)``. Returns
+    ``(t, f_t, n_evals)`` satisfying sufficient decrease (c1) and curvature
+    (c2), the same conditions as the reference's ``lswolfe``
+    (optim/LineSearch.scala).
+    """
+    f_t, g_t = feval_dir(t)
+    n_evals = 1
+
+    # Bracketing phase.
+    t_prev, f_prev, g_prev = 0.0, f0, g0
+    bracket = None
+    done = False
+    while n_evals < max_ls:
+        if f_t > f0 + c1 * t * g0 or (n_evals > 1 and f_t >= f_prev):
+            bracket = (t_prev, f_prev, g_prev, t, f_t, g_t)
+            break
+        if abs(g_t) <= -c2 * g0:
+            done = True
+            break
+        if g_t >= 0:
+            bracket = (t, f_t, g_t, t_prev, f_prev, g_prev)
+            break
+        # expand
+        min_step = t + 0.01 * (t - t_prev)
+        max_step = t * 10
+        tmp = t
+        t = _cubic_interpolate(t_prev, f_prev, g_prev, t, f_t, g_t,
+                               bounds=(min_step, max_step))
+        t_prev, f_prev, g_prev = tmp, f_t, g_t
+        f_t, g_t = feval_dir(t)
+        n_evals += 1
+
+    if done or bracket is None:
+        return t, f_t, n_evals
+
+    # Zoom phase on the bracket.
+    t_lo, f_lo, g_lo, t_hi, f_hi, g_hi = bracket
+    insuf_progress = False
+    while n_evals < max_ls:
+        if abs(t_hi - t_lo) * abs(g0) < tol_change:
+            break
+        t = _cubic_interpolate(t_lo, f_lo, g_lo, t_hi, f_hi, g_hi)
+        # Guard against stagnation at the bracket edge (torch-style 0.1 eps
+        # nudge; keeps the zoom making progress on flat cubics).
+        eps = 0.1 * abs(t_hi - t_lo)
+        lo_b, hi_b = min(t_lo, t_hi), max(t_lo, t_hi)
+        if min(abs(t - lo_b), abs(hi_b - t)) < eps:
+            if insuf_progress or t >= hi_b or t <= lo_b:
+                t = hi_b - eps if abs(t - hi_b) < abs(t - lo_b) else lo_b + eps
+                insuf_progress = False
+            else:
+                insuf_progress = True
+        else:
+            insuf_progress = False
+        f_t, g_t = feval_dir(t)
+        n_evals += 1
+        if f_t > f0 + c1 * t * g0 or f_t >= f_lo:
+            t_hi, f_hi, g_hi = t, f_t, g_t
+        else:
+            if abs(g_t) <= -c2 * g0:
+                break
+            if g_t * (t_hi - t_lo) >= 0:
+                t_hi, f_hi, g_hi = t_lo, f_lo, g_lo
+            t_lo, f_lo, g_lo = t, f_t, g_t
+    return t, f_t, n_evals
+
+
+class LBFGS:
+    """Limited-memory BFGS (reference optim/LBFGS.scala:26-287).
+
+    Parameters mirror the reference's config Table: ``max_iter`` (maxIter),
+    ``max_eval`` (maxEval, default maxIter*1.25), ``tol_fun``/``tol_x``,
+    ``n_correction`` (history size), ``learning_rate``, and ``line_search``
+    (True => strong Wolfe, the reference's lswolfe; False => fixed step with
+    the first-iteration 1/||g||_1 scaling, LBFGS.scala's no-lineSearch branch).
+    """
+
+    def __init__(self, max_iter: int = 20, max_eval: Optional[int] = None,
+                 tol_fun: float = 1e-5, tol_x: float = 1e-9,
+                 n_correction: int = 100, learning_rate: float = 1.0,
+                 line_search: bool = True):
+        self.max_iter = max_iter
+        self.max_eval = max_eval if max_eval is not None else int(
+            max_iter * 1.25)
+        self.tol_fun = tol_fun
+        self.tol_x = tol_x
+        self.n_correction = n_correction
+        self.learning_rate = learning_rate
+        self.line_search = line_search
+
+    def optimize(self, feval: Callable[[Any], tuple[Any, Any]], params):
+        """Run up to max_iter L-BFGS iterations. Returns (params, losses)."""
+        x, unravel = ravel_pytree(params)
+        x = x.astype(jnp.float32)
+
+        def feval_flat(xf):
+            loss, grads = feval(unravel(xf))
+            gf, _ = ravel_pytree(grads)
+            return jnp.asarray(loss, jnp.float32), gf.astype(jnp.float32)
+
+        f, g = feval_flat(x)
+        losses = [float(f)]
+        n_eval = 1
+        if float(jnp.abs(g).max()) <= 1e-10:  # already at a critical point
+            return unravel(x), losses
+
+        s_hist: list[jax.Array] = []
+        y_hist: list[jax.Array] = []
+        rho_hist: list[float] = []
+        g_prev = None
+        t = self.learning_rate
+        h_diag = 1.0
+
+        for it in range(self.max_iter):
+            # ---- direction via two-loop recursion -------------------------
+            if g_prev is None:
+                d = -g
+            else:
+                y = g - g_prev
+                s = t * d
+                ys = float(jnp.dot(y, s))
+                if ys > 1e-10:  # curvature condition (LBFGS.scala history gate)
+                    if len(s_hist) == self.n_correction:
+                        s_hist.pop(0), y_hist.pop(0), rho_hist.pop(0)
+                    s_hist.append(s)
+                    y_hist.append(y)
+                    rho_hist.append(1.0 / ys)
+                    h_diag = ys / float(jnp.dot(y, y))
+                q = -g
+                alphas = []
+                for s_i, y_i, rho_i in zip(reversed(s_hist), reversed(y_hist),
+                                           reversed(rho_hist)):
+                    a_i = rho_i * float(jnp.dot(s_i, q))
+                    alphas.append(a_i)
+                    q = q - a_i * y_i
+                r = q * h_diag
+                for (s_i, y_i, rho_i), a_i in zip(
+                        zip(s_hist, y_hist, rho_hist), reversed(alphas)):
+                    b_i = rho_i * float(jnp.dot(y_i, r))
+                    r = r + (a_i - b_i) * s_i
+                d = r
+            g_prev = g
+
+            gtd = float(jnp.dot(g, d))
+            if gtd > -self.tol_x:  # not a descent direction
+                break
+
+            # ---- step size -----------------------------------------------
+            if it == 0:
+                t = min(1.0, 1.0 / float(jnp.abs(g).sum())) * self.learning_rate
+            else:
+                t = self.learning_rate
+
+            if self.line_search:
+                probe_cache: dict[str, Any] = {}
+
+                def feval_dir(tt):
+                    f_n, g_n = feval_flat(x + tt * d)
+                    probe_cache["t"], probe_cache["f"], probe_cache["g"] = (
+                        tt, f_n, g_n)
+                    return float(f_n), float(jnp.dot(g_n, d))
+
+                t, _, ls_evals = line_search_wolfe(
+                    feval_dir, t, float(f), gtd)
+                n_eval += ls_evals
+                x = x + t * d
+                if probe_cache.get("t") == t:  # reuse the accepted probe
+                    f_new, g_new = probe_cache["f"], probe_cache["g"]
+                else:
+                    f_new, g_new = feval_flat(x)
+                    n_eval += 1
+            else:
+                x = x + t * d
+                f_new, g_new = feval_flat(x)
+                n_eval += 1
+
+            # ---- convergence checks (LBFGS.scala tolFun/tolX/maxEval) -----
+            losses.append(float(f_new))
+            d_f = abs(float(f_new) - float(f))
+            f, g = f_new, g_new
+            if float(jnp.abs(g).max()) <= 1e-10:
+                break
+            if d_f < self.tol_fun:
+                break
+            if float(jnp.abs(t * d).max()) <= self.tol_x:
+                break
+            if n_eval >= self.max_eval:
+                break
+
+        return unravel(x), losses

@@ -1,0 +1,70 @@
+"""L-BFGS convergence tests (reference optim/LBFGSSpec.scala: optimize
+Rosenbrock to its known minimum; optim/LineSearch lswolfe behavior)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bigdl_tpu.optim import LBFGS
+
+
+def rosenbrock(x):
+    return jnp.sum(100.0 * (x[1:] - x[:-1] ** 2) ** 2 + (1.0 - x[:-1]) ** 2)
+
+
+def test_rosenbrock_with_wolfe():
+    feval = jax.jit(jax.value_and_grad(rosenbrock))
+    x0 = jnp.zeros(4)
+    opt = LBFGS(max_iter=100, max_eval=500, line_search=True)
+    x, losses = opt.optimize(lambda p: feval(p), x0)
+    assert losses[-1] < 1e-5
+    np.testing.assert_allclose(np.asarray(x), np.ones(4), atol=1e-2)
+
+
+def test_rosenbrock_fixed_step():
+    feval = jax.jit(jax.value_and_grad(rosenbrock))
+    x0 = jnp.zeros(2)
+    opt = LBFGS(max_iter=200, max_eval=1000, learning_rate=0.5,
+                line_search=False)
+    x, losses = opt.optimize(lambda p: feval(p), x0)
+    assert losses[-1] < losses[0]
+    assert losses[-1] < 1e-3
+
+
+def test_quadratic_pytree():
+    """Works on pytree params (a dict), like real model parameters."""
+    target = {"w": jnp.asarray([1.0, -2.0, 3.0]), "b": jnp.asarray(0.5)}
+
+    def loss(p):
+        return (jnp.sum((p["w"] - target["w"]) ** 2)
+                + (p["b"] - target["b"]) ** 2)
+
+    feval = jax.jit(jax.value_and_grad(loss))
+    p0 = {"w": jnp.zeros(3), "b": jnp.zeros(())}
+    opt = LBFGS(max_iter=50)
+    p, losses = opt.optimize(lambda q: feval(q), p0)
+    np.testing.assert_allclose(np.asarray(p["w"]), np.asarray(target["w"]),
+                               atol=1e-4)
+    np.testing.assert_allclose(float(p["b"]), 0.5, atol=1e-4)
+
+
+def test_linear_regression_model():
+    """L-BFGS on a tiny Linear model via the module system, full-batch."""
+    from bigdl_tpu import nn
+
+    rng = np.random.RandomState(0)
+    w_true = rng.randn(5, 3).astype(np.float32)
+    x = rng.randn(64, 5).astype(np.float32)
+    y = x @ w_true
+
+    lin = nn.Linear(5, 3)
+    params = lin.init(jax.random.PRNGKey(0))
+
+    def loss_fn(p):
+        pred = lin.forward(p, jnp.asarray(x))
+        return jnp.mean((pred - jnp.asarray(y)) ** 2)
+
+    feval = jax.jit(jax.value_and_grad(loss_fn))
+    opt = LBFGS(max_iter=100, max_eval=400)
+    params, losses = opt.optimize(lambda p: feval(p), params)
+    assert losses[-1] < 1e-6
