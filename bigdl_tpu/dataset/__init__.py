@@ -4,4 +4,5 @@ from bigdl_tpu.dataset.transformer import (
 from bigdl_tpu.dataset.dataset import (
     DataSet, LocalArrayDataSet, BatchDataSet, MiniBatch,
 )
-from bigdl_tpu.dataset import mnist, cifar, image, text
+from bigdl_tpu.dataset import mnist, cifar, image, text, native
+from bigdl_tpu.dataset.native import NativePrefetchDataSet

@@ -178,6 +178,10 @@ class _Reader:
             dtype = np.dtype(_STORAGE_DTYPES[name])
             size = self.read_long()
             data = self.f.read(size * dtype.itemsize)
+            if len(data) != size * dtype.itemsize:
+                # must raise here: a short buffer + the as_strided view in
+                # the tensor reader would read out-of-bounds memory
+                raise EOFError("truncated .t7 storage")
             arr = np.frombuffer(data, dtype=dtype).copy()
             self.memo[idx] = arr
             return arr

@@ -94,6 +94,7 @@ def line_search_wolfe(feval_dir: Callable[[float], tuple[float, float]],
     # Zoom phase on the bracket.
     t_lo, f_lo, g_lo, t_hi, f_hi, g_hi = bracket
     insuf_progress = False
+    satisfied = False
     while n_evals < max_ls:
         if abs(t_hi - t_lo) * abs(g0) < tol_change:
             break
@@ -116,10 +117,15 @@ def line_search_wolfe(feval_dir: Callable[[float], tuple[float, float]],
             t_hi, f_hi, g_hi = t, f_t, g_t
         else:
             if abs(g_t) <= -c2 * g0:
+                satisfied = True
                 break
             if g_t * (t_hi - t_lo) >= 0:
                 t_hi, f_hi, g_hi = t_lo, f_lo, g_lo
             t_lo, f_lo, g_lo = t, f_t, g_t
+    if not satisfied:
+        # zoom exhausted without meeting Wolfe: commit the best point in
+        # hand (the low bracket endpoint), never the last rejected probe
+        t, f_t = t_lo, f_lo
     return t, f_t, n_evals
 
 
@@ -184,17 +190,21 @@ class LBFGS:
                     y_hist.append(y)
                     rho_hist.append(1.0 / ys)
                     h_diag = ys / float(jnp.dot(y, y))
+                # two-loop recursion: a_i/b_i stay 0-d device arrays so the
+                # whole direction computation is dispatched without a single
+                # host<->device sync (syncs happen only at the per-iteration
+                # convergence checks below)
                 q = -g
                 alphas = []
                 for s_i, y_i, rho_i in zip(reversed(s_hist), reversed(y_hist),
                                            reversed(rho_hist)):
-                    a_i = rho_i * float(jnp.dot(s_i, q))
+                    a_i = rho_i * jnp.dot(s_i, q)
                     alphas.append(a_i)
                     q = q - a_i * y_i
                 r = q * h_diag
                 for (s_i, y_i, rho_i), a_i in zip(
                         zip(s_hist, y_hist, rho_hist), reversed(alphas)):
-                    b_i = rho_i * float(jnp.dot(y_i, r))
+                    b_i = rho_i * jnp.dot(y_i, r)
                     r = r + (a_i - b_i) * s_i
                 d = r
             g_prev = g

@@ -1,0 +1,335 @@
+// bigdl-tpu native runtime: multi-threaded prefetching input pipeline +
+// binary dataset readers.
+//
+// This is the TPU-native equivalent of the reference's multi-threaded
+// ImageNet input path (image/MTLabeledBGRImgToBatch.scala:48-133): there,
+// coreNumber cloned transformer pipelines race on an atomic batch-position
+// counter to decode/augment into one shared batch buffer. Here, worker
+// threads claim batch *tickets* from an atomic counter, run
+// crop/flip/normalize over raw uint8 samples, and push finished float
+// batches into a bounded queue that the host training loop pops while the
+// TPU computes — classic double-buffering so the MXU never waits on the
+// input pipeline (SURVEY.md §7 "Input pipeline throughput").
+//
+// Exposed as a plain C ABI for ctypes (no pybind11 in this image).
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <deque>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <random>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct Batch {
+    long index;
+    std::vector<float> images;
+    std::vector<int32_t> labels;
+};
+
+struct Pipeline {
+    // dataset (borrowed pointers — caller keeps them alive)
+    const uint8_t* images = nullptr;
+    const int32_t* labels = nullptr;
+    int64_t n = 0;
+    int h = 0, w = 0, c = 0;
+
+    // batch/augment config
+    int batch = 0;
+    int crop_h = 0, crop_w = 0;
+    bool random_crop = false;
+    bool hflip = false;
+    std::vector<float> mean, stdev;  // per-channel
+    bool shuffle = true;
+    bool loop = false;
+    uint64_t seed = 0;
+
+    // runtime
+    long batches_per_epoch = 0;
+    std::atomic<long> ticket{0};
+    std::vector<std::thread> workers;
+    size_t queue_cap = 4;
+    // finished batches keyed by ticket: delivery is strictly in ticket
+    // order (epoch boundaries and eval sample order must be exact even
+    // though workers complete out of order)
+    std::map<long, Batch> ready;
+    std::mutex mu;
+    std::condition_variable cv_space, cv_ready;
+    bool stopping = false;
+    long delivered = 0;  // == next ticket to hand to the consumer
+
+    // per-epoch permutations (epoch -> shuffled index array); workers near
+    // an epoch boundary may need two epochs' perms concurrently
+    std::mutex perm_mu;
+    std::map<long, std::shared_ptr<std::vector<uint32_t>>> perms;
+
+    std::shared_ptr<std::vector<uint32_t>> perm_for(long epoch) {
+        std::lock_guard<std::mutex> lk(perm_mu);
+        auto it = perms.find(epoch);
+        if (it != perms.end()) return it->second;
+        auto p = std::make_shared<std::vector<uint32_t>>(n);
+        for (int64_t i = 0; i < n; ++i) (*p)[i] = (uint32_t)i;
+        if (shuffle) {
+            std::mt19937_64 rng(seed * 0x9E3779B97F4A7C15ULL + (uint64_t)epoch);
+            for (int64_t i = n - 1; i > 0; --i) {
+                std::uniform_int_distribution<int64_t> d(0, i);
+                std::swap((*p)[i], (*p)[d(rng)]);
+            }
+        }
+        perms[epoch] = p;
+        // prune stale epochs (keep a small sliding window)
+        while (perms.size() > 3) perms.erase(perms.begin());
+        return p;
+    }
+};
+
+// Fill one sample slot: crop (random or center), optional horizontal flip,
+// per-channel (x - mean) / std normalization, uint8 HWC -> float HWC.
+void fill_sample(const Pipeline* p, const uint8_t* src, float* dst,
+                 std::mt19937_64& rng) {
+    const int ch = p->crop_h, cw = p->crop_w, c = p->c;
+    int off_h = (p->h - ch) / 2, off_w = (p->w - cw) / 2;
+    if (p->random_crop && (p->h > ch || p->w > cw)) {
+        if (p->h > ch) {
+            std::uniform_int_distribution<int> d(0, p->h - ch);
+            off_h = d(rng);
+        }
+        if (p->w > cw) {
+            std::uniform_int_distribution<int> d(0, p->w - cw);
+            off_w = d(rng);
+        }
+    }
+    bool flip = false;
+    if (p->hflip) {
+        std::uniform_int_distribution<int> d(0, 1);
+        flip = d(rng) == 1;
+    }
+    const float* mean = p->mean.data();
+    const float* stdev = p->stdev.data();
+    for (int y = 0; y < ch; ++y) {
+        const uint8_t* row = src + ((int64_t)(y + off_h) * p->w + off_w) * c;
+        float* out_row = dst + (int64_t)y * cw * c;
+        for (int x = 0; x < cw; ++x) {
+            int sx = flip ? (cw - 1 - x) : x;
+            const uint8_t* px = row + (int64_t)sx * c;
+            float* out = out_row + (int64_t)x * c;
+            for (int k = 0; k < c; ++k)
+                out[k] = ((float)px[k] - mean[k]) / stdev[k];
+        }
+    }
+}
+
+void worker_main(Pipeline* p) {
+    const int64_t sample_elems = (int64_t)p->crop_h * p->crop_w * p->c;
+    for (;;) {
+        long t = p->ticket.fetch_add(1);
+        if (!p->loop && t >= p->batches_per_epoch) break;
+        long epoch = t / p->batches_per_epoch;
+        long b = t % p->batches_per_epoch;
+        auto perm = p->perm_for(epoch);
+
+        Batch out;
+        out.index = t;
+        out.images.resize((size_t)p->batch * sample_elems);
+        out.labels.resize(p->batch);
+        // ticket-seeded rng: augmentation is reproducible regardless of
+        // which thread runs the ticket
+        std::mt19937_64 rng(p->seed ^ (0xD1B54A32D192ED03ULL * (uint64_t)(t + 1)));
+        for (int i = 0; i < p->batch; ++i) {
+            uint32_t idx = (*perm)[(size_t)b * p->batch + i];
+            const uint8_t* src =
+                p->images + (int64_t)idx * p->h * p->w * p->c;
+            fill_sample(p, src, out.images.data() + (int64_t)i * sample_elems,
+                        rng);
+            out.labels[i] = p->labels ? p->labels[idx] : 0;
+        }
+
+        std::unique_lock<std::mutex> lk(p->mu);
+        // the batch the consumer is waiting for must always be insertable,
+        // even when the buffer is formally full, or the pipeline deadlocks
+        // (consumer waits for ticket k while k's producer waits for space)
+        long my_index = out.index;
+        p->cv_space.wait(lk, [p, my_index] {
+            return p->stopping || p->ready.size() < p->queue_cap ||
+                   my_index == p->delivered;
+        });
+        if (p->stopping) break;
+        p->ready.emplace(my_index, std::move(out));
+        p->cv_ready.notify_all();
+    }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Create a pipeline over an in-memory uint8 image array [n, h, w, c] and
+// int32 labels [n]. Caller keeps images/labels alive until destroy.
+// loop=0: exactly one epoch of batches then next() returns -1.
+// loop=1: endless (train mode; reshuffles each epoch, reference
+//         CachedDistriDataSet train iterator semantics).
+void* bt_pipeline_create(const uint8_t* images, int64_t n, int h, int w,
+                         int c, const int32_t* labels, int batch, int crop_h,
+                         int crop_w, int random_crop, int hflip,
+                         const float* mean, const float* stdev, int shuffle,
+                         int loop, uint64_t seed, int n_threads,
+                         int queue_cap) {
+    if (!images || n <= 0 || batch <= 0 || crop_h <= 0 || crop_w <= 0 ||
+        crop_h > h || crop_w > w || n < batch)
+        return nullptr;
+    auto* p = new Pipeline();
+    p->images = images;
+    p->labels = labels;
+    p->n = n;
+    p->h = h;
+    p->w = w;
+    p->c = c;
+    p->batch = batch;
+    p->crop_h = crop_h;
+    p->crop_w = crop_w;
+    p->random_crop = random_crop != 0;
+    p->hflip = hflip != 0;
+    if (mean) p->mean.assign(mean, mean + c);
+    else p->mean.assign(c, 0.f);
+    if (stdev) p->stdev.assign(stdev, stdev + c);
+    else p->stdev.assign(c, 1.f);
+    p->shuffle = shuffle != 0;
+    p->loop = loop != 0;
+    p->seed = seed;
+    p->batches_per_epoch = n / batch;  // drop remainder: static XLA shapes
+    p->queue_cap = queue_cap > 0 ? (size_t)queue_cap : 4;
+    int nt = n_threads > 0 ? n_threads : 4;
+    for (int i = 0; i < nt; ++i)
+        p->workers.emplace_back(worker_main, p);
+    return p;
+}
+
+long bt_pipeline_batches_per_epoch(void* h) {
+    return h ? ((Pipeline*)h)->batches_per_epoch : 0;
+}
+
+// Pop the next finished batch into caller buffers
+// (out_images: batch*crop_h*crop_w*c floats; out_labels: batch int32).
+// Returns the batch ticket (>=0), or -1 when a non-loop pipeline is
+// exhausted. Blocks while workers fill the queue.
+long bt_pipeline_next(void* h, float* out_images, int32_t* out_labels) {
+    auto* p = (Pipeline*)h;
+    if (!p) return -1;
+    std::unique_lock<std::mutex> lk(p->mu);
+    if (!p->loop && p->delivered >= p->batches_per_epoch) return -1;
+    // wait for the *in-order* next batch, not just any finished one
+    p->cv_ready.wait(lk, [p] {
+        return p->stopping || p->ready.count(p->delivered) > 0;
+    });
+    if (p->stopping && p->ready.count(p->delivered) == 0) return -1;
+    auto it = p->ready.find(p->delivered);
+    Batch b = std::move(it->second);
+    p->ready.erase(it);
+    p->delivered++;
+    p->cv_space.notify_all();  // wake the producer of the new head ticket
+    lk.unlock();
+    std::memcpy(out_images, b.images.data(),
+                b.images.size() * sizeof(float));
+    if (out_labels)
+        std::memcpy(out_labels, b.labels.data(),
+                    b.labels.size() * sizeof(int32_t));
+    return b.index;
+}
+
+void bt_pipeline_destroy(void* h) {
+    auto* p = (Pipeline*)h;
+    if (!p) return;
+    {
+        std::lock_guard<std::mutex> lk(p->mu);
+        p->stopping = true;
+    }
+    p->cv_space.notify_all();
+    p->cv_ready.notify_all();
+    for (auto& t : p->workers) t.join();
+    delete p;
+}
+
+// ---------------------------------------------------------------- readers
+
+// Read an MNIST idx file (the raw ubyte format the reference's
+// models/lenet/Utils.scala parses). Returns element count and fills dims;
+// data is malloc'd into *out (caller frees with bt_free).
+int64_t bt_read_idx(const char* path, uint8_t** out, int64_t* dims,
+                    int* ndim) {
+    FILE* f = fopen(path, "rb");
+    if (!f) return -1;
+    uint8_t magic[4];
+    // header: 0x00 0x00 <dtype> <ndim>; only ubyte (0x08) is supported and
+    // ndim is capped at the caller's 8-slot dims buffer — both are
+    // file-controlled bytes and must be validated, not trusted
+    if (fread(magic, 1, 4, f) != 4 || magic[0] != 0 || magic[1] != 0 ||
+        magic[2] != 0x08 || magic[3] == 0 || magic[3] > 8) {
+        fclose(f);
+        return -1;
+    }
+    int nd = magic[3];
+    int64_t total = 1;
+    for (int i = 0; i < nd; ++i) {
+        uint8_t b[4];
+        if (fread(b, 1, 4, f) != 4) {
+            fclose(f);
+            return -1;
+        }
+        dims[i] = ((int64_t)b[0] << 24) | (b[1] << 16) | (b[2] << 8) | b[3];
+        if (dims[i] <= 0 || total > (int64_t)1 << 40) {
+            fclose(f);
+            return -1;
+        }
+        total *= dims[i];
+    }
+    *ndim = nd;
+    *out = (uint8_t*)malloc((size_t)total);
+    if (!*out) {
+        fclose(f);
+        return -1;
+    }
+    int64_t got = (int64_t)fread(*out, 1, (size_t)total, f);
+    fclose(f);
+    if (got != total) {
+        free(*out);
+        *out = nullptr;
+        return -1;
+    }
+    return total;
+}
+
+// Read one CIFAR-10 .bin shard (reference dataset format: records of
+// 1 label byte + 3072 CHW pixel bytes). Fills images as NHWC uint8.
+int64_t bt_read_cifar10(const char* path, uint8_t* images, int32_t* labels,
+                        int64_t max_records) {
+    FILE* f = fopen(path, "rb");
+    if (!f) return -1;
+    const int hw = 32 * 32;
+    std::vector<uint8_t> rec(1 + 3 * hw);
+    int64_t count = 0;
+    while (count < max_records &&
+           fread(rec.data(), 1, rec.size(), f) == rec.size()) {
+        labels[count] = rec[0];
+        uint8_t* dst = images + count * (int64_t)(3 * hw);
+        // CHW (RGB planes) -> HWC
+        for (int i = 0; i < hw; ++i) {
+            dst[i * 3 + 0] = rec[1 + i];
+            dst[i * 3 + 1] = rec[1 + hw + i];
+            dst[i * 3 + 2] = rec[1 + 2 * hw + i];
+        }
+        ++count;
+    }
+    fclose(f);
+    return count;
+}
+
+void bt_free(void* p) { free(p); }
+
+}  // extern "C"
