@@ -1,0 +1,8 @@
+"""Command-line Train/Test entry points per model family
+(reference bigdl/models/*/{Train,Test}.scala scopt CLIs + the perf harness
+models/utils/DistriOptimizerPerf.scala). Run as, e.g.::
+
+    python -m bigdl_tpu.cli.lenet train -f /data/mnist -b 128 --maxEpoch 5
+    python -m bigdl_tpu.cli.lenet test -f /data/mnist --model ckpt_dir
+    python -m bigdl_tpu.cli.perf -m inception_v1 -b 32 -i 10
+"""

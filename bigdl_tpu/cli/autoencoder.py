@@ -1,0 +1,44 @@
+"""Autoencoder on MNIST (reference models/autoencoder/Train.scala: MSE
+reconstruction of normalized grey images, Adagrad in the reference's
+example config; SGD+momentum default here with --adagrad to match)."""
+
+from __future__ import annotations
+
+import argparse
+
+from bigdl_tpu.cli import common
+
+
+def main(argv=None):
+    common.setup_logging()
+    p = argparse.ArgumentParser("bigdl-tpu autoencoder")
+    sub = p.add_subparsers(dest="cmd", required=True)
+    tr = sub.add_parser("train")
+    common.add_train_args(tr)
+    tr.add_argument("--adagrad", action="store_true")
+    args = p.parse_args(argv)
+
+    import numpy as np
+
+    from bigdl_tpu import nn
+    from bigdl_tpu.dataset import BatchDataSet
+    from bigdl_tpu.dataset.mnist import load_mnist
+    from bigdl_tpu.models import autoencoder
+    from bigdl_tpu.optim import Adagrad
+
+    xtr, _ = load_mnist(args.folder, train=True)
+    x = xtr.astype(np.float32) / 255.0
+    # target = flattened input (reconstruction); BatchDataSet keeps the
+    # feature/target rows aligned under shuffling
+    train = BatchDataSet(x, x.reshape(len(x), -1), args.batchSize,
+                         shuffle=True)
+
+    model = autoencoder(32)
+    method = Adagrad(learning_rate=args.learningRate) if args.adagrad else None
+    opt = common.build_optimizer(model, train, nn.MSECriterion(), args,
+                                 optim_method=method)
+    return opt.optimize()
+
+
+if __name__ == "__main__":
+    main()

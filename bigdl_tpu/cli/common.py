@@ -1,0 +1,118 @@
+"""Shared CLI wiring (reference models/*/Utils.scala scopt parsers +
+models/inception/Options.scala — one typed flag surface instead of the
+reference's env-var / system-property / scopt triple, SURVEY.md §5
+"Config / flag system")."""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+from typing import Optional, Sequence
+
+import numpy as np
+
+
+def add_train_args(p: argparse.ArgumentParser) -> None:
+    """The reference's common knobs (-f, -b, --learningRate, --maxEpoch,
+    --checkpoint, --model/--state resume; models/lenet/Utils.scala flags)."""
+    p.add_argument("-f", "--folder", default="./", help="data folder")
+    p.add_argument("-b", "--batchSize", type=int, default=128)
+    p.add_argument("--learningRate", type=float, default=0.05)
+    p.add_argument("--learningRateDecay", type=float, default=0.0)
+    p.add_argument("--weightDecay", type=float, default=0.0)
+    p.add_argument("--momentum", type=float, default=0.9)
+    p.add_argument("--maxEpoch", type=int, default=5)
+    p.add_argument("--checkpoint", default=None,
+                   help="dir for model.<n>/state.<n> snapshots")
+    p.add_argument("--model", default=None,
+                   help="checkpoint dir to resume model from")
+    p.add_argument("--overWriteCheckpoint", action="store_true")
+    p.add_argument("--dataParallel", action="store_true",
+                   help="shard the batch over all visible devices")
+    p.add_argument("--seed", type=int, default=42)
+    p.add_argument("--logEvery", type=int, default=10)
+
+
+def add_test_args(p: argparse.ArgumentParser) -> None:
+    p.add_argument("-f", "--folder", default="./")
+    p.add_argument("-b", "--batchSize", type=int, default=128)
+    p.add_argument("--model", required=True, help="checkpoint dir or file")
+
+
+def setup_logging() -> None:
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(levelname)s %(name)s - %(message)s")
+
+
+def build_strategy(args):
+    """DataParallel over every visible device when requested (the
+    reference's Engine.init(node, cores) + DistriOptimizer path)."""
+    if not getattr(args, "dataParallel", False):
+        return None
+    import jax
+
+    from bigdl_tpu.parallel import DataParallel, make_mesh
+
+    n = len(jax.devices())
+    if n <= 1:
+        return None
+    return DataParallel(make_mesh({"data": n}))
+
+
+def build_optimizer(model, dataset, criterion, args, schedule=None,
+                    optim_method=None):
+    from bigdl_tpu.optim import Optimizer, SGD, Trigger
+    from bigdl_tpu.optim.schedules import Default
+
+    if optim_method is None:
+        optim_method = SGD(
+            learning_rate=args.learningRate,
+            weight_decay=args.weightDecay,
+            momentum=args.momentum,
+            schedule=schedule if schedule is not None
+            else Default(args.learningRateDecay),
+        )
+    opt = Optimizer(model, dataset, criterion,
+                    optim_method=optim_method,
+                    end_when=Trigger.max_epoch(args.maxEpoch),
+                    strategy=build_strategy(args), seed=args.seed,
+                    log_every=args.logEvery)
+    if args.checkpoint:
+        os.makedirs(args.checkpoint, exist_ok=True)
+        opt.set_checkpoint(Trigger.every_epoch(), args.checkpoint)
+    if args.model:
+        opt.resume(args.model)
+    return opt
+
+
+def load_trained(model, path: str):
+    """Load params/mod_state from a checkpoint dir (newest model.<n>) or a
+    single saved file (reference Module.load, nn/Module.scala:28)."""
+    from bigdl_tpu.utils.file import load_pytree, latest_checkpoint
+
+    if os.path.isdir(path):
+        p = latest_checkpoint(path, "model.")
+        if p is None:
+            raise FileNotFoundError(f"no model.<n> checkpoint in {path}")
+    else:
+        p = path
+    blob = load_pytree(p)
+    return blob["params"], blob["mod_state"]
+
+
+def evaluate(model, params, mod_state, dataset,
+             methods: Optional[Sequence] = None):
+    """Standalone evaluation (reference optim/Validator.scala +
+    models/*/Test.scala)."""
+    from bigdl_tpu.optim import Top1Accuracy
+    from bigdl_tpu.optim.validator import build_eval_fn, run_evaluation
+
+    methods = list(methods) if methods else [Top1Accuracy()]
+    eval_fn = build_eval_fn(model, methods, None)
+    results = run_evaluation(eval_fn, dataset, methods, params, mod_state,
+                             None)
+    for m, r in zip(methods, results):
+        print(f"{m.name} is {r!r}")
+    return results

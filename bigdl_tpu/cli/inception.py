@@ -1,0 +1,80 @@
+"""Inception-v1/v2 on ImageNet-style image folders (reference
+models/inception/{Train,Test,Options}.scala: SeqFile pipeline + Poly LR
+schedule Train.scala:77-83; here the input is a label-by-folder image tree
+streamed through ImageFolderDataSet)."""
+
+from __future__ import annotations
+
+import argparse
+
+from bigdl_tpu.cli import common
+
+# ImageNet BGR-ish channel stats the reference pipeline bakes in
+_MEAN = (123.0, 117.0, 104.0)
+_STD = (58.4, 57.1, 57.4)
+
+
+def _datasets(folder: str, batch: int, classes_expected: int):
+    import os
+
+    from bigdl_tpu.dataset.folder import ImageFolderDataSet
+
+    train = ImageFolderDataSet(os.path.join(folder, "train"), batch,
+                               size=(224, 224), train=True,
+                               mean=_MEAN, std=_STD)
+    vdir = os.path.join(folder, "val")
+    val = (ImageFolderDataSet(vdir, batch, size=(224, 224),
+                              mean=_MEAN, std=_STD)
+           if os.path.isdir(vdir) else None)
+    return train, val
+
+
+def main(argv=None):
+    common.setup_logging()
+    p = argparse.ArgumentParser("bigdl-tpu inception")
+    sub = p.add_subparsers(dest="cmd", required=True)
+    tr = sub.add_parser("train")
+    common.add_train_args(tr)
+    tr.add_argument("--modelName", choices=["inception_v1", "inception_v2"],
+                    default="inception_v1")
+    tr.add_argument("--classNum", type=int, default=1000)
+    tr.add_argument("--maxIteration", type=int, default=62000)
+    te = sub.add_parser("test")
+    common.add_test_args(te)
+    te.add_argument("--modelName", choices=["inception_v1", "inception_v2"],
+                    default="inception_v1")
+    te.add_argument("--classNum", type=int, default=1000)
+    args = p.parse_args(argv)
+
+    from bigdl_tpu import nn
+    from bigdl_tpu.models import inception_v1_no_aux, inception_v2
+    from bigdl_tpu.optim import SGD, Top1Accuracy, Top5Accuracy, Trigger
+    from bigdl_tpu.optim.schedules import Poly
+
+    build = (inception_v1_no_aux if args.modelName == "inception_v1"
+             else inception_v2)
+    model = build(args.classNum)
+
+    if args.cmd == "train":
+        train, val = _datasets(args.folder, args.batchSize, args.classNum)
+        # reference hyperparams: lr 0.0898, Poly(0.5, 62000)
+        method = SGD(learning_rate=args.learningRate,
+                     schedule=Poly(0.5, args.maxIteration))
+        opt = common.build_optimizer(model, train, nn.ClassNLLCriterion(),
+                                     args, optim_method=method)
+        if val is not None:
+            opt.set_validation(Trigger.every_epoch(), val,
+                               [Top1Accuracy(), Top5Accuracy()])
+        return opt.optimize()
+    params, mod_state = common.load_trained(model, args.model)
+    _, val = _datasets(args.folder, args.batchSize, args.classNum)
+    if val is None:
+        raise FileNotFoundError(
+            f"no val/ directory under {args.folder} — `inception test` "
+            f"needs {args.folder}/val/<class>/*.jpg")
+    return common.evaluate(model, params, mod_state, val,
+                           [Top1Accuracy(), Top5Accuracy()])
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,54 @@
+"""LeNet-5 on MNIST (reference models/lenet/{Train,Test}.scala:
+GreyImgNormalizer(trainMean, trainStd) -> GreyImgToBatch -> SGD ->
+Top1 validation)."""
+
+from __future__ import annotations
+
+import argparse
+
+from bigdl_tpu.cli import common
+
+
+def _one_split(folder: str, batch: int, train_split: bool):
+    import numpy as np
+
+    from bigdl_tpu.dataset import BatchDataSet
+    from bigdl_tpu.dataset.mnist import load_mnist, TRAIN_MEAN, TRAIN_STD
+
+    x, y = load_mnist(folder, train=train_split)
+    xn = ((x.astype(np.float32) / 255.0) - TRAIN_MEAN) / TRAIN_STD
+    return BatchDataSet(xn, y, batch, shuffle=train_split)
+
+
+def _datasets(folder: str, batch: int):
+    return _one_split(folder, batch, True), _one_split(folder, batch, False)
+
+
+def main(argv=None):
+    common.setup_logging()
+    p = argparse.ArgumentParser("bigdl-tpu lenet")
+    sub = p.add_subparsers(dest="cmd", required=True)
+    tr = sub.add_parser("train")
+    common.add_train_args(tr)
+    te = sub.add_parser("test")
+    common.add_test_args(te)
+    args = p.parse_args(argv)
+
+    from bigdl_tpu import nn
+    from bigdl_tpu.models import lenet5
+    from bigdl_tpu.optim import Top1Accuracy, Trigger
+
+    model = lenet5(10)
+    if args.cmd == "train":
+        train, test = _datasets(args.folder, args.batchSize)
+        opt = common.build_optimizer(model, train, nn.ClassNLLCriterion(),
+                                     args)
+        opt.set_validation(Trigger.every_epoch(), test, [Top1Accuracy()])
+        return opt.optimize()
+    params, mod_state = common.load_trained(model, args.model)
+    test = _one_split(args.folder, args.batchSize, False)
+    return common.evaluate(model, params, mod_state, test)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,168 @@
+"""Synthetic-data training throughput harness (reference
+models/utils/DistriOptimizerPerf.scala:35-150 / LocalOptimizerPerf.scala —
+constant/random synthetic input, models inception/vgg/resnet, reports the
+canonical records/second; extended with MFU, which the reference lacks but
+the BASELINE north-star requires).
+
+    python -m bigdl_tpu.cli.perf -m resnet50 -b 128 -i 20 --dataType constant
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+
+_PEAK_FLOPS = {
+    # bf16 peak per chip (public figures); used for the MFU estimate
+    "tpu v4": 275e12,
+    "tpu v5e": 197e12,
+    "tpu v5p": 459e12,
+    "tpu v6e": 918e12,
+    "cpu": 1e12,  # nominal, so MFU stays defined in CPU test runs
+}
+
+
+def _peak_flops(device) -> float:
+    kind = getattr(device, "device_kind", "cpu").lower()
+    for k, v in _PEAK_FLOPS.items():
+        if k.replace("tpu ", "") in kind.replace(" ", "").lower():
+            return v
+    return _PEAK_FLOPS["cpu"]
+
+
+def build_model(name: str, class_num: int = 1000):
+    from bigdl_tpu import models
+
+    table = {
+        "inception_v1": lambda: models.inception_v1_no_aux(class_num),
+        "inception_v2": lambda: models.inception_v2(class_num),
+        "vgg16": lambda: models.vgg16(class_num),
+        "vgg19": lambda: models.vgg19(class_num),
+        "alexnet": lambda: models.alexnet(class_num),
+        "resnet50": lambda: models.resnet50(class_num),
+        "lenet5": lambda: models.lenet5(10),
+    }
+    if name not in table:
+        raise SystemExit(f"unknown model {name}; choose from {list(table)}")
+    size = {"lenet5": (28, 28, 1)}.get(name, (224, 224, 3))
+    return table[name](), size
+
+
+def run(model_name: str, batch: int, iterations: int, data_type: str,
+        use_bf16: bool = True, data_parallel: bool = False):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from bigdl_tpu import nn
+    from bigdl_tpu.optim import SGD
+
+    model, in_shape = build_model(model_name)
+    crit = nn.ClassNLLCriterion()
+    opt = SGD(learning_rate=0.01, momentum=0.9)
+
+    on_tpu = jax.default_backend() == "tpu"
+    dtype = jnp.bfloat16 if (use_bf16 and on_tpu) else jnp.float32
+
+    rng = np.random.RandomState(0)
+    if data_type == "constant":
+        x_host = np.ones((batch, *in_shape), np.float32)
+    else:
+        x_host = rng.randn(batch, *in_shape).astype(np.float32)
+    y_host = rng.randint(0, 1000 if in_shape[0] > 30 else 10,
+                         batch).astype(np.int32)
+
+    params = model.init(jax.random.PRNGKey(0))
+    mod_state = model.init_state()
+    opt_state = opt.init(params)
+
+    strategy = None
+    if data_parallel and len(jax.devices()) > 1:
+        from bigdl_tpu.parallel import DataParallel, make_mesh
+
+        strategy = DataParallel(make_mesh({"data": len(jax.devices())}))
+        params, mod_state, opt_state = strategy.place(
+            params, mod_state, opt_state)
+
+    def train_step(params, mod_state, opt_state, x, y, rng):
+        def loss_fn(p):
+            out, ms = model.apply(p, mod_state, x.astype(dtype),
+                                  training=True, rng=rng)
+            return crit(out.astype(jnp.float32), y), ms
+
+        (loss, ms), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        if strategy is not None:
+            grads, loss = strategy.reduce_grads(grads, loss)
+        new_p, new_o = opt.update(grads, opt_state, params)
+        return new_p, ms, new_o, loss
+
+    if strategy is not None:
+        step = strategy.compile_step(train_step)
+        x, y = strategy.shard_batch(x_host, y_host)
+    else:
+        step = jax.jit(train_step, donate_argnums=(0, 1, 2))
+        x, y = jnp.asarray(x_host), jnp.asarray(y_host)
+
+    k = jax.random.PRNGKey(1)
+    # FLOPs estimate for MFU, read from the compiled HLO of the same jit
+    # wrapper that runs the benchmark (one XLA compile total)
+    step_flops = 0.0
+    try:
+        cost = step.lower(params, mod_state, opt_state, x, y,
+                          k).compile().cost_analysis()
+        if isinstance(cost, (list, tuple)):  # older jax returns [dict]
+            cost = cost[0] if cost else {}
+        step_flops = float(cost.get("flops", 0.0) or 0.0)
+    except Exception:
+        pass
+
+    params, mod_state, opt_state, loss = step(params, mod_state, opt_state,
+                                              x, y, k)
+    jax.block_until_ready(loss)  # compile + warmup
+
+    t0 = time.perf_counter()
+    for _ in range(iterations):
+        params, mod_state, opt_state, loss = step(params, mod_state,
+                                                  opt_state, x, y, k)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+
+    ips = batch * iterations / dt
+    n_dev = len(jax.devices()) if strategy is not None else 1
+    peak = _peak_flops(jax.devices()[0]) * n_dev
+    mfu = (step_flops * iterations / dt) / peak if step_flops else None
+    out = {
+        "model": model_name,
+        "batch": batch,
+        "iterations": iterations,
+        "seconds": round(dt, 4),
+        "records_per_second": round(ips, 2),
+        "images_per_second_per_chip": round(ips / n_dev, 2),
+        "dtype": str(dtype.__name__ if hasattr(dtype, "__name__")
+                     else dtype),
+        "mfu": round(mfu, 4) if mfu is not None else None,
+        "device": getattr(jax.devices()[0], "device_kind", "unknown"),
+    }
+    print(json.dumps(out))
+    return out
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser("bigdl-tpu perf")
+    p.add_argument("-m", "--model", default="inception_v1")
+    p.add_argument("-b", "--batchSize", type=int, default=32)
+    p.add_argument("-i", "--iteration", type=int, default=10)
+    p.add_argument("--dataType", choices=["constant", "random"],
+                   default="constant")
+    p.add_argument("--f32", action="store_true",
+                   help="disable bf16 compute")
+    p.add_argument("--dataParallel", action="store_true")
+    args = p.parse_args(argv)
+    run(args.model, args.batchSize, args.iteration, args.dataType,
+        use_bf16=not args.f32, data_parallel=args.dataParallel)
+
+
+if __name__ == "__main__":
+    main()

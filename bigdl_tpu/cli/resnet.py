@@ -1,0 +1,56 @@
+"""ResNet on CIFAR-10 (reference models/resnet/{Train,Utils}.scala:
+depth-20/32/44/56/110 with basic blocks, momentum 0.9, weight decay 1e-4,
+nesterov; reference default optnet memory sharing is XLA's job here)."""
+
+from __future__ import annotations
+
+import argparse
+
+from bigdl_tpu.cli import common
+from bigdl_tpu.cli.vgg import _datasets, _one_split
+
+
+def main(argv=None):
+    common.setup_logging()
+    p = argparse.ArgumentParser("bigdl-tpu resnet")
+    sub = p.add_subparsers(dest="cmd", required=True)
+    tr = sub.add_parser("train")
+    common.add_train_args(tr)
+    tr.add_argument("--depth", type=int, default=20)
+    # reference resnet recipe defaults (an explicit --weightDecay 0 still
+    # disables decay; only the *default* changes here)
+    tr.set_defaults(weightDecay=1e-4)
+    te = sub.add_parser("test")
+    common.add_test_args(te)
+    te.add_argument("--depth", type=int, default=20)
+    args = p.parse_args(argv)
+
+    from bigdl_tpu import nn
+    from bigdl_tpu.models import resnet_cifar
+    from bigdl_tpu.optim import SGD, Top1Accuracy, Trigger
+    from bigdl_tpu.optim.schedules import EpochSchedule, Regime
+
+    model = resnet_cifar(args.depth, 10)
+    if args.cmd == "train":
+        train, test = _datasets(args.folder, args.batchSize, train_aug=True)
+        # reference resnet training regime: lr drops at epochs 81/122
+        sched = EpochSchedule([
+            Regime(1, 80, {"learning_rate": args.learningRate}),
+            Regime(81, 121, {"learning_rate": args.learningRate * 0.1}),
+            Regime(122, 10**9, {"learning_rate": args.learningRate * 0.01}),
+        ])
+        method = SGD(learning_rate=args.learningRate,
+                     weight_decay=args.weightDecay,
+                     momentum=args.momentum, dampening=0.0,
+                     nesterov=args.momentum > 0, schedule=sched)
+        opt = common.build_optimizer(model, train, nn.ClassNLLCriterion(),
+                                     args, optim_method=method)
+        opt.set_validation(Trigger.every_epoch(), test, [Top1Accuracy()])
+        return opt.optimize()
+    params, mod_state = common.load_trained(model, args.model)
+    test = _one_split(args.folder, args.batchSize, False, False)
+    return common.evaluate(model, params, mod_state, test)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,62 @@
+"""VGG on CIFAR-10 (reference models/vgg/{Train,Test}.scala: BGR
+normalize -> random crop/flip augment -> SGD)."""
+
+from __future__ import annotations
+
+import argparse
+
+from bigdl_tpu.cli import common
+
+
+def _one_split(folder: str, batch: int, train_split: bool, augment: bool):
+    from bigdl_tpu.dataset.cifar import load_cifar10, TRAIN_MEAN, TRAIN_STD
+    from bigdl_tpu.dataset.native import NativePrefetchDataSet, available
+    import numpy as np
+
+    mean = [m * 255 for m in TRAIN_MEAN]
+    std = [s * 255 for s in TRAIN_STD]
+    x, y = load_cifar10(folder, train=train_split)
+    if available():
+        return NativePrefetchDataSet(x, y, batch, train=augment,
+                                     mean=mean, std=std)
+    # pure-python fallback
+    from bigdl_tpu.dataset import BatchDataSet
+
+    xn = ((x.astype(np.float32) - np.asarray(mean, np.float32))
+          / np.asarray(std, np.float32))
+    return BatchDataSet(xn, y, batch, shuffle=augment)
+
+
+def _datasets(folder: str, batch: int, train_aug: bool):
+    return (_one_split(folder, batch, True, train_aug),
+            _one_split(folder, batch, False, False))
+
+
+def main(argv=None):
+    common.setup_logging()
+    p = argparse.ArgumentParser("bigdl-tpu vgg")
+    sub = p.add_subparsers(dest="cmd", required=True)
+    tr = sub.add_parser("train")
+    common.add_train_args(tr)
+    te = sub.add_parser("test")
+    common.add_test_args(te)
+    args = p.parse_args(argv)
+
+    from bigdl_tpu import nn
+    from bigdl_tpu.models import vgg_for_cifar10
+    from bigdl_tpu.optim import Top1Accuracy, Trigger
+
+    model = vgg_for_cifar10(10)
+    if args.cmd == "train":
+        train, test = _datasets(args.folder, args.batchSize, train_aug=True)
+        opt = common.build_optimizer(model, train, nn.ClassNLLCriterion(),
+                                     args)
+        opt.set_validation(Trigger.every_epoch(), test, [Top1Accuracy()])
+        return opt.optimize()
+    params, mod_state = common.load_trained(model, args.model)
+    test = _one_split(args.folder, args.batchSize, False, False)
+    return common.evaluate(model, params, mod_state, test)
+
+
+if __name__ == "__main__":
+    main()

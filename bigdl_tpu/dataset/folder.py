@@ -1,0 +1,124 @@
+"""Label-by-folder image datasets (reference DataSet.ImageFolder,
+dataset/DataSet.scala:322-379 — images under ``root/<class>/xxx.jpg``, one
+folder per class, sorted folder names -> label ids).
+
+Decode uses PIL on the host (the reference uses javax.imageio through
+``BGRImage.readImage``, dataset/image/LocalImageFiles); decoded samples can
+feed either the pure-python transformers (``bigdl_tpu.dataset.image``) or the
+native C++ prefetch pipeline (``bigdl_tpu.dataset.native``) for the
+multi-threaded augment path.
+"""
+
+from __future__ import annotations
+
+import os
+from concurrent.futures import ThreadPoolExecutor
+from typing import Iterator, Optional, Sequence
+
+import numpy as np
+
+from bigdl_tpu.dataset.dataset import DataSet, MiniBatch
+
+__all__ = ["list_image_folder", "load_image_folder", "ImageFolderDataSet"]
+
+_EXTS = {".jpg", ".jpeg", ".png", ".bmp", ".ppm", ".webp"}
+
+
+def list_image_folder(root: str) -> tuple[list[str], np.ndarray, list[str]]:
+    """Scan ``root/<class>/*`` -> (paths, labels, class_names). Labels are
+    0-based ids of the sorted class-folder names (reference ImageFolder
+    assigns consecutive ids by folder, DataSet.scala:322-344)."""
+    classes = sorted(d for d in os.listdir(root)
+                     if os.path.isdir(os.path.join(root, d)))
+    paths: list[str] = []
+    labels: list[int] = []
+    for ci, cls in enumerate(classes):
+        cdir = os.path.join(root, cls)
+        for fn in sorted(os.listdir(cdir)):
+            if os.path.splitext(fn)[1].lower() in _EXTS:
+                paths.append(os.path.join(cdir, fn))
+                labels.append(ci)
+    return paths, np.asarray(labels, np.int32), classes
+
+
+def _decode(path: str, size: Optional[tuple[int, int]]) -> np.ndarray:
+    from PIL import Image
+
+    with Image.open(path) as im:
+        im = im.convert("RGB")
+        if size is not None:
+            # scale shorter side to max(size) then center-crop, the standard
+            # eval transform (reference BGRImage.readImage scales to
+            # scaleTo on the shorter side)
+            th, tw = size
+            scale = max(th / im.height, tw / im.width)
+            im = im.resize((max(tw, int(round(im.width * scale))),
+                            max(th, int(round(im.height * scale)))))
+            left = (im.width - tw) // 2
+            top = (im.height - th) // 2
+            im = im.crop((left, top, left + tw, top + th))
+        return np.asarray(im, dtype=np.uint8)
+
+
+def load_image_folder(root: str, size: tuple[int, int] = (224, 224),
+                      n_threads: int = 8,
+                      limit: Optional[int] = None):
+    """Eagerly decode a whole image folder into (images[N,H,W,3] uint8,
+    labels[N] int32, class_names). Threaded decode (the reference's
+    MT decode path, image/MTLabeledBGRImgToBatch.scala)."""
+    paths, labels, classes = list_image_folder(root)
+    if limit is not None:
+        paths, labels = paths[:limit], labels[:limit]
+    with ThreadPoolExecutor(max_workers=n_threads) as ex:
+        images = list(ex.map(lambda p: _decode(p, size), paths))
+    return np.stack(images) if images else np.zeros(
+        (0, *size, 3), np.uint8), labels, classes
+
+
+class ImageFolderDataSet(DataSet):
+    """Lazy batched image-folder dataset: decodes per batch with a thread
+    pool, so arbitrarily large datasets stream from disk (the ImageNet path
+    — reference DataSet.SeqFileFolder streams Hadoop SequenceFiles; here we
+    stream the files themselves)."""
+
+    def __init__(self, root: str, batch_size: int,
+                 size: tuple[int, int] = (224, 224), train: bool = False,
+                 mean: Optional[Sequence[float]] = None,
+                 std: Optional[Sequence[float]] = None,
+                 seed: int = 0, n_threads: int = 8,
+                 drop_remainder: bool = True):
+        self.paths, self.labels, self.classes = list_image_folder(root)
+        self.batch_size = batch_size
+        self.img_size = size
+        self.train = train
+        self._rng = np.random.RandomState(seed)
+        self.n_threads = n_threads
+        self.drop_remainder = drop_remainder
+        c = 3
+        self.mean = (np.asarray(mean, np.float32) if mean is not None
+                     else np.zeros(c, np.float32))
+        self.std = (np.asarray(std, np.float32) if std is not None
+                    else np.ones(c, np.float32))
+
+    def __iter__(self) -> Iterator[MiniBatch]:
+        n = len(self.paths)
+        order = np.arange(n)
+        if self.train:
+            self._rng.shuffle(order)
+        end = (n - self.batch_size + 1) if self.drop_remainder else n
+        with ThreadPoolExecutor(max_workers=self.n_threads) as ex:
+            for i in range(0, max(end, 0), self.batch_size):
+                idx = order[i:i + self.batch_size]
+                imgs = list(ex.map(
+                    lambda j: _decode(self.paths[j], self.img_size), idx))
+                x = (np.stack(imgs).astype(np.float32) - self.mean) / self.std
+                if self.train and self._rng.rand() < 0.5:
+                    x = x[:, :, ::-1, :].copy()  # batch hflip augment
+                yield MiniBatch(x, self.labels[idx])
+
+    def size(self) -> int:
+        return len(self.paths)
+
+    def shuffle(self, seed=None):
+        if seed is not None:
+            self._rng = np.random.RandomState(seed)

@@ -14,8 +14,10 @@ from typing import Iterable, Optional, Sequence
 
 import numpy as np
 
+from bigdl_tpu.dataset.transformer import Transformer
+
 __all__ = ["tokenize", "Dictionary", "pad_sequences", "LabeledSentence",
-           "sentences_to_ids"]
+           "sentences_to_ids", "LabeledSentenceToSample"]
 
 PAD, UNK = "<pad>", "<unk>"
 _WORD_RE = re.compile(r"[A-Za-z']+|[.,!?;]")
@@ -80,3 +82,20 @@ def sentences_to_ids(sentences: Sequence[LabeledSentence],
     ids = pad_sequences([dictionary.ids(s.data) for s in sentences], max_len)
     labels = np.asarray([s.label for s in sentences], np.int32)
     return ids, labels
+
+
+class LabeledSentenceToSample(Transformer):
+    """Transformer stage: LabeledSentence -> (ids[max_len] int32, label)
+    sample pairs (reference dataset/text/LabeledSentenceToSample.scala —
+    fixed-length padding; fixed here rather than per-batch because XLA
+    recompiles per shape). Composes with ``>>`` like any Transformer."""
+
+    def __init__(self, dictionary: Dictionary, max_len: int):
+        self.dictionary = dictionary
+        self.max_len = max_len
+
+    def __call__(self, it):
+        for s in it:
+            ids = pad_sequences([self.dictionary.ids(s.data)],
+                                self.max_len)[0]
+            yield ids, np.int32(s.label)

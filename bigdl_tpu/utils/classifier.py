@@ -1,0 +1,70 @@
+"""Batched inference facade (reference utils/DLClassifier.scala:36-136 —
+a Spark-ML Transformer that batches DataFrame rows, runs model.forward and
+emits argmax predictions, with a per-partition cached model).
+
+Without Spark, the equivalent surface is: wrap (module, params) once,
+compile one jitted forward for a fixed batch size, stream any array /
+iterable through it in fixed batches (padding the tail so XLA sees a single
+static shape), return predictions. Plugs into anything that feeds numpy
+arrays — the role DataFrames play in the reference.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["Classifier"]
+
+
+class Classifier:
+    """``Classifier(model, params, mod_state)(x)`` -> class ids.
+
+    ``batch_size`` fixes the compiled shape; inputs of any length are
+    processed in chunks with tail padding (discarded after the forward).
+    """
+
+    def __init__(self, module, params, mod_state=None, batch_size: int = 128):
+        self.module = module
+        self.params = params
+        self.mod_state = (mod_state if mod_state is not None
+                          else module.init_state())
+        self.batch_size = batch_size
+
+        def fwd(params, mod_state, x):
+            y, _ = module.apply(params, mod_state, x, training=False)
+            return y
+
+        self._fwd = jax.jit(fwd)
+
+    def predict_scores(self, x: np.ndarray) -> np.ndarray:
+        """Raw model outputs (e.g. log-probs) for every row of x."""
+        n = len(x)
+        if n == 0:
+            return np.zeros((0,))
+        outs = []
+        for i in range(0, n, self.batch_size):
+            chunk = np.asarray(x[i:i + self.batch_size])
+            pad = self.batch_size - len(chunk)
+            if pad > 0:  # pad the tail so the jitted shape is static
+                chunk = np.concatenate(
+                    [chunk, np.repeat(chunk[-1:], pad, axis=0)])
+            y = self._fwd(self.params, self.mod_state, jnp.asarray(chunk))
+            outs.append(np.asarray(y)[:len(x[i:i + self.batch_size])])
+        return np.concatenate(outs)
+
+    def predict(self, x: np.ndarray) -> np.ndarray:
+        """Argmax class ids (reference DLClassifier's prediction column)."""
+        return np.argmax(self.predict_scores(x), axis=-1)
+
+    def predict_iter(self, batches: Iterable[Any]) -> Iterable[np.ndarray]:
+        """Stream predictions over an iterator of feature batches."""
+        for b in batches:
+            feats = b.input if hasattr(b, "input") else b
+            yield self.predict(np.asarray(feats))
+
+    def __call__(self, x: np.ndarray) -> np.ndarray:
+        return self.predict(x)

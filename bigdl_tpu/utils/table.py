@@ -1,0 +1,110 @@
+"""Lua-style Table (reference utils/Table.scala:31-137 and the ``T(...)``
+constructor) — the reference's universal heterogeneous container for
+optimizer state, nested activities, and hyperparameter bundles.
+
+In the TPU framework pytrees (dicts/tuples) play that role natively, but
+Table is kept for API parity: code moving over from the reference can write
+``T(learningRate=0.1)`` or ``T(tensor_a, tensor_b)`` unchanged. Table is a
+registered JAX pytree, so it can flow through jit/grad like a dict.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterator
+
+import jax
+
+__all__ = ["Table", "T"]
+
+
+class Table:
+    """Int- and string-keyed map; integer keys start at 1 (Lua convention,
+    reference Table.scala array-part semantics)."""
+
+    def __init__(self, *args: Any, **kwargs: Any):
+        self._data: dict[Any, Any] = {}
+        for i, v in enumerate(args):
+            self._data[i + 1] = v
+        self._data.update(kwargs)
+
+    # ----------------------------------------------------------- mapping
+    def __getitem__(self, k):
+        return self._data[k]
+
+    def __setitem__(self, k, v):
+        self._data[k] = v
+
+    def __contains__(self, k):
+        return k in self._data
+
+    def __len__(self):
+        return len(self._data)
+
+    def __iter__(self) -> Iterator:
+        return iter(self._data)
+
+    def get(self, k, default=None):
+        return self._data.get(k, default)
+
+    def keys(self):
+        return self._data.keys()
+
+    def values(self):
+        return self._data.values()
+
+    def items(self):
+        return self._data.items()
+
+    def update(self, other) -> "Table":
+        self._data.update(dict(other.items()) if isinstance(other, Table)
+                          else other)
+        return self
+
+    # ------------------------------------------------------- array part
+    def insert(self, v) -> "Table":
+        """Append to the integer array part (reference Table.insert)."""
+        self._data[self._array_len() + 1] = v
+        return self
+
+    def remove(self) -> Any:
+        """Pop the last array element."""
+        n = self._array_len()
+        if n == 0:
+            return None
+        return self._data.pop(n)
+
+    def _array_len(self) -> int:
+        n = 0
+        while (n + 1) in self._data:
+            n += 1
+        return n
+
+    def to_list(self) -> list:
+        return [self._data[i + 1] for i in range(self._array_len())]
+
+    def __eq__(self, other):
+        return isinstance(other, Table) and self._data == other._data
+
+    def __repr__(self):
+        inner = ", ".join(f"{k!r}: {v!r}" for k, v in self._data.items())
+        return f"T({{{inner}}})"
+
+
+def T(*args: Any, **kwargs: Any) -> Table:
+    """Constructor shorthand (reference ``T(...)``)."""
+    return Table(*args, **kwargs)
+
+
+def _table_flatten(t: Table):
+    keys = sorted(t._data.keys(), key=lambda k: (isinstance(k, str), k))
+    return [t._data[k] for k in keys], tuple(keys)
+
+
+def _table_unflatten(keys, values) -> Table:
+    t = Table()
+    for k, v in zip(keys, values):
+        t._data[k] = v
+    return t
+
+
+jax.tree_util.register_pytree_node(Table, _table_flatten, _table_unflatten)

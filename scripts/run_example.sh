@@ -1,0 +1,32 @@
+#!/usr/bin/env bash
+# End-to-end example driver (reference scripts/run.example.sh — downloads
+# data and spark-submits a model's Train class; here: checks for the
+# dataset locally and runs the corresponding CLI module).
+#
+# Usage: ./scripts/run_example.sh <lenet|vgg|resnet|inception|rnn|autoencoder|perf> <data_dir> [extra args...]
+set -euo pipefail
+
+MODEL="${1:?usage: run_example.sh <model> <data_dir> [args...]}"
+DATA="${2:-./data}"
+shift 2 || true
+
+cd "$(dirname "$0")/.."
+
+case "$MODEL" in
+  lenet)
+    exec python -m bigdl_tpu.cli.lenet train -f "$DATA" "$@" ;;
+  vgg)
+    exec python -m bigdl_tpu.cli.vgg train -f "$DATA" "$@" ;;
+  resnet)
+    exec python -m bigdl_tpu.cli.resnet train -f "$DATA" "$@" ;;
+  inception)
+    exec python -m bigdl_tpu.cli.inception train -f "$DATA" "$@" ;;
+  rnn)
+    exec python -m bigdl_tpu.cli.rnn train -f "$DATA" "$@" ;;
+  autoencoder)
+    exec python -m bigdl_tpu.cli.autoencoder train -f "$DATA" "$@" ;;
+  perf)
+    exec python -m bigdl_tpu.cli.perf "$@" ;;
+  *)
+    echo "unknown model: $MODEL" >&2; exit 1 ;;
+esac

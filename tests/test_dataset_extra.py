@@ -1,0 +1,165 @@
+"""ImageFolder / sharding / text-to-sample / Classifier / Table tests
+(reference dataset specs + utils/DLClassifierSpec + Table usage, SURVEY §4)."""
+
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from bigdl_tpu.dataset import (
+    ImageFolderDataSet, ShardedDataSet, host_shard, list_image_folder,
+    load_image_folder,
+)
+from bigdl_tpu.dataset.text import (
+    Dictionary, LabeledSentence, LabeledSentenceToSample, tokenize,
+)
+from bigdl_tpu.utils import Classifier, T, Table
+
+
+# ----------------------------------------------------------- image folder
+
+@pytest.fixture
+def image_root(tmp_path):
+    from PIL import Image
+
+    rng = np.random.RandomState(0)
+    for cls in ["cat", "dog"]:
+        d = tmp_path / "imgs" / cls
+        d.mkdir(parents=True)
+        for i in range(6):
+            arr = rng.randint(0, 256, (20, 24, 3), dtype=np.uint8)
+            Image.fromarray(arr).save(d / f"{i}.png")
+    return str(tmp_path / "imgs")
+
+
+def test_list_image_folder(image_root):
+    paths, labels, classes = list_image_folder(image_root)
+    assert classes == ["cat", "dog"]
+    assert len(paths) == 12
+    assert labels.tolist() == [0] * 6 + [1] * 6
+
+
+def test_load_image_folder_resize(image_root):
+    images, labels, classes = load_image_folder(image_root, size=(16, 16))
+    assert images.shape == (12, 16, 16, 3)
+    assert images.dtype == np.uint8
+
+
+def test_image_folder_dataset_batches(image_root):
+    ds = ImageFolderDataSet(image_root, batch_size=4, size=(16, 16),
+                            mean=[0, 0, 0], std=[255, 255, 255])
+    batches = list(ds)
+    assert len(batches) == 3
+    for b in batches:
+        assert b.input.shape == (4, 16, 16, 3)
+        assert b.input.max() <= 1.0
+    assert ds.size() == 12
+
+
+# ---------------------------------------------------------------- sharding
+
+def test_host_shard_partition():
+    s0 = host_shard(100, process_index=0, process_count=4)
+    s3 = host_shard(100, process_index=3, process_count=4)
+    assert (s0.start, s0.stop) == (0, 25)
+    assert (s3.start, s3.stop) == (75, 100)
+
+
+def test_sharded_dataset_disjoint_exhaustive():
+    n, gbs, pc = 64, 16, 4
+    feats = np.arange(n, dtype=np.float32)[:, None]
+    labels = np.arange(n, dtype=np.int32)
+    shards = [ShardedDataSet(feats, labels, gbs, shuffle=True, seed=5,
+                             process_index=pi, process_count=pc)
+              for pi in range(pc)]
+    per_step = [[b.target.tolist() for b in s] for s in shards]
+    # all hosts step the same number of batches, each of local size gbs/pc
+    assert all(len(steps) == n // gbs for steps in per_step)
+    # per step, the union over hosts is disjoint; over the epoch, exhaustive
+    seen = []
+    for step_i in range(n // gbs):
+        step_union = sum((per_step[pi][step_i] for pi in range(pc)), [])
+        assert len(set(step_union)) == gbs
+        seen.extend(step_union)
+    assert sorted(seen) == list(range(n))
+
+
+def test_sharded_dataset_reshuffles_between_epochs():
+    feats = np.arange(32, dtype=np.float32)[:, None]
+    labels = np.arange(32, dtype=np.int32)
+    ds = ShardedDataSet(feats, labels, 8, shuffle=True, seed=1,
+                        process_index=0, process_count=1)
+    e1 = [b.target.tolist() for b in ds]
+    ds.shuffle()
+    e2 = [b.target.tolist() for b in ds]
+    assert sorted(sum(e1, [])) == sorted(sum(e2, []))
+    assert e1 != e2
+
+
+# ------------------------------------------------------------------- text
+
+def test_labeled_sentence_to_sample():
+    corpus = ["the cat sat", "the dog ran far away"]
+    toks = [tokenize(t) for t in corpus]
+    d = Dictionary(toks)
+    stage = LabeledSentenceToSample(d, max_len=4)
+    sents = [LabeledSentence(t, i) for i, t in enumerate(toks)]
+    out = list(stage(iter(sents)))
+    assert len(out) == 2
+    ids0, lab0 = out[0]
+    assert ids0.shape == (4,) and ids0.dtype == np.int32
+    assert ids0[3] == 0  # padded
+    assert lab0 == 0
+    ids1, _ = out[1]
+    assert (ids1 != 0).all()  # truncated to max_len, no padding
+
+
+# -------------------------------------------------------------- classifier
+
+def test_classifier_predict_matches_direct():
+    from bigdl_tpu import nn
+    from bigdl_tpu.core import Sequential
+
+    model = Sequential(nn.Linear(6, 4), nn.LogSoftMax())
+    params = model.init(jax.random.PRNGKey(0))
+    x = np.random.RandomState(0).randn(37, 6).astype(np.float32)  # odd size
+    clf = Classifier(model, params, batch_size=16)
+    pred = clf.predict(x)
+    direct = np.argmax(np.asarray(model.forward(params, x)), axis=1)
+    np.testing.assert_array_equal(pred, direct)
+    scores = clf.predict_scores(x)
+    assert scores.shape == (37, 4)
+
+
+def test_classifier_predict_iter():
+    from bigdl_tpu import nn
+    from bigdl_tpu.core import Sequential
+    from bigdl_tpu.dataset import BatchDataSet
+
+    model = Sequential(nn.Linear(3, 2), nn.LogSoftMax())
+    params = model.init(jax.random.PRNGKey(0))
+    x = np.random.RandomState(0).randn(16, 3).astype(np.float32)
+    y = np.zeros(16, np.int32)
+    ds = BatchDataSet(x, y, 8)
+    preds = list(Classifier(model, params, batch_size=8).predict_iter(ds))
+    assert len(preds) == 2 and all(p.shape == (8,) for p in preds)
+
+
+# ------------------------------------------------------------------- table
+
+def test_table_constructor_and_array_part():
+    t = T(10, 20, lr=0.5)
+    assert t[1] == 10 and t[2] == 20 and t["lr"] == 0.5
+    t.insert(30)
+    assert t.to_list() == [10, 20, 30]
+    assert t.remove() == 30
+    assert t.to_list() == [10, 20]
+
+
+def test_table_is_pytree():
+    t = T(np.ones(3), scale=np.asarray(2.0))
+    doubled = jax.tree_util.tree_map(lambda a: a * 2, t)
+    assert isinstance(doubled, Table)
+    np.testing.assert_array_equal(doubled[1], np.full(3, 2.0))
+    assert float(doubled["scale"]) == 4.0
