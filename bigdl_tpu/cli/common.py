@@ -81,7 +81,9 @@ def build_optimizer(model, dataset, criterion, args, schedule=None,
                     log_every=args.logEvery)
     if args.checkpoint:
         os.makedirs(args.checkpoint, exist_ok=True)
-        opt.set_checkpoint(Trigger.every_epoch(), args.checkpoint)
+        opt.set_checkpoint(Trigger.every_epoch(), args.checkpoint,
+                           overwrite=getattr(args, "overWriteCheckpoint",
+                                             False))
     if args.model:
         opt.resume(args.model)
     return opt

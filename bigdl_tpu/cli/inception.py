@@ -14,19 +14,25 @@ _MEAN = (123.0, 117.0, 104.0)
 _STD = (58.4, 57.1, 57.4)
 
 
-def _datasets(folder: str, batch: int, classes_expected: int):
+def _train_dataset(folder: str, batch: int):
     import os
 
     from bigdl_tpu.dataset.folder import ImageFolderDataSet
 
-    train = ImageFolderDataSet(os.path.join(folder, "train"), batch,
-                               size=(224, 224), train=True,
-                               mean=_MEAN, std=_STD)
-    vdir = os.path.join(folder, "val")
-    val = (ImageFolderDataSet(vdir, batch, size=(224, 224),
+    return ImageFolderDataSet(os.path.join(folder, "train"), batch,
+                              size=(224, 224), train=True,
                               mean=_MEAN, std=_STD)
-           if os.path.isdir(vdir) else None)
-    return train, val
+
+
+def _val_dataset(folder: str, batch: int):
+    import os
+
+    from bigdl_tpu.dataset.folder import ImageFolderDataSet
+
+    vdir = os.path.join(folder, "val")
+    return (ImageFolderDataSet(vdir, batch, size=(224, 224),
+                               mean=_MEAN, std=_STD)
+            if os.path.isdir(vdir) else None)
 
 
 def main(argv=None):
@@ -56,7 +62,8 @@ def main(argv=None):
     model = build(args.classNum)
 
     if args.cmd == "train":
-        train, val = _datasets(args.folder, args.batchSize, args.classNum)
+        train = _train_dataset(args.folder, args.batchSize)
+        val = _val_dataset(args.folder, args.batchSize)
         # reference hyperparams: lr 0.0898, Poly(0.5, 62000)
         method = SGD(learning_rate=args.learningRate,
                      schedule=Poly(0.5, args.maxIteration))
@@ -67,7 +74,7 @@ def main(argv=None):
                                [Top1Accuracy(), Top5Accuracy()])
         return opt.optimize()
     params, mod_state = common.load_trained(model, args.model)
-    _, val = _datasets(args.folder, args.batchSize, args.classNum)
+    val = _val_dataset(args.folder, args.batchSize)
     if val is None:
         raise FileNotFoundError(
             f"no val/ directory under {args.folder} — `inception test` "

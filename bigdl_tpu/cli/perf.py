@@ -106,15 +106,16 @@ def run(model_name: str, batch: int, iterations: int, data_type: str,
         x, y = jnp.asarray(x_host), jnp.asarray(y_host)
 
     k = jax.random.PRNGKey(1)
-    # FLOPs estimate for MFU, read from the compiled HLO of the same jit
-    # wrapper that runs the benchmark (one XLA compile total)
+    # AOT-compile once; the same Compiled object supplies the FLOPs estimate
+    # for MFU *and* runs the benchmark loop (one XLA compile total)
     step_flops = 0.0
     try:
-        cost = step.lower(params, mod_state, opt_state, x, y,
-                          k).compile().cost_analysis()
+        compiled = step.lower(params, mod_state, opt_state, x, y, k).compile()
+        cost = compiled.cost_analysis()
         if isinstance(cost, (list, tuple)):  # older jax returns [dict]
             cost = cost[0] if cost else {}
         step_flops = float(cost.get("flops", 0.0) or 0.0)
+        step = compiled
     except Exception:
         pass
 

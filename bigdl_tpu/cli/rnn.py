@@ -15,7 +15,7 @@ def _windows(ids, seq_len: int):
     import numpy as np
 
     xs, ys = [], []
-    for i in range(0, len(ids) - seq_len - 1):
+    for i in range(0, len(ids) - seq_len):
         xs.append(ids[i:i + seq_len])
         ys.append(ids[i + seq_len])
     return np.asarray(xs, np.int32), np.asarray(ys, np.int32)
@@ -47,6 +47,10 @@ def main(argv=None):
     d = Dictionary([tokens], vocab_size=args.vocabSize)
     ids = np.asarray(d.ids(tokens), np.int32)
     x, y = _windows(ids, args.seqLength)
+    # hold out the tail windows for the perplexity report
+    n_held = min(512, max(1, len(x) // 10))
+    x, y, x_val, y_val = (x[:-n_held], y[:-n_held],
+                          x[-n_held:], y[-n_held:])
     train = BatchDataSet(x, y, args.batchSize, shuffle=True)
 
     vocab = len(d)
@@ -59,10 +63,10 @@ def main(argv=None):
     )
     opt = common.build_optimizer(model, train, nn.ClassNLLCriterion(), args)
     trained = opt.optimize()
-    # report perplexity on a held-out tail (reference loss = perplexity)
+    # report perplexity on the held-out tail (reference loss = perplexity)
     import jax.numpy as jnp
-    logp = trained.module.forward(trained.params, jnp.asarray(x[-512:]))
-    nll = -np.mean(np.asarray(logp)[np.arange(len(y[-512:])), y[-512:]])
+    logp = trained.module.forward(trained.params, jnp.asarray(x_val))
+    nll = -np.mean(np.asarray(logp)[np.arange(len(y_val)), y_val])
     print(f"perplexity is {math.exp(nll):.2f}")
     return trained
 

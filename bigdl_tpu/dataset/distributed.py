@@ -29,7 +29,9 @@ def host_shard(n: int, process_index: Optional[int] = None,
                process_count: Optional[int] = None) -> slice:
     """This host's contiguous slice of an n-element dataset (equal shards,
     remainder dropped so every host steps the same number of batches —
-    SPMD collectives require lockstep iteration counts)."""
+    SPMD collectives require lockstep iteration counts). Feed the slice to a
+    per-host pipeline (e.g. ``LocalArrayDataSet``) — not to
+    :class:`ShardedDataSet`, which expects the full global arrays."""
     import jax
 
     pi = jax.process_index() if process_index is None else process_index
@@ -39,14 +41,19 @@ def host_shard(n: int, process_index: Optional[int] = None,
 
 
 class ShardedDataSet(DataSet):
-    """Wrap per-host arrays into the host-local part of a global batch.
+    """Every host holds the **full global arrays**; each yields its own
+    host-local part of every global batch.
 
     ``global_batch_size`` is the logical batch across all hosts; each host
-    yields ``global_batch_size // process_count`` samples per step from its
-    own shard, epoch-shuffled with a *shared* seed so shards stay disjoint
-    and exhaustive (all hosts permute the same global index space —
-    the analog of the reference's driver-computed shuffled-index RDD,
-    DataSet.scala:252-257).
+    yields ``global_batch_size // process_count`` samples per step, selected
+    from a *shared* epoch-advanced permutation of the global index space so
+    shards stay disjoint and exhaustive (the analog of the reference's
+    driver-computed shuffled-index RDD, DataSet.scala:252-257).
+
+    Do NOT pass a :func:`host_shard` slice here — indexing is global. When a
+    host can only hold 1/P of the data (ImageNet-scale), use
+    :func:`host_shard` to select files and feed a per-host pipeline
+    (``ImageFolderDataSet``/``LocalArrayDataSet``) instead.
     """
 
     def __init__(self, features: np.ndarray, labels: np.ndarray,

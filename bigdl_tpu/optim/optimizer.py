@@ -96,10 +96,14 @@ class Optimizer:
         self._val_methods = list(methods)
         return self
 
-    def set_checkpoint(self, trigger: Trigger, path: str) -> "Optimizer":
-        """(reference Optimizer.setCheckpoint :87-94)"""
+    def set_checkpoint(self, trigger: Trigger, path: str,
+                       overwrite: bool = False) -> "Optimizer":
+        """(reference Optimizer.setCheckpoint :87-94 +
+        overWriteCheckpoint flag: refuse to clobber an existing snapshot
+        unless ``overwrite``)"""
         self._ckpt_trigger = trigger
         self._ckpt_path = path
+        self._ckpt_overwrite = overwrite
         return self
 
     def set_state(self, params=None, mod_state=None,
@@ -242,6 +246,12 @@ class Optimizer:
             return
         self._last_ckpt_iter = driver["iteration"]
         n = driver["iteration"]
+        target = os.path.join(self._ckpt_path, f"model.{n}")
+        if os.path.exists(target) and not getattr(
+                self, "_ckpt_overwrite", False):
+            raise FileExistsError(
+                f"{target} exists; pass overwrite=True to set_checkpoint "
+                f"(--overWriteCheckpoint) to clobber it")
         if self.strategy is not None:
             params, mod_state, opt_state = self.strategy.gather(
                 params, mod_state, opt_state)

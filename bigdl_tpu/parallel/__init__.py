@@ -2,4 +2,7 @@ from bigdl_tpu.parallel.mesh import (
     init_distributed, make_mesh, local_mesh, P, NamedSharding,
 )
 from bigdl_tpu.parallel.data_parallel import DataParallel
+from bigdl_tpu.parallel.tensor_parallel import (
+    TensorParallel, megatron_specs, replicated_specs,
+)
 from bigdl_tpu.parallel.sequence import ring_attention, make_ring_attention

@@ -1,0 +1,226 @@
+"""Tensor (model) parallelism — GSPMD parameter sharding over a ``model`` axis.
+
+The reference has no tensor parallelism (SURVEY.md §2.7: "Tensor (intra-op
+model) parallel — NO"); this is a new, TPU-first capability. The design is
+deliberately *not* Megatron's hand-written f/g collective layers: under XLA's
+SPMD partitioner it is sufficient to annotate the **weights** with shardings —
+the compiler propagates shardings through the einsums and inserts the exact
+all-reduce/all-gather schedule Megatron hand-codes. The classic pairing
+(column-split first matmul, row-split second, one psum at the end of the pair)
+falls out automatically from the weight specs below.
+
+``megatron_specs(module, params, axis, n_shard)`` builds a PartitionSpec
+pytree that mirrors ``params``:
+
+* ``Linear`` (weight ``(in, out)``, ``y = x @ w``): consecutive Linears
+  alternate column-parallel ``P(None, axis)`` / row-parallel ``P(axis, None)``
+  so activations stay sharded on the feature dim between the pair.
+* ``MultiHeadAttention``: wq/wk/wv column-split (= head-parallel, the
+  attention itself is embarrassingly parallel over heads), wo row-split.
+* ``TransformerEncoderLayer``: attention as above; MLP w1 column / w2 row;
+  LayerNorms replicated.
+* ``SpatialConvolution`` (HWIO weight): output-channel split on the last dim.
+* ``LookupTable``: embedding dim split (row/vocab split would need masked
+  gather + psum; feature split composes with a following column Linear).
+* anything else: replicated.
+
+A dimension is only split when divisible by the axis size; otherwise that
+leaf stays replicated (correctness never depends on divisibility).
+
+:class:`TensorParallel` is the strategy object (same protocol as
+:class:`~bigdl_tpu.parallel.DataParallel`: place / shard_batch /
+compile_step / compile_eval / gather) for a ``data × model`` mesh — data
+parallelism over ``data_axis`` (batch sharded) and tensor parallelism over
+``model_axis`` (params sharded). Keep ``model`` on ICI-adjacent devices:
+its collectives are per-layer, while ``data``'s is one grad reduction.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from bigdl_tpu.parallel.data_parallel import DataParallel, _zero1_spec
+
+__all__ = ["TensorParallel", "megatron_specs", "replicated_specs"]
+
+
+def replicated_specs(params):
+    """All-replicated spec tree (the degenerate rule)."""
+    return jax.tree_util.tree_map(lambda _: P(), params)
+
+
+def _div(dim: int, n: int) -> bool:
+    return n > 1 and dim % n == 0 and dim >= n
+
+
+def megatron_specs(module, params, axis: str, n_shard: int):
+    """Build the param-sharding spec pytree for ``module``'s ``params``.
+
+    Dispatches on layer type, recursing through containers. ``_state`` keeps
+    the column/row alternation across sibling Linears (Megatron pairing).
+    """
+    from bigdl_tpu import nn
+
+    state = {"linear_toggle": 0}
+
+    def linear_spec(mod, p):
+        # weight (in, out); alternate column (shard out) / row (shard in)
+        w = p["weight"]
+        col = state["linear_toggle"] % 2 == 0
+        spec = {"weight": P(), "bias": P()} if "bias" in p else {"weight": P()}
+        if col and _div(w.shape[1], n_shard):
+            spec["weight"] = P(None, axis)
+            if "bias" in p:
+                spec["bias"] = P(axis)
+            state["linear_toggle"] += 1
+        elif not col and _div(w.shape[0], n_shard):
+            spec["weight"] = P(axis, None)
+            state["linear_toggle"] += 1
+        return spec
+
+    def mha_spec(mod, p):
+        if not _div(mod.num_heads, n_shard):
+            return replicated_specs(p)
+        return {
+            "wq": P(None, axis), "wk": P(None, axis), "wv": P(None, axis),
+            "bq": P(axis), "bk": P(axis), "bv": P(axis),
+            "wo": P(axis, None), "bo": P(),
+        }
+
+    def block_spec(mod, p):
+        d, f = mod._mlp_dims
+        out = {
+            "ln1": replicated_specs(p["ln1"]),
+            "ln2": replicated_specs(p["ln2"]),
+            "mha": mha_spec(mod.mha, p["mha"]),
+            "w1": P(None, axis) if _div(f, n_shard) else P(),
+            "b1": P(axis) if _div(f, n_shard) else P(),
+            "w2": P(axis, None) if _div(f, n_shard) else P(),
+            "b2": P(),
+        }
+        return out
+
+    def conv_spec(mod, p):
+        # HWIO weight; split output channels (last dim)
+        w = p["weight"]
+        if _div(w.shape[-1], n_shard):
+            spec = {"weight": P(None, None, None, axis)}
+            if "bias" in p:
+                spec["bias"] = P(axis)
+            return spec
+        return replicated_specs(p)
+
+    def lookup_spec(mod, p):
+        w = p["weight"]
+        if _div(w.shape[-1], n_shard):
+            return {"weight": P(None, axis)}
+        return replicated_specs(p)
+
+    def rec(mod, p):
+        if isinstance(mod, nn.TransformerEncoderLayer):
+            return block_spec(mod, p)
+        if isinstance(mod, nn.MultiHeadAttention):
+            return mha_spec(mod, p)
+        if isinstance(mod, nn.Linear):
+            return linear_spec(mod, p)
+        if isinstance(mod, nn.LookupTable):
+            return lookup_spec(mod, p)
+        if isinstance(mod, nn.SpatialConvolution):
+            return conv_spec(mod, p)
+        children = mod.children()
+        if children and isinstance(p, dict):
+            out = {}
+            for i, c in enumerate(children):
+                k = str(i)
+                if k in p:
+                    out[k] = rec(c, p[k])
+            # container-level params not belonging to an indexed child
+            for k in p:
+                if k not in out:
+                    out[k] = replicated_specs(p[k])
+            return out
+        return replicated_specs(p)
+
+    return rec(module, params)
+
+
+class TensorParallel(DataParallel):
+    """dp × tp strategy over a mesh with ``data_axis`` and ``model_axis``.
+
+    Params are sharded per ``rules`` (default :func:`megatron_specs`) over
+    ``model_axis``; the batch is sharded over ``data_axis``; optimizer state
+    inherits each param's sharding (so TP-sharded leaves keep their layout)
+    with ZeRO-1 over ``data_axis`` for the replicated remainder.
+    """
+
+    def __init__(self, mesh: Mesh, module,
+                 data_axis: str = "data", model_axis: str = "model",
+                 rules: Callable = megatron_specs,
+                 zero1: bool = True, donate: bool = True):
+        super().__init__(mesh, axis=data_axis, zero1=zero1, donate=donate)
+        self.module = module
+        self.model_axis = model_axis
+        self.rules = rules
+        self._param_shardings = None
+
+    # ------------------------------------------------------------- placement
+    def _build_param_shardings(self, params):
+        n = self.mesh.shape[self.model_axis]
+        specs = self.rules(self.module, params, self.model_axis, n)
+        return jax.tree_util.tree_map(
+            lambda s: NamedSharding(self.mesh, s), specs,
+            is_leaf=lambda x: isinstance(x, P))
+
+    def _opt_sharding_like_params(self, opt_state, params, param_shardings):
+        """Opt-state leaves that mirror params (velocity/m/v/accum trees)
+        take the matching param sharding; scalars/mismatches replicate with
+        optional ZeRO-1 over the data axis."""
+        p_struct = jax.tree_util.tree_structure(params)
+
+        def subtree(st):
+            if jax.tree_util.tree_structure(st) == p_struct:
+                return param_shardings
+            return jax.tree_util.tree_map(
+                lambda x: NamedSharding(
+                    self.mesh,
+                    _zero1_spec(x, self.mesh, self.axis) if (
+                        self.zero1 and hasattr(x, "ndim")) else P()), st)
+
+        if isinstance(opt_state, dict):
+            return {k: subtree(v) for k, v in opt_state.items()}
+        return subtree(opt_state)
+
+    def place(self, params, mod_state, opt_state):
+        self._param_shardings = self._build_param_shardings(params)
+        params = jax.tree_util.tree_map(
+            lambda x, s: jax.device_put(x, s), params, self._param_shardings)
+        mod_state = jax.device_put(mod_state, self._repl)
+        self._opt_shardings = self._opt_sharding_like_params(
+            opt_state, params, self._param_shardings)
+        opt_state = jax.tree_util.tree_map(
+            lambda x, s: jax.device_put(x, s), opt_state, self._opt_shardings)
+        return params, mod_state, opt_state
+
+    # ------------------------------------------------------------- compile
+    def compile_step(self, train_step):
+        if self._param_shardings is None:
+            raise RuntimeError("TensorParallel.place() must run before "
+                               "compile_step()")
+        in_shardings = (self._param_shardings, self._repl, self._opt_shardings,
+                        self._batch, self._batch, self._repl)
+        out_shardings = (self._param_shardings, self._repl,
+                         self._opt_shardings, self._repl)
+        donate = (0, 1, 2) if self.donate else ()
+        return jax.jit(train_step, in_shardings=in_shardings,
+                       out_shardings=out_shardings, donate_argnums=donate)
+
+    def compile_eval(self, eval_step):
+        return jax.jit(eval_step,
+                       in_shardings=(self._param_shardings, self._repl,
+                                     self._batch, self._batch))

@@ -44,7 +44,12 @@ class Classifier:
         """Raw model outputs (e.g. log-probs) for every row of x."""
         n = len(x)
         if n == 0:
-            return np.zeros((0,))
+            # probe one padded batch for the output shape so empty input
+            # round-trips with the right rank
+            probe = np.zeros((self.batch_size,) + np.asarray(x).shape[1:],
+                             np.float32)
+            y = self._fwd(self.params, self.mod_state, jnp.asarray(probe))
+            return np.zeros((0,) + np.asarray(y).shape[1:])
         outs = []
         for i in range(0, n, self.batch_size):
             chunk = np.asarray(x[i:i + self.batch_size])
@@ -58,7 +63,10 @@ class Classifier:
 
     def predict(self, x: np.ndarray) -> np.ndarray:
         """Argmax class ids (reference DLClassifier's prediction column)."""
-        return np.argmax(self.predict_scores(x), axis=-1)
+        scores = self.predict_scores(x)
+        if len(scores) == 0:
+            return np.zeros((0,), np.int64)
+        return np.argmax(scores, axis=-1)
 
     def predict_iter(self, batches: Iterable[Any]) -> Iterable[np.ndarray]:
         """Stream predictions over an iterator of feature batches."""

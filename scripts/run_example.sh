@@ -8,7 +8,8 @@ set -euo pipefail
 
 MODEL="${1:?usage: run_example.sh <model> <data_dir> [args...]}"
 DATA="${2:-./data}"
-shift 2 || true
+shift
+[ "$#" -gt 0 ] && shift
 
 cd "$(dirname "$0")/.."
 
