@@ -11,4 +11,5 @@ from bigdl_tpu.nn.norm import *  # noqa: F401,F403
 from bigdl_tpu.nn.structural import *  # noqa: F401,F403
 from bigdl_tpu.nn.recurrent import *  # noqa: F401,F403
 from bigdl_tpu.nn.attention import *  # noqa: F401,F403
+from bigdl_tpu.nn.moe import *  # noqa: F401,F403
 from bigdl_tpu.nn.criterion import *  # noqa: F401,F403

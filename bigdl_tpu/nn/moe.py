@@ -1,0 +1,143 @@
+"""Mixture-of-Experts with expert parallelism.
+
+The reference's ``MixtureTable`` (nn/MixtureTable.scala) is a single-node
+soft gating layer: every expert runs on every input and a gater blends the
+outputs. :class:`MoE` keeps that dense blend available (``dense=True`` —
+exact MixtureTable parity) and adds the TPU-scale sparse path the reference
+never had: top-k routing with a capacity factor, einsum dispatch/combine
+(one-hot matmuls — MXU-friendly, static shapes, no ragged gather), and
+optional **expert parallelism**: experts' params stacked on a leading
+``[E, ...]`` dim and sharded over an ``expert`` mesh axis, with tokens
+moved to their experts by the all-to-all that falls out of resharding the
+dispatched tensor (SURVEY.md §2.7: "Expert parallel / MoE — NO" in the
+reference).
+
+Load-balancing uses the standard auxiliary loss (mean gate fraction x mean
+token fraction per expert); retrieve it from the returned state.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from bigdl_tpu.core.module import Module
+
+__all__ = ["MoE"]
+
+
+class MoE(Module):
+    """``MoE(expert, num_experts, d_model, top_k)``: route (batch, seq, d)
+    tokens (or (batch, d)) through ``num_experts`` copies of ``expert``.
+
+    ``dense=True`` reproduces the reference MixtureTable exactly: softmax
+    gate over ALL experts, every expert computes every token, outputs
+    blended. Sparse mode keeps only the top-k experts per token, bounded by
+    ``capacity_factor`` (tokens above an expert's capacity are dropped —
+    their residual passes through unchanged when used inside a residual
+    block).
+    """
+
+    def __init__(self, expert: Module, num_experts: int, d_model: int,
+                 top_k: int = 1, capacity_factor: float = 1.25,
+                 dense: bool = False, name: Optional[str] = None):
+        super().__init__(name)
+        self._expert_state = expert.init_state()
+        if jax.tree_util.tree_leaves(self._expert_state):
+            raise ValueError("MoE experts must be stateless")
+        self.expert = expert
+        self.num_experts = num_experts
+        self.d_model = d_model
+        self.top_k = top_k
+        self.capacity_factor = capacity_factor
+        self.dense = dense
+
+    def init(self, rng):
+        ks = jax.random.split(rng, self.num_experts + 1)
+        experts = [self.expert.init(k) for k in ks[1:]]
+        stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *experts)
+        gate = jax.random.normal(ks[0], (self.d_model, self.num_experts),
+                                 jnp.float32) * 0.02
+        return {"gate": gate, "experts": stacked}
+
+    def init_state(self):
+        # aux_loss is exposed through state so training loops can add it
+        return {"aux_loss": jnp.zeros((), jnp.float32)}
+
+    # ----------------------------------------------------------------- apply
+    def _run_experts(self, p_experts, xs, training, rng):
+        """vmap the expert over its stacked params: xs [E, C, d] -> [E, C, d']."""
+        def one(pb, xb):
+            y, _ = self.expert.apply(pb, self._expert_state, xb,
+                                     training=training, rng=rng)
+            return y
+        return jax.vmap(one)(p_experts, xs)
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        orig_shape = x.shape
+        tokens = x.reshape(-1, orig_shape[-1])  # [T, d]
+        t = tokens.shape[0]
+        e = self.num_experts
+        logits = tokens @ params["gate"].astype(tokens.dtype)  # [T, E]
+        probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+
+        if self.dense:
+            # exact MixtureTable semantics: blend every expert's output
+            ys = self._run_experts(params["experts"],
+                                   jnp.broadcast_to(tokens, (e,) + tokens.shape),
+                                   training, rng)  # [E, T, d']
+            out = jnp.einsum("te,etd->td", probs.astype(ys.dtype), ys)
+            new_state = {"aux_loss": jnp.zeros((), jnp.float32)}
+            return out.reshape(orig_shape[:-1] + out.shape[-1:]), new_state
+
+        # ---- sparse top-k routing with capacity ----
+        cap = max(1, int(self.capacity_factor * t * self.top_k / e))
+        gate_vals, gate_idx = jax.lax.top_k(probs, self.top_k)  # [T, k]
+        if self.top_k > 1:
+            gate_vals = gate_vals / jnp.maximum(
+                gate_vals.sum(-1, keepdims=True), 1e-9)
+        # top-1 keeps the RAW softmax probability (Switch): renormalizing
+        # would make the combine weight identically 1, whose gradient wrt
+        # the gate logits is zero — the router would never learn from the
+        # task loss
+
+        # position of each (token, k) inside its expert's capacity buffer
+        onehot = jax.nn.one_hot(gate_idx, e, dtype=jnp.int32)  # [T, k, E]
+        flat = onehot.reshape(t * self.top_k, e)
+        pos = jnp.cumsum(flat, axis=0) - flat  # arrival order per expert
+        pos = (pos * flat).sum(-1).reshape(t, self.top_k)
+        keep = pos < cap
+
+        # per-choice dispatch [T, k, E, C]: k-th choice of token t occupies
+        # slot (expert gate_idx[t,k], position pos[t,k]) when kept
+        disp_k = (jax.nn.one_hot(gate_idx, e, dtype=tokens.dtype)[..., None]
+                  * jax.nn.one_hot(pos, cap, dtype=tokens.dtype)[:, :, None, :]
+                  * keep[..., None, None].astype(tokens.dtype))
+        disp = disp_k.sum(1)                               # [T, E, C] 0/1
+        xs = jnp.einsum("tec,td->ecd", disp, tokens)       # [E, C, d]
+        ys = self._run_experts(params["experts"], xs, training, rng)
+        combine = (disp_k * gate_vals[..., None, None]).sum(1).astype(ys.dtype)
+        out = jnp.einsum("tec,ecd->td", combine, ys)
+
+        # load-balance aux loss (Switch-style): E * sum_e f_e * P_e
+        frac_tokens = (jax.nn.one_hot(gate_idx[:, 0], e)
+                       .mean(0))               # fraction routed (top-1)
+        frac_probs = probs.mean(0)
+        aux = e * jnp.sum(frac_tokens * frac_probs)
+        new_state = {"aux_loss": aux}
+        return out.reshape(orig_shape[:-1] + out.shape[-1:]), new_state
+
+    # ------------------------------------------------------------- placement
+    def place_expert_parallel(self, mesh: Mesh, params,
+                              axis: str = "expert"):
+        """Shard the stacked expert params over the expert axis; the gate
+        stays replicated. Under jit, XLA inserts the all-to-all that moves
+        dispatched tokens to their expert's device."""
+        ex = jax.tree_util.tree_map(
+            lambda x: jax.device_put(x, NamedSharding(mesh, P(axis))),
+            params["experts"])
+        gate = jax.device_put(params["gate"], NamedSharding(mesh, P()))
+        return {"gate": gate, "experts": ex}

@@ -1,21 +1,169 @@
-"""Flash attention for TPU.
+"""Flash attention for TPU — hand-tiled Pallas forward kernel.
 
-Currently the XLA-path implementation (blockwise-fused by the compiler); the
-hand-tiled Pallas kernel lands behind the same signature so callers —
-``nn.MultiHeadAttention(attn_impl="flash")`` — never change.
+The reference has no attention at all (SURVEY.md §2.7); this kernel exists
+for the long-context path the new framework treats as first-class. Design
+per the TPU Pallas playbook:
+
+* grid = (batch*heads, q_blocks); each program owns one (BLOCK_Q, d) query
+  tile in VMEM and streams K/V tiles with an online (one-pass) softmax —
+  O(s) memory instead of materializing the (s, s) score matrix in HBM.
+* scores accumulate in fp32 (``preferred_element_type``) on the MXU while
+  inputs may be bf16 — the same numerics as the XLA dense path.
+* On non-TPU backends the kernel runs in interpret mode (tests), so one
+  code path serves CPU tests and TPU execution.
+
+Backward: a ``jax.custom_vjp`` that recomputes attention with the dense
+XLA path (flash-style blockwise backward is a later optimization;
+``jax.checkpoint`` around the attention already gives the usual
+remat-memory profile for training).
+
+``nn.MultiHeadAttention(attn_impl="flash")`` routes here.
 """
 
 from __future__ import annotations
 
+import functools
 from typing import Optional
 
 import jax
+import jax.numpy as jnp
 
 from bigdl_tpu.nn import attention as _dense
 
+__all__ = ["flash_attention"]
+
+_NEG_INF = -1e30
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, scale: float,
+                causal: bool, seq_k: int, block_q: int, q_offset: int):
+    from jax.experimental import pallas as pl
+
+    j = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32)  # (BQ, d)
+    bq = q.shape[0]
+    n_k = seq_k // block_k
+
+    # bottom-right aligned causal (matches dot_product_attention): query i
+    # sees keys <= (s_k - s_q) + i
+    q_pos = (q_offset + j * block_q
+             + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0))
+
+    def body(kb, carry):
+        m, l, acc = carry
+        kblk = k_ref[0, pl.dslice(kb * block_k, block_k), :]
+        vblk = v_ref[0, pl.dslice(kb * block_k, block_k), :]
+        s = jax.lax.dot_general(
+            q, kblk.astype(jnp.float32),
+            (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # (BQ, BK)
+        if causal:
+            k_pos = kb * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        blk_max = jnp.max(s, axis=-1, keepdims=True)
+        new_m = jnp.maximum(m, blk_max)
+        p = jnp.exp(s - new_m)
+        if causal:
+            p = jnp.where(q_pos >= k_pos, p, 0.0)
+        corr = jnp.exp(m - new_m)
+        l = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc = acc * corr + jax.lax.dot_general(
+            p, vblk.astype(jnp.float32),
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return new_m, l, acc
+
+    m0 = jnp.full((bq, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((bq, 1), jnp.float32)
+    a0 = jnp.zeros(q.shape, jnp.float32)
+    _, l, acc = jax.lax.fori_loop(0, n_k, body, (m0, l0, a0))
+    o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+def _pad_to(x, mult, axis):
+    pad = (-x.shape[axis]) % mult
+    if pad == 0:
+        return x, 0
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths), pad
+
+
+def _flash_fwd(q, k, v, causal: bool, block_q: int, block_k: int):
+    from jax.experimental import pallas as pl
+
+    b, h, s_q, d = q.shape
+    s_k = k.shape[-2]
+    scale = 1.0 / (d ** 0.5)
+
+    qf = q.reshape(b * h, s_q, d)
+    kf = k.reshape(b * h, s_k, d)
+    vf = v.reshape(b * h, s_k, d)
+
+    bq = min(block_q, max(8, s_q))
+    bk = min(block_k, max(8, s_k))
+    if s_k % bk:
+        # ragged key length would need a validity mask woven into the
+        # online softmax; dense handles it (pad_to on K alone would let
+        # padded keys win the softmax)
+        return _dense.dot_product_attention(q, k, v, causal=causal,
+                                            mask=None)
+    qf, pad_q = _pad_to(qf, bq, 1)
+    sq, sk = qf.shape[1], kf.shape[1]
+
+    kernel = functools.partial(_fwd_kernel, block_k=bk, scale=scale,
+                               causal=causal, seq_k=sk, block_q=bq,
+                               q_offset=s_k - s_q)
+    interpret = jax.default_backend() != "tpu"
+    out = pl.pallas_call(
+        kernel,
+        grid=(b * h, sq // bq),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, sk, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, sk, d), lambda i, j: (i, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+        interpret=interpret,
+    )(qf, kf, vf)
+    if pad_q:
+        out = out[:, :s_q]
+    return out.reshape(b, h, s_q, d)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash(q, k, v, causal, block_q, block_k):
+    return _flash_fwd(q, k, v, causal, block_q, block_k)
+
+
+def _flash_vjp_fwd(q, k, v, causal, block_q, block_k):
+    return _flash(q, k, v, causal, block_q, block_k), (q, k, v)
+
+
+def _flash_vjp_bwd(causal, block_q, block_k, res, g):
+    q, k, v = res
+    # dense recompute backward (correct; flash-blockwise bwd is a future
+    # optimization)
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: _dense.dot_product_attention(
+            q_, k_, v_, causal=causal, mask=None), q, k, v)
+    return vjp(g)
+
+
+_flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
 
 def flash_attention(q, k, v, *, causal: bool = False,
-                    mask: Optional[jax.Array] = None):
-    """(b, h, s, d) attention; falls back to the dense XLA path until the
-    Pallas kernel is wired in."""
-    return _dense.dot_product_attention(q, k, v, causal=causal, mask=mask)
+                    mask: Optional[jax.Array] = None,
+                    block_q: int = 128, block_k: int = 128):
+    """(b, h, s, d) attention via the Pallas online-softmax kernel.
+
+    Falls back to the dense XLA path when an explicit ``mask`` is given
+    (arbitrary masks don't tile) or when key length isn't tileable.
+    """
+    if mask is not None:
+        return _dense.dot_product_attention(q, k, v, causal=causal,
+                                            mask=mask)
+    return _flash(q, k, v, causal, block_q, block_k)

@@ -6,3 +6,7 @@ from bigdl_tpu.parallel.tensor_parallel import (
     TensorParallel, megatron_specs, replicated_specs,
 )
 from bigdl_tpu.parallel.sequence import ring_attention, make_ring_attention
+from bigdl_tpu.parallel.pipeline import (
+    PipelineStack, pipeline_forward, place_pipeline_params,
+    make_pipeline_train_step,
+)

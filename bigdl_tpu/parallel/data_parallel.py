@@ -55,6 +55,29 @@ def _zero1_spec(leaf, mesh: Mesh, axis: str) -> P:
     return P()
 
 
+def opt_sharding_like_params(mesh, opt_state, params, param_shardings,
+                             zero1_axis: Optional[str] = None):
+    """Shardings for an optimizer-state pytree: subtrees that mirror the
+    params structure (velocity/m/v/accum) take the matching param sharding;
+    everything else replicates, optionally ZeRO-1 sharded over
+    ``zero1_axis``. Shared by the TP and pipeline strategies."""
+    p_struct = jax.tree_util.tree_structure(params)
+
+    def fallback(x):
+        if zero1_axis is not None and hasattr(x, "ndim"):
+            return NamedSharding(mesh, _zero1_spec(x, mesh, zero1_axis))
+        return NamedSharding(mesh, P())
+
+    def subtree(st):
+        if jax.tree_util.tree_structure(st) == p_struct:
+            return param_shardings
+        return jax.tree_util.tree_map(fallback, st)
+
+    if isinstance(opt_state, dict):
+        return {k: subtree(v) for k, v in opt_state.items()}
+    return subtree(opt_state)
+
+
 class DataParallel:
     """Strategy object consumed by :class:`bigdl_tpu.optim.Optimizer`.
 
@@ -113,12 +136,16 @@ class DataParallel:
         explicit shard_map strategies can psum here."""
         return grads, loss
 
-    def compile_step(self, train_step):
+    def compile_step(self, train_step, batch_spec: Optional[P] = None):
+        """``batch_spec`` overrides the x/y input sharding (e.g.
+        P('data', 'seq', None) when composing with sequence parallelism)."""
         if self._opt_shardings is None:
             raise RuntimeError("DataParallel.place() must run before "
                                "compile_step()")
+        batch = (self._batch if batch_spec is None
+                 else NamedSharding(self.mesh, batch_spec))
         in_shardings = (self._repl, self._repl, self._opt_shardings,
-                        self._batch, self._batch, self._repl)
+                        batch, batch, self._repl)
         out_shardings = (self._repl, self._repl, self._opt_shardings,
                          self._repl)
         donate = (0, 1, 2) if self.donate else ()

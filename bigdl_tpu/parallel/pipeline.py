@@ -1,0 +1,187 @@
+"""Pipeline parallelism: GPipe microbatch schedule over a ``pipe`` mesh axis.
+
+The reference has no pipeline parallelism (SURVEY.md §2.7: "Pipeline
+parallel — NO"); this is a new TPU-first capability. Unlike GPU frameworks
+that run one process per stage with send/recv, the TPU-native shape is a
+single SPMD program: the layer stack's parameters are stacked on a leading
+``[L, ...]`` dim and sharded over the ``pipe`` axis, and microbatch
+activations rotate between neighboring devices with ``lax.ppermute`` (one
+ICI hop per tick) inside a ``lax.scan`` — compiler-visible, fully jittable,
+and differentiable (the VJP of ppermute is the reverse ppermute, so the
+backward pipeline falls out of ``jax.grad`` for free).
+
+Schedule: M microbatches through P stages takes M + P - 1 ticks; bubble
+fraction (P-1)/(M+P-1) — raise ``microbatches`` to amortize (GPipe).
+
+Blocks must be homogeneous (same params structure, input shape == output
+shape) and stateless — the transformer-block case. Embedding/head layers
+run replicated outside the pipelined middle.
+
+Composes with data parallelism: pass ``data_axis`` to also split each
+microbatch over a ``data`` mesh axis.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from bigdl_tpu.core.module import Module
+
+__all__ = ["PipelineStack", "pipeline_forward", "place_pipeline_params",
+           "make_pipeline_train_step"]
+
+
+class PipelineStack(Module):
+    """L homogeneous blocks with params stacked on a leading ``[L, ...]``
+    dim — the layout pipeline (and remat-scan) execution wants.
+
+    Single-device ``apply`` runs the stack as one ``lax.scan`` over layers
+    (XLA compiles ONE block body regardless of L — faster compiles than an
+    unrolled Sequential). :func:`pipeline_forward` runs the same params
+    pipelined over a mesh axis.
+    """
+
+    def __init__(self, block: Module, num_blocks: int,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self._block_state = block.init_state()
+        if jax.tree_util.tree_leaves(self._block_state):
+            raise ValueError("PipelineStack blocks must be stateless "
+                             f"({type(block).__name__} has state)")
+        self.block = block
+        self.num_blocks = num_blocks
+
+    def init(self, rng):
+        inits = [self.block.init(jax.random.fold_in(rng, i))
+                 for i in range(self.num_blocks)]
+        return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *inits)
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        def body(h, pb):
+            h2, _ = self.block.apply(pb, self._block_state, h,
+                                     training=training, rng=rng)
+            return h2, None
+
+        y, _ = jax.lax.scan(body, x, params)
+        return y, state
+
+
+def place_pipeline_params(mesh: Mesh, params, axis: str = "pipe"):
+    """Shard stacked ``[L, ...]`` params over the pipe axis (stage p owns
+    blocks [p*L/P, (p+1)*L/P))."""
+    shard = NamedSharding(mesh, P(axis))
+    return jax.tree_util.tree_map(lambda x: jax.device_put(x, shard), params)
+
+
+def pipeline_forward(stack: PipelineStack, mesh: Mesh, params, x,
+                     microbatches: int, axis: str = "pipe",
+                     data_axis: Optional[str] = None,
+                     training: bool = False, rng=None):
+    """Pipelined forward of ``stack`` over the mesh: returns the same value
+    as ``stack.apply`` (up to fp reassociation), computed with the GPipe
+    rotation. ``x`` is the full (batch, ...) input; it is split into
+    ``microbatches`` equal microbatches along dim 0.
+    """
+    n_stage = mesh.shape[axis]
+    if stack.num_blocks % n_stage:
+        raise ValueError(f"{stack.num_blocks} blocks not divisible by "
+                         f"{n_stage} pipeline stages")
+    if x.shape[0] % microbatches:
+        raise ValueError(f"batch {x.shape[0]} not divisible by "
+                         f"{microbatches} microbatches")
+    m = microbatches
+    x_mb = x.reshape((m, x.shape[0] // m) + x.shape[1:])
+
+    block = stack.block
+
+    def local_fn(p_local, xs):
+        # p_local: [L/P, ...] this stage's blocks; xs: [M, mb_local, ...]
+        p_sz = jax.lax.psum(1, axis)
+        idx = jax.lax.axis_index(axis)
+        perm = [(i, (i + 1) % p_sz) for i in range(p_sz)]
+
+        def stage(h, t):
+            def body(carry, args):
+                i, h = carry
+                pb = args
+                r = (None if rng is None
+                     else jax.random.fold_in(jax.random.fold_in(rng, t), i))
+                h2, _ = block.apply(pb, stack._block_state, h,
+                                    training=training, rng=r)
+                return (i + 1, h2), None
+
+            (_, h), _ = jax.lax.scan(body, (idx * p_local_len, h), p_local)
+            return h
+
+        p_local_len = jax.tree_util.tree_leaves(p_local)[0].shape[0]
+        zeros = jnp.zeros_like(xs[0])
+        outputs = jnp.zeros_like(xs)
+
+        def tick(carry, t):
+            state_in, outputs = carry
+            inj = jnp.take(xs, jnp.clip(t, 0, m - 1), axis=0)
+            h_in = jnp.where(idx == 0, inj, state_in)
+            h_out = stage(h_in, t)
+            out_t = t - (p_sz - 1)
+            start = (jnp.clip(out_t, 0, m - 1),) + (0,) * (xs.ndim - 1)
+            upd = jax.lax.dynamic_update_slice(outputs, h_out[None], start)
+            outputs = jnp.where((out_t >= 0) & (idx == p_sz - 1), upd,
+                                outputs)
+            sent = jax.lax.ppermute(h_out, axis, perm)
+            return (sent, outputs), None
+
+        (_, outputs), _ = jax.lax.scan(tick, (zeros, outputs),
+                                       jnp.arange(m + p_sz - 1))
+        # only the last stage holds real outputs; replicate over the pipe
+        # axis (zeros elsewhere make psum a broadcast, not a sum)
+        outputs = jnp.where(idx == p_sz - 1, outputs, 0.0)
+        return jax.lax.psum(outputs, axis)
+
+    p_spec = jax.tree_util.tree_map(lambda _: P(axis), params)
+    x_spec = P(None, data_axis) if data_axis else P()
+    y = jax.shard_map(local_fn, mesh=mesh,
+                      in_specs=(p_spec, x_spec),
+                      out_specs=x_spec, check_vma=False)(params, x_mb)
+    return y.reshape(x.shape[0:1] + y.shape[2:])
+
+
+def make_pipeline_train_step(stack: PipelineStack, mesh: Mesh, criterion,
+                             optim_method, microbatches: int,
+                             axis: str = "pipe",
+                             data_axis: Optional[str] = None):
+    """Jitted full train step (loss, grads, update) with the pipelined
+    forward/backward. Params and optimizer state stay sharded over the pipe
+    axis (stage-local optimizer — the pipeline analog of the reference's
+    per-partition optimizer shards)."""
+    p_shard = NamedSharding(mesh, P(axis))
+    # x/y arrive as flat (batch, ...); the microbatch split happens inside
+    # the jit, so batch-dim sharding over data is enough here
+    x_shard = NamedSharding(mesh, P(data_axis) if data_axis else P())
+
+    def train_step(params, opt_state, x, y, rng):
+        def loss_fn(p):
+            out = pipeline_forward(stack, mesh, p, x, microbatches,
+                                   axis=axis, data_axis=data_axis,
+                                   training=True, rng=rng)
+            return criterion(out, y)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        new_params, new_opt = optim_method.update(grads, opt_state, params)
+        return new_params, new_opt, loss
+
+    def compile_for(opt_state, params):
+        from bigdl_tpu.parallel.data_parallel import opt_sharding_like_params
+        p_specs = jax.tree_util.tree_map(lambda _: p_shard, params)
+        o_specs = opt_sharding_like_params(mesh, opt_state, params, p_specs)
+        repl = NamedSharding(mesh, P())
+        return jax.jit(
+            train_step,
+            in_shardings=(p_specs, o_specs, x_shard, x_shard, repl),
+            out_shardings=(p_specs, o_specs, repl),
+            donate_argnums=(0, 1))
+
+    return compile_for

@@ -37,15 +37,14 @@ its collectives are per-layer, while ``data``'s is one grad reduction.
 
 from __future__ import annotations
 
-from functools import partial
-from typing import Any, Callable, Optional
+from typing import Callable, Optional
 
 import jax
-import jax.numpy as jnp
-import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from bigdl_tpu.parallel.data_parallel import DataParallel, _zero1_spec
+from bigdl_tpu.parallel.data_parallel import (
+    DataParallel, opt_sharding_like_params,
+)
 
 __all__ = ["TensorParallel", "megatron_specs", "replicated_specs"]
 
@@ -177,43 +176,29 @@ class TensorParallel(DataParallel):
             lambda s: NamedSharding(self.mesh, s), specs,
             is_leaf=lambda x: isinstance(x, P))
 
-    def _opt_sharding_like_params(self, opt_state, params, param_shardings):
-        """Opt-state leaves that mirror params (velocity/m/v/accum trees)
-        take the matching param sharding; scalars/mismatches replicate with
-        optional ZeRO-1 over the data axis."""
-        p_struct = jax.tree_util.tree_structure(params)
-
-        def subtree(st):
-            if jax.tree_util.tree_structure(st) == p_struct:
-                return param_shardings
-            return jax.tree_util.tree_map(
-                lambda x: NamedSharding(
-                    self.mesh,
-                    _zero1_spec(x, self.mesh, self.axis) if (
-                        self.zero1 and hasattr(x, "ndim")) else P()), st)
-
-        if isinstance(opt_state, dict):
-            return {k: subtree(v) for k, v in opt_state.items()}
-        return subtree(opt_state)
-
     def place(self, params, mod_state, opt_state):
         self._param_shardings = self._build_param_shardings(params)
         params = jax.tree_util.tree_map(
             lambda x, s: jax.device_put(x, s), params, self._param_shardings)
         mod_state = jax.device_put(mod_state, self._repl)
-        self._opt_shardings = self._opt_sharding_like_params(
-            opt_state, params, self._param_shardings)
+        self._opt_shardings = opt_sharding_like_params(
+            self.mesh, opt_state, params, self._param_shardings,
+            zero1_axis=self.axis if self.zero1 else None)
         opt_state = jax.tree_util.tree_map(
             lambda x, s: jax.device_put(x, s), opt_state, self._opt_shardings)
         return params, mod_state, opt_state
 
     # ------------------------------------------------------------- compile
-    def compile_step(self, train_step):
+    def compile_step(self, train_step, batch_spec: Optional[P] = None):
+        """``batch_spec`` overrides the x/y sharding (e.g.
+        P('data', 'seq', None) when composing with ring attention)."""
         if self._param_shardings is None:
             raise RuntimeError("TensorParallel.place() must run before "
                                "compile_step()")
+        batch = (self._batch if batch_spec is None
+                 else NamedSharding(self.mesh, batch_spec))
         in_shardings = (self._param_shardings, self._repl, self._opt_shardings,
-                        self._batch, self._batch, self._repl)
+                        batch, batch, self._repl)
         out_shardings = (self._param_shardings, self._repl,
                          self._opt_shardings, self._repl)
         donate = (0, 1, 2) if self.donate else ()

@@ -1,0 +1,99 @@
+"""Pipeline parallelism (GPipe schedule over a `pipe` mesh axis) — new
+TPU-first capability (reference has none, SURVEY.md §2.7)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bigdl_tpu import nn
+from bigdl_tpu.optim import SGD
+from bigdl_tpu.parallel import make_mesh
+from bigdl_tpu.parallel.pipeline import (
+    PipelineStack, make_pipeline_train_step, pipeline_forward,
+    place_pipeline_params,
+)
+
+
+def _stack(l=4, d=8):
+    return PipelineStack(
+        nn.TransformerEncoderLayer(d_model=d, num_heads=2, d_ff=16), l)
+
+
+def test_stack_apply_matches_unrolled(rng):
+    """Single-device scan-over-layers == applying blocks one by one."""
+    stack = _stack()
+    params = stack.init(rng)
+    x = jnp.asarray(np.random.RandomState(0).randn(2, 5, 8), jnp.float32)
+    y_scan, _ = stack.apply(params, (), x)
+    h = x
+    for i in range(stack.num_blocks):
+        pb = jax.tree_util.tree_map(lambda a: a[i], params)
+        h, _ = stack.block.apply(pb, (), h)
+    np.testing.assert_allclose(np.asarray(y_scan), np.asarray(h), atol=1e-5)
+
+
+@pytest.mark.parametrize("stages,micro", [(4, 4), (2, 8), (8, 2)])
+def test_pipeline_forward_matches_sequential(rng, stages, micro):
+    mesh = make_mesh({"pipe": stages, "rest": -1})
+    stack = _stack(l=8)
+    params = stack.init(rng)
+    x = jnp.asarray(np.random.RandomState(1).randn(16, 5, 8), jnp.float32)
+    y_ref, _ = stack.apply(params, (), x)
+    sharded = place_pipeline_params(mesh, params, "pipe")
+    y_pipe = jax.jit(lambda p, xs: pipeline_forward(
+        stack, mesh, p, xs, micro, axis="pipe"))(sharded, x)
+    np.testing.assert_allclose(np.asarray(y_pipe), np.asarray(y_ref),
+                               atol=1e-4)
+
+
+def test_pipeline_rejects_bad_split(rng):
+    mesh = make_mesh({"pipe": 8})
+    stack = _stack(l=6)  # 6 % 8 != 0
+    params = stack.init(rng)
+    x = jnp.zeros((4, 5, 8))
+    with pytest.raises(ValueError, match="not divisible"):
+        pipeline_forward(stack, mesh, params, x, 2)
+    with pytest.raises(ValueError, match="microbatches"):
+        pipeline_forward(_stack(l=8), mesh, _stack(l=8).init(rng),
+                         jnp.zeros((5, 5, 8)), 2)
+
+
+def test_pipeline_train_step_matches_single_device(rng):
+    """Pipelined fwd+bwd+update == plain single-device step (grads flow
+    through ppermute/scan)."""
+    mesh = make_mesh({"pipe": 4, "data": 2})
+    d = 8
+    stack = _stack(l=4, d=d)
+    params = stack.init(rng)
+    crit = nn.MSECriterion()
+    opt = SGD(learning_rate=0.1, momentum=0.9)
+
+    rs = np.random.RandomState(0)
+    x = jnp.asarray(rs.randn(16, 5, d), jnp.float32)
+    y = jnp.asarray(rs.randn(16, 5, d), jnp.float32)
+
+    # reference: plain step on replicated params
+    def ref_step(p, o):
+        def loss_fn(p):
+            out, _ = stack.apply(p, (), x, training=True)
+            return crit(out, y)
+        loss, g = jax.value_and_grad(loss_fn)(p)
+        return *opt.update(g, o, p), loss
+
+    p_ref, o_ref, l_ref = jax.jit(ref_step)(params, opt.init(params))
+
+    compile_for = make_pipeline_train_step(stack, mesh, crit, opt,
+                                           microbatches=4, axis="pipe",
+                                           data_axis="data")
+    sharded = place_pipeline_params(mesh, params, "pipe")
+    opt_state = jax.tree_util.tree_map(jnp.zeros_like,
+                                       opt.init(params))  # fresh, same tree
+    step = compile_for(opt_state, sharded)
+    p_pipe, o_pipe, l_pipe = step(sharded, opt_state, x, y,
+                                  jax.random.PRNGKey(9))
+
+    np.testing.assert_allclose(float(l_pipe), float(l_ref), atol=1e-4)
+    for a, b in zip(jax.tree_util.tree_leaves(p_ref),
+                    jax.tree_util.tree_leaves(jax.device_get(p_pipe))):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-4)
