@@ -1,0 +1,82 @@
+"""Imported-model validation (reference example/loadmodel/
+ModelValidator.scala — loads a Caffe (.prototxt/.caffemodel), Torch (.t7)
+or native checkpoint into the matching model builder and evaluates Top-1/
+Top-5 on an ImageNet-style val folder).
+
+    python -m bigdl_tpu.cli.loadmodel --modelType caffe \
+        --model deploy.prototxt --weights bvlc.caffemodel \
+        --modelName alexnet -f /data/imagenet
+"""
+
+from __future__ import annotations
+
+import argparse
+
+from bigdl_tpu.cli import common
+
+_BUILDERS = {
+    "alexnet": lambda n: _models().alexnet(n),
+    "inception_v1": lambda n: _models().inception_v1_no_aux(n),
+    "resnet50": lambda n: _models().resnet50(n),
+    "vgg16": lambda n: _models().vgg16(n),
+}
+
+
+def _models():
+    from bigdl_tpu import models
+    return models
+
+
+def load_into(model, model_type: str, model_path: str, weights: str | None):
+    """Returns (params, mod_state) with imported weights copied in
+    (reference Module.load/loadTorch/loadCaffe, nn/Module.scala:28-41)."""
+    import jax
+
+    params = model.init(jax.random.PRNGKey(0))
+    mod_state = model.init_state()
+    if model_type == "caffe":
+        from bigdl_tpu.interop import load_caffe
+        params = load_caffe(model, params, weights, prototxt_path=model_path)
+    elif model_type == "torch":
+        from bigdl_tpu.interop import load_torch_params
+        params = load_torch_params(model, params, model_path)
+    elif model_type == "bigdl":
+        params, mod_state = common.load_trained(model, model_path)
+    else:
+        raise SystemExit(f"unknown modelType {model_type}")
+    return params, mod_state
+
+
+def main(argv=None):
+    common.setup_logging()
+    p = argparse.ArgumentParser("bigdl-tpu loadmodel")
+    p.add_argument("--modelType", required=True,
+                   choices=["caffe", "torch", "bigdl"])
+    p.add_argument("--modelName", required=True, choices=sorted(_BUILDERS))
+    p.add_argument("--model", required=True,
+                   help="prototxt (caffe) / .t7 (torch) / checkpoint (bigdl)")
+    p.add_argument("--weights", default=None, help=".caffemodel (caffe)")
+    p.add_argument("-f", "--folder", required=True,
+                   help="val folder: <class>/<imgs>")
+    p.add_argument("-b", "--batchSize", type=int, default=32)
+    p.add_argument("--classNum", type=int, default=1000)
+    args = p.parse_args(argv)
+
+    from bigdl_tpu import nn  # noqa: F401  (models import side effects)
+    from bigdl_tpu.dataset.folder import ImageFolderDataSet
+    from bigdl_tpu.optim import Top1Accuracy, Top5Accuracy
+
+    model = _BUILDERS[args.modelName](args.classNum)
+    params, mod_state = load_into(model, args.modelType, args.model,
+                                  args.weights)
+    # Caffe AlexNet crops to 227; the rest take 224
+    size = (227, 227) if args.modelName == "alexnet" else (224, 224)
+    val = ImageFolderDataSet(args.folder, args.batchSize, size=size,
+                             mean=(123.0, 117.0, 104.0),
+                             std=(58.4, 57.1, 57.4))
+    return common.evaluate(model, params, mod_state, val,
+                           [Top1Accuracy(), Top5Accuracy()])
+
+
+if __name__ == "__main__":
+    main()

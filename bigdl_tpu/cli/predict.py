@@ -1,0 +1,85 @@
+"""Batch image prediction (reference example/imageclassification/
+ImagePredictor.scala:34-82 — DLClassifier over a folder of images; here the
+Spark DataFrame becomes a plain file stream through
+:class:`bigdl_tpu.utils.Classifier`).
+
+    python -m bigdl_tpu.cli.predict --model ckpt_dir --modelName lenet \
+        -f /path/to/images [--topN 5]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+
+from bigdl_tpu.cli import common
+
+
+def main(argv=None):
+    common.setup_logging()
+    p = argparse.ArgumentParser("bigdl-tpu predict")
+    p.add_argument("--model", required=True, help="checkpoint dir or file")
+    p.add_argument("--modelName", default="lenet",
+                   choices=["lenet", "alexnet", "inception_v1", "resnet50",
+                            "vgg16"])
+    p.add_argument("-f", "--folder", required=True,
+                   help="folder of images (flat or class subdirs)")
+    p.add_argument("-b", "--batchSize", type=int, default=32)
+    p.add_argument("--classNum", type=int, default=1000)
+    p.add_argument("--topN", type=int, default=1)
+    args = p.parse_args(argv)
+
+    import numpy as np
+
+    from bigdl_tpu import models
+    from bigdl_tpu.dataset.folder import _decode, list_image_folder
+    from bigdl_tpu.utils import Classifier
+
+    if args.modelName == "lenet":
+        model, size = models.lenet5(max(args.classNum, 10)), (28, 28)
+    else:
+        build = {"alexnet": models.alexnet,
+                 "inception_v1": models.inception_v1_no_aux,
+                 "resnet50": models.resnet50,
+                 "vgg16": models.vgg16}[args.modelName]
+        model, size = build(args.classNum), (
+            (227, 227) if args.modelName == "alexnet" else (224, 224))
+
+    params, mod_state = common.load_trained(model, args.model)
+    clf = Classifier(model, params, mod_state, batch_size=args.batchSize)
+
+    # accept both a class-subdir tree and a flat folder of images
+    try:
+        paths, _, _ = list_image_folder(args.folder)
+    except (FileNotFoundError, ValueError):
+        paths = []
+    if not paths:
+        exts = (".jpg", ".jpeg", ".png", ".bmp")
+        paths = [os.path.join(args.folder, f)
+                 for f in sorted(os.listdir(args.folder))
+                 if f.lower().endswith(exts)]
+    if not paths:
+        raise SystemExit(f"no images under {args.folder}")
+
+    for i in range(0, len(paths), args.batchSize):
+        chunk = paths[i:i + args.batchSize]
+        imgs = np.stack([_decode(p_, size) for p_ in chunk])
+        if args.modelName == "lenet":
+            # match cli/lenet.py training normalization
+            from bigdl_tpu.dataset.mnist import TRAIN_MEAN, TRAIN_STD
+            if imgs.shape[-1] == 3:
+                imgs = imgs.mean(-1, keepdims=True)
+            x = ((imgs.astype(np.float32) / 255.0) - TRAIN_MEAN) / TRAIN_STD
+        else:
+            # match the ImageFolderDataSet stats the imagenet CLIs train with
+            mean = np.asarray((123.0, 117.0, 104.0), np.float32)
+            std = np.asarray((58.4, 57.1, 57.4), np.float32)
+            x = (imgs.astype(np.float32) - mean) / std
+        scores = clf.predict_scores(x)
+        top = np.argsort(-scores, axis=-1)[:, : args.topN]
+        for path, classes in zip(chunk, top):
+            print(f"{path}\t{' '.join(map(str, classes))}")
+
+
+if __name__ == "__main__":
+    main()

@@ -242,9 +242,13 @@ class Sequential(Container):
         new_state: dict[str, State] = {}
         for i, m in enumerate(self._modules):
             k = str(i)
-            x, s = m.apply(
-                params[k], state[k], x, training=training, rng=_child_rng(rng, i)
-            )
+            # named_scope tags each child's ops in profiler traces/HLO —
+            # the jit-era replacement for per-module forwardTime counters
+            with jax.named_scope(f"{i}:{m.name}"):
+                x, s = m.apply(
+                    params[k], state[k], x,
+                    training=training, rng=_child_rng(rng, i)
+                )
             new_state[k] = s
         return x, new_state
 

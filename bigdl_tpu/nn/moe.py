@@ -69,12 +69,23 @@ class MoE(Module):
 
     # ----------------------------------------------------------------- apply
     def _run_experts(self, p_experts, xs, training, rng):
-        """vmap the expert over its stacked params: xs [E, C, d] -> [E, C, d']."""
-        def one(pb, xb):
+        """vmap the expert over its stacked params: xs [E, C, d] -> [E, C, d'].
+        Each expert gets its own rng stream (split per expert) so dropout
+        masks are decorrelated across experts."""
+        if rng is None:
+            def one(pb, xb):
+                y, _ = self.expert.apply(pb, self._expert_state, xb,
+                                         training=training)
+                return y
+            return jax.vmap(one)(p_experts, xs)
+
+        keys = jax.random.split(rng, self.num_experts)
+
+        def one_k(pb, xb, k):
             y, _ = self.expert.apply(pb, self._expert_state, xb,
-                                     training=training, rng=rng)
+                                     training=training, rng=k)
             return y
-        return jax.vmap(one)(p_experts, xs)
+        return jax.vmap(one_k)(p_experts, xs, keys)
 
     def apply(self, params, state, x, *, training=False, rng=None):
         orig_shape = x.shape

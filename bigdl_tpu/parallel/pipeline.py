@@ -61,12 +61,14 @@ class PipelineStack(Module):
         return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *inits)
 
     def apply(self, params, state, x, *, training=False, rng=None):
-        def body(h, pb):
+        def body(carry, pb):
+            i, h = carry
+            r = None if rng is None else jax.random.fold_in(rng, i)
             h2, _ = self.block.apply(pb, self._block_state, h,
-                                     training=training, rng=rng)
-            return h2, None
+                                     training=training, rng=r)
+            return (i + 1, h2), None
 
-        y, _ = jax.lax.scan(body, x, params)
+        (_, y), _ = jax.lax.scan(body, (0, x), params)
         return y, state
 
 
@@ -81,10 +83,12 @@ def pipeline_forward(stack: PipelineStack, mesh: Mesh, params, x,
                      microbatches: int, axis: str = "pipe",
                      data_axis: Optional[str] = None,
                      training: bool = False, rng=None):
-    """Pipelined forward of ``stack`` over the mesh: returns the same value
-    as ``stack.apply`` (up to fp reassociation), computed with the GPipe
-    rotation. ``x`` is the full (batch, ...) input; it is split into
-    ``microbatches`` equal microbatches along dim 0.
+    """Pipelined forward of ``stack`` over the mesh: for rng-independent
+    blocks this returns the same value as ``stack.apply`` (up to fp
+    reassociation). With dropout the masks necessarily differ (each
+    microbatch draws its own, folded by tick and layer) but stay
+    decorrelated across layers. ``x`` is the full (batch, ...) input; it is
+    split into ``microbatches`` equal microbatches along dim 0.
     """
     n_stage = mesh.shape[axis]
     if stack.num_blocks % n_stage:

@@ -1,0 +1,92 @@
+"""Tracing / profiling (SURVEY.md §5 "Tracing / profiling").
+
+The reference has three mechanisms; each maps here:
+
+1. Per-module wall-time counters (AbstractModule.forwardTime/getTimes,
+   nn/abstractnn/AbstractModule.scala:107-152, Container.scala:70-77)
+   -> :func:`time_modules`: walks a module tree, times each child's
+   forward eagerly (outside jit — under jit XLA fuses across module
+   boundaries, so per-module wall time is only meaningful per-dispatch),
+   and returns (path, seconds) rows like ``getTimes()``.
+2. Named counters aggregated across the cluster (optim/Metrics.scala via
+   Spark accumulators) -> :class:`bigdl_tpu.optim.Metrics` (host-side
+   counters; one process per host, aggregated by the caller).
+3. Perf binaries (models/utils/DistriOptimizerPerf) -> bigdl_tpu.cli.perf.
+
+New, TPU-only: :func:`trace` wraps ``jax.profiler.trace`` so any training
+loop can emit an XPlane/TensorBoard trace (the XLA-level replacement for
+per-op timers), and Sequential tags each child with ``jax.named_scope`` so
+modules are identifiable inside the trace.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Any, Optional
+
+import jax
+
+__all__ = ["time_modules", "trace", "format_times"]
+
+
+@contextlib.contextmanager
+def trace(logdir: str):
+    """Profile a block into ``logdir`` (open with TensorBoard or xprof):
+
+    >>> with trace("/tmp/tb"):
+    ...     step(params, ...)  # traced on device timeline
+    """
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def time_modules(module, params, x, state: Optional[Any] = None,
+                 iters: int = 3, training: bool = False, rng=None):
+    """Per-child forward wall time, eagerly, recursing into Sequential
+    chains (reference getTimes semantics). Returns rows
+    ``(path, seconds_per_call)`` ordered by execution; container rows hold
+    the sum of their children.
+    """
+    if state is None:
+        state = module.init_state()
+    rows: list[tuple[str, float]] = []
+
+    def run(mod, p, s, x, path):
+        from bigdl_tpu.core.module import Sequential
+
+        if isinstance(mod, Sequential):
+            total = 0.0
+            start_row = len(rows)
+            rows.append((path, 0.0))  # placeholder, filled after children
+            for i, child in enumerate(mod.children()):
+                k = str(i)
+                x, dt = run(child, p[k], s[k], x,
+                            f"{path}.{i}:{child.name}")
+                total += dt
+            rows[start_row] = (path, total)
+            return x, total
+
+        def once():
+            t0 = time.perf_counter()
+            y, _ = mod.apply(p, s, x, training=training, rng=rng)
+            jax.block_until_ready(y)
+            return y, time.perf_counter() - t0
+
+        y, _ = once()  # warmup/compile
+        best = min(once()[1] for _ in range(max(1, iters)))
+        rows.append((path, best))
+        return y, best
+
+    run(module, params, state, x, module.name)
+    return rows
+
+
+def format_times(rows) -> str:
+    """Pretty table like the reference's getTimes log (module, time)."""
+    width = max(len(p) for p, _ in rows)
+    lines = [f"{p:<{width}}  {dt * 1e3:10.3f} ms" for p, dt in rows]
+    return "\n".join(lines)

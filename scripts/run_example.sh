@@ -26,6 +26,12 @@ case "$MODEL" in
     exec python -m bigdl_tpu.cli.rnn train -f "$DATA" "$@" ;;
   autoencoder)
     exec python -m bigdl_tpu.cli.autoencoder train -f "$DATA" "$@" ;;
+  textclassification)
+    exec python -m bigdl_tpu.cli.textclassification -f "$DATA" "$@" ;;
+  loadmodel)
+    exec python -m bigdl_tpu.cli.loadmodel -f "$DATA" "$@" ;;
+  predict)
+    exec python -m bigdl_tpu.cli.predict -f "$DATA" "$@" ;;
   perf)
     exec python -m bigdl_tpu.cli.perf "$@" ;;
   *)

@@ -1,0 +1,107 @@
+"""Example CLIs: text classification (reference example/textclassification),
+loadmodel validation (example/loadmodel), batch prediction
+(example/imageclassification)."""
+
+import os
+
+import numpy as np
+import pytest
+
+
+def test_textclassification_synthetic_converges(tmp_path, caplog):
+    """No corpus on disk -> synthetic two-topic corpus; the text CNN must
+    separate the topics (reference claims ~90% on 20news after 2 epochs)."""
+    from bigdl_tpu.cli import textclassification as tc
+
+    trained = tc.main(["-f", str(tmp_path), "-b", "32", "--maxEpoch", "2",
+                       "--sequenceLength", "60", "--embeddingDim", "16",
+                       "--learningRate", "0.05", "--logEvery", "100"])
+    assert trained is not None
+
+
+def test_textclassification_reads_corpus_and_glove(tmp_path):
+    from bigdl_tpu.cli.textclassification import load_glove, read_corpus
+    from bigdl_tpu.dataset.text import Dictionary
+
+    root = tmp_path / "20news-18828"
+    for cls, words in [("comp.graphics", "pixel render gpu"),
+                       ("rec.sport", "score team game")]:
+        d = root / cls
+        d.mkdir(parents=True)
+        for i in range(3):
+            (d / f"doc{i}").write_text(f"{words} document {i}")
+    texts, labels, names = read_corpus(str(tmp_path))
+    assert len(texts) == 6 and sorted(set(labels)) == [0, 1]
+    assert names == ["comp.graphics", "rec.sport"]
+
+    dic = Dictionary([["pixel", "team"]])
+    gdir = tmp_path / "glove.6B"
+    gdir.mkdir()
+    gfile = gdir / "glove.6B.4d.txt"
+    gfile.write_text("pixel 1 2 3 4\nunseen 9 9 9 9\n")
+    table = load_glove(str(gfile), dic, 4)
+    np.testing.assert_allclose(table[dic.word2id["pixel"]], [1, 2, 3, 4])
+    assert table.shape == (len(dic), 4)
+
+
+def test_predict_cli_over_folder(tmp_path, capsys, rng):
+    """Train-free path: save a fresh lenet checkpoint, predict a folder of
+    PNGs, one 'path<TAB>class' line per image."""
+    from PIL import Image
+
+    from bigdl_tpu.cli import predict
+    from bigdl_tpu.models import lenet5
+    from bigdl_tpu.utils.file import save_pytree
+
+    model = lenet5(10)
+    params = model.init(rng)
+    ck = tmp_path / "ckpt"
+    ck.mkdir()
+    save_pytree({"params": params, "mod_state": model.init_state()},
+                str(ck / "model.1"))
+
+    imgs = tmp_path / "imgs"
+    imgs.mkdir()
+    rs = np.random.RandomState(0)
+    for i in range(3):
+        Image.fromarray(rs.randint(0, 255, (28, 28), np.uint8), "L").save(
+            imgs / f"im{i}.png")
+
+    predict.main(["--model", str(ck), "--modelName", "lenet",
+                  "-f", str(imgs), "-b", "4"])
+    lines = [l for l in capsys.readouterr().out.splitlines() if "\t" in l]
+    assert len(lines) == 3
+    for line in lines:
+        path, cls = line.split("\t")
+        assert os.path.exists(path) and 0 <= int(cls) < 10
+
+
+def test_loadmodel_bigdl_checkpoint_roundtrip(tmp_path, rng):
+    """loadmodel --modelType bigdl evaluates a saved checkpoint on a val
+    image folder."""
+    from PIL import Image
+
+    from bigdl_tpu.cli import loadmodel
+    from bigdl_tpu.models import alexnet
+    from bigdl_tpu.utils.file import save_pytree
+
+    model = alexnet(10)
+    ck = tmp_path / "ckpt"
+    ck.mkdir()
+    save_pytree({"params": model.init(rng), "mod_state": model.init_state()},
+                str(ck / "model.1"))
+
+    val = tmp_path / "val"
+    rs = np.random.RandomState(1)
+    for cls in ["class0", "class1"]:
+        d = val / cls
+        d.mkdir(parents=True)
+        for i in range(2):
+            Image.fromarray(rs.randint(0, 255, (224, 224, 3), np.uint8),
+                            "RGB").save(d / f"{i}.png")
+
+    results = loadmodel.main(["--modelType", "bigdl", "--modelName",
+                              "alexnet", "--model", str(ck),
+                              "-f", str(val), "-b", "4", "--classNum", "10"])
+    acc, count = results[0].result()
+    assert count == 4 and 0.0 <= acc <= 1.0

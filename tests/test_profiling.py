@@ -1,0 +1,51 @@
+"""Profiling utilities (reference getTimes / Metrics, SURVEY.md §5)."""
+
+import os
+
+import jax.numpy as jnp
+import numpy as np
+
+from bigdl_tpu import nn
+from bigdl_tpu.core import Sequential
+from bigdl_tpu.utils import format_times, time_modules, trace
+
+
+def test_time_modules_covers_every_child(rng):
+    model = Sequential(nn.Linear(8, 16), nn.Tanh(), nn.Linear(16, 4),
+                       name="mlp")
+    params = model.init(rng)
+    x = jnp.asarray(np.random.RandomState(0).randn(4, 8), np.float32)
+    rows = time_modules(model, params, x, iters=1)
+    paths = [p for p, _ in rows]
+    assert paths[0] == "mlp"  # container first, holding the sum
+    assert any("Linear" in p for p in paths[1:])
+    assert len(rows) == 4  # container + 3 children
+    times = dict(rows)
+    child_sum = sum(t for p, t in rows[1:])
+    np.testing.assert_allclose(times["mlp"], child_sum, rtol=1e-6)
+    table = format_times(rows)
+    assert "ms" in table and "mlp" in table
+
+
+def test_time_modules_nested_sequential(rng):
+    inner = Sequential(nn.Linear(8, 8), nn.ReLU(), name="inner")
+    model = Sequential(inner, nn.Linear(8, 2), name="outer")
+    params = model.init(rng)
+    x = jnp.zeros((2, 8))
+    rows = time_modules(model, params, x, iters=1)
+    assert any("inner" in p for p, _ in rows)
+    assert len(rows) == 5  # outer, inner, inner's 2 children, final Linear
+
+
+def test_trace_writes_profile(tmp_path, rng):
+    model = Sequential(nn.Linear(8, 8), nn.Tanh())
+    params = model.init(rng)
+    x = jnp.zeros((2, 8))
+    logdir = str(tmp_path / "tb")
+    with trace(logdir):
+        y = model.forward(params, x)
+        y.block_until_ready()
+    found = []
+    for root, _dirs, files in os.walk(logdir):
+        found.extend(files)
+    assert found, "profiler trace produced no files"
