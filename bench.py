@@ -15,6 +15,7 @@ Usage: python bench.py [model] [batch] — model in {resnet50, lenet}.
 import json
 import sys
 import time
+from functools import partial
 
 import numpy as np
 
@@ -57,7 +58,9 @@ def main() -> None:
     y = jnp.asarray(np.random.RandomState(1).randint(
         0, 1000 if model_name == "resnet50" else 10, batch))
 
-    @jax.jit
+    # donate the three state trees: lets XLA update weights in place
+    # instead of allocating fresh HBM buffers every step
+    @partial(jax.jit, donate_argnums=(0, 1, 2))
     def step(params, mod_state, opt_state, x, y, rng):
         def loss_fn(p):
             out, ms = model.apply(p, mod_state, x, training=True, rng=rng)
@@ -70,13 +73,17 @@ def main() -> None:
     k = jax.random.PRNGKey(2)
     params, mod_state, opt_state, loss = step(params, mod_state, opt_state,
                                               x, y, k)
-    jax.block_until_ready(loss)  # compile + warmup
+    # sync via scalar host transfer: on the tunneled (axon) TPU platform,
+    # block_until_ready was observed returning before execution finished
+    # (20 ResNet-50 steps "completed" in 0.04s, 4x above hardware peak);
+    # a host read of the loss is a true sync on every platform
+    float(loss)  # compile + warmup
 
     t0 = time.perf_counter()
     for i in range(iters):
         params, mod_state, opt_state, loss = step(params, mod_state,
                                                   opt_state, x, y, k)
-    jax.block_until_ready(loss)
+    float(loss)  # scalar host read = true device sync (see note above)
     dt = time.perf_counter() - t0
     ips = batch * iters / dt
 

@@ -9,9 +9,8 @@ import argparse
 
 from bigdl_tpu.cli import common
 
-# ImageNet BGR-ish channel stats the reference pipeline bakes in
-_MEAN = (123.0, 117.0, 104.0)
-_STD = (58.4, 57.1, 57.4)
+from bigdl_tpu.dataset.folder import IMAGENET_MEAN as _MEAN
+from bigdl_tpu.dataset.folder import IMAGENET_STD as _STD
 
 
 def _train_dataset(folder: str, batch: int):

@@ -71,9 +71,9 @@ def main(argv=None):
                                   args.weights)
     # Caffe AlexNet crops to 227; the rest take 224
     size = (227, 227) if args.modelName == "alexnet" else (224, 224)
+    from bigdl_tpu.dataset.folder import IMAGENET_MEAN, IMAGENET_STD
     val = ImageFolderDataSet(args.folder, args.batchSize, size=size,
-                             mean=(123.0, 117.0, 104.0),
-                             std=(58.4, 57.1, 57.4))
+                             mean=IMAGENET_MEAN, std=IMAGENET_STD)
     return common.evaluate(model, params, mod_state, val,
                            [Top1Accuracy(), Top5Accuracy()])
 

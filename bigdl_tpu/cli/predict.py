@@ -72,9 +72,10 @@ def main(argv=None):
             x = ((imgs.astype(np.float32) / 255.0) - TRAIN_MEAN) / TRAIN_STD
         else:
             # match the ImageFolderDataSet stats the imagenet CLIs train with
-            mean = np.asarray((123.0, 117.0, 104.0), np.float32)
-            std = np.asarray((58.4, 57.1, 57.4), np.float32)
-            x = (imgs.astype(np.float32) - mean) / std
+            from bigdl_tpu.dataset.folder import IMAGENET_MEAN, IMAGENET_STD
+            x = ((imgs.astype(np.float32)
+                  - np.asarray(IMAGENET_MEAN, np.float32))
+                 / np.asarray(IMAGENET_STD, np.float32))
         scores = clf.predict_scores(x)
         top = np.argsort(-scores, axis=-1)[:, : args.topN]
         for path, classes in zip(chunk, top):

@@ -59,10 +59,11 @@ def read_corpus(base: str):
     if root is None:
         return None
     texts, labels, names = [], [], []
-    for ci, cls in enumerate(sorted(os.listdir(root))):
+    for cls in sorted(os.listdir(root)):
         cdir = os.path.join(root, cls)
-        if not os.path.isdir(cdir):
+        if not os.path.isdir(cdir):  # stray files must not shift label ids
             continue
+        ci = len(names)
         names.append(cls)
         for fn in sorted(os.listdir(cdir)):
             try:

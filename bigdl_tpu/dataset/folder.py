@@ -19,9 +19,17 @@ import numpy as np
 
 from bigdl_tpu.dataset.dataset import DataSet, MiniBatch
 
-__all__ = ["list_image_folder", "load_image_folder", "ImageFolderDataSet"]
+__all__ = ["list_image_folder", "load_image_folder", "ImageFolderDataSet",
+           "IMAGENET_MEAN", "IMAGENET_STD"]
 
 _EXTS = {".jpg", ".jpeg", ".png", ".bmp", ".ppm", ".webp"}
+
+# Per-channel RGB stats on raw 0-255 pixels, baked into the reference's
+# ImageNet pipeline (BGRImgNormalizer defaults) — every imagenet-style CLI
+# (inception/loadmodel/predict) trains and evaluates with these, so they
+# live here, next to the loader they parameterize.
+IMAGENET_MEAN = (123.0, 117.0, 104.0)
+IMAGENET_STD = (58.4, 57.1, 57.4)
 
 
 def list_image_folder(root: str) -> tuple[list[str], np.ndarray, list[str]]:

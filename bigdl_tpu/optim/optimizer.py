@@ -55,7 +55,8 @@ class Optimizer:
     def __init__(self, model: Module, dataset, criterion: Criterion,
                  optim_method: Optional[OptimMethod] = None,
                  end_when: Optional[Trigger] = None,
-                 strategy=None, seed: int = 42, log_every: int = 1):
+                 strategy=None, seed: int = 42, log_every: int = 1,
+                 compute_dtype=None):
         self.model = model
         self.dataset = dataset
         self.criterion = criterion
@@ -63,6 +64,10 @@ class Optimizer:
         self.end_when = end_when or Trigger.max_epoch(1)
         self.strategy = strategy  # None => single-device
         self.seed = seed
+        # bf16 activations/grad math with fp32 params+loss — the native
+        # replacement for the reference's truncated-fp16 gradient codec
+        # (parameters/FP16CompressedTensor.scala)
+        self.compute_dtype = compute_dtype
         self._val_trigger = None
         self._val_dataset = None
         self._val_methods: Sequence[ValidationMethod] = ()
@@ -97,13 +102,17 @@ class Optimizer:
         return self
 
     def set_checkpoint(self, trigger: Trigger, path: str,
-                       overwrite: bool = False) -> "Optimizer":
+                       overwrite: bool = False,
+                       sharded: bool = False) -> "Optimizer":
         """(reference Optimizer.setCheckpoint :87-94 +
         overWriteCheckpoint flag: refuse to clobber an existing snapshot
-        unless ``overwrite``)"""
+        unless ``overwrite``). ``sharded=True`` writes orbax shards
+        directly from each host instead of gathering to one blob —
+        the pod-scale path (utils/orbax_ckpt.py)."""
         self._ckpt_trigger = trigger
         self._ckpt_path = path
         self._ckpt_overwrite = overwrite
+        self._ckpt_sharded = sharded
         return self
 
     def set_state(self, params=None, mod_state=None,
@@ -116,10 +125,19 @@ class Optimizer:
         return self
 
     def resume(self, checkpoint_dir: str) -> "Optimizer":
-        """Load the newest model.<n>/state.<n> pair from a directory."""
+        """Load the newest model.<n>/state.<n> pair from a directory
+        (either single-blob or orbax-sharded snapshots)."""
         from bigdl_tpu.utils.file import latest_checkpoint
         m = latest_checkpoint(checkpoint_dir, "model.")
         s = latest_checkpoint(checkpoint_dir, "state.")
+        if m and os.path.isdir(m):  # orbax checkpoints are directories
+            from bigdl_tpu.utils.orbax_ckpt import restore_sharded
+            blob = restore_sharded(m)
+            self._init_params = blob["params"]
+            self._init_mod_state = blob["mod_state"]
+            if s:
+                self._init_opt_state = restore_sharded(s)
+            return self
         if m:
             blob = load_pytree(m)
             self._init_params = blob["params"]
@@ -132,10 +150,17 @@ class Optimizer:
     def _build_step(self):
         model, criterion, opt = self.model, self.criterion, self.optim_method
 
+        dtype = self.compute_dtype
+
         def train_step(params, mod_state, opt_state, x, y, rng):
+            if dtype is not None and jnp.issubdtype(x.dtype, jnp.floating):
+                x = x.astype(dtype)
+
             def loss_fn(p):
                 out, new_ms = model.apply(p, mod_state, x,
                                           training=True, rng=rng)
+                if dtype is not None:
+                    out = out.astype(jnp.float32)  # fp32 loss/softmax
                 return criterion(out, y), new_ms
 
             (loss, new_ms), grads = jax.value_and_grad(
@@ -247,16 +272,25 @@ class Optimizer:
         self._last_ckpt_iter = driver["iteration"]
         n = driver["iteration"]
         target = os.path.join(self._ckpt_path, f"model.{n}")
-        if os.path.exists(target) and not getattr(
-                self, "_ckpt_overwrite", False):
+        overwrite = getattr(self, "_ckpt_overwrite", False)
+        if os.path.exists(target) and not overwrite:
             raise FileExistsError(
                 f"{target} exists; pass overwrite=True to set_checkpoint "
                 f"(--overWriteCheckpoint) to clobber it")
-        if self.strategy is not None:
-            params, mod_state, opt_state = self.strategy.gather(
-                params, mod_state, opt_state)
-        save_pytree({"params": params, "mod_state": mod_state},
-                    os.path.join(self._ckpt_path, f"model.{n}"))
-        save_pytree(opt_state, os.path.join(self._ckpt_path, f"state.{n}"))
+        if getattr(self, "_ckpt_sharded", False):
+            # pod-scale path: every host writes its own shards, no gather
+            from bigdl_tpu.utils.orbax_ckpt import save_sharded
+            save_sharded({"params": params, "mod_state": mod_state},
+                         target, overwrite=overwrite)
+            save_sharded(opt_state,
+                         os.path.join(self._ckpt_path, f"state.{n}"),
+                         overwrite=overwrite)
+        else:
+            if self.strategy is not None:
+                params, mod_state, opt_state = self.strategy.gather(
+                    params, mod_state, opt_state)
+            save_pytree({"params": params, "mod_state": mod_state}, target)
+            save_pytree(opt_state,
+                        os.path.join(self._ckpt_path, f"state.{n}"))
         logger.info("Checkpoint written at iteration %d to %s", n,
                     self._ckpt_path)

@@ -82,8 +82,9 @@ class DataParallel:
     """Strategy object consumed by :class:`bigdl_tpu.optim.Optimizer`.
 
     ``zero1=True`` shards optimizer state over the data axis (reference's
-    per-partition optimizer shards); ``compute_dtype=jnp.bfloat16`` casts
-    activations/grad math to bf16 (native replacement for the fp16 codec).
+    per-partition optimizer shards). For bf16 activations/grad math pass
+    ``compute_dtype=jnp.bfloat16`` to the Optimizer (native replacement
+    for the reference's fp16 codec).
     """
 
     def __init__(self, mesh: Optional[Mesh] = None, axis: str = "data",

@@ -1,0 +1,101 @@
+"""Orbax sharded checkpointing (SURVEY.md §5: the TPU equivalent of the
+reference's gather-to-driver checkpoint is per-host sharded writes) and the
+bf16 compute_dtype path."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bigdl_tpu import nn
+from bigdl_tpu.core import Sequential
+from bigdl_tpu.dataset import BatchDataSet
+from bigdl_tpu.optim import Optimizer, SGD, Trigger
+from bigdl_tpu.parallel import DataParallel, local_mesh
+from bigdl_tpu.utils.orbax_ckpt import (
+    latest_sharded, restore_sharded, save_sharded,
+)
+
+
+def _data(n=64):
+    rs = np.random.RandomState(0)
+    x = rs.rand(n, 4).astype(np.float32)
+    y = (x.sum(-1) > 2).astype(np.int32)
+    return x, y
+
+
+def test_save_restore_roundtrip_sharded_arrays(tmp_path, rng):
+    """Device-sharded arrays round-trip, restoring onto the same
+    shardings when a `like` tree is given."""
+    mesh = local_mesh()
+    model = Sequential(nn.Linear(4, 16), nn.Tanh(), nn.Linear(16, 2))
+    strat = DataParallel(mesh)
+    opt = SGD(learning_rate=0.1, momentum=0.9)
+    params = model.init(rng)
+    params_s, ms, opt_s = strat.place(params, model.init_state(),
+                                      opt.init(params))
+    path = str(tmp_path / "state.1")
+    save_sharded(opt_s, path)
+    back = restore_sharded(path, like=opt_s)
+    for a, b in zip(jax.tree_util.tree_leaves(opt_s),
+                    jax.tree_util.tree_leaves(back)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+        if hasattr(a, "sharding") and hasattr(b, "sharding"):
+            assert b.sharding.is_equivalent_to(a.sharding, a.ndim)
+
+
+def test_optimizer_sharded_checkpoint_and_resume(tmp_path):
+    x, y = _data()
+    model = Sequential(nn.Linear(4, 16), nn.Tanh(), nn.Linear(16, 2),
+                       nn.LogSoftMax())
+    ck = str(tmp_path / "ck")
+    os.makedirs(ck)
+    opt = Optimizer(model, BatchDataSet(x, y, 32), nn.ClassNLLCriterion(),
+                    optim_method=SGD(learning_rate=0.2, momentum=0.9),
+                    end_when=Trigger.max_epoch(2),
+                    strategy=DataParallel(local_mesh()))
+    opt.set_checkpoint(Trigger.every_epoch(), ck, sharded=True)
+    trained = opt.optimize()
+    assert latest_sharded(ck, "model.") is not None
+    assert latest_sharded(ck, "state.") is not None
+
+    # the snapshot holds the trained params
+    blob = restore_sharded(latest_sharded(ck, "model."))
+    for a, b in zip(jax.tree_util.tree_leaves(blob["params"]),
+                    jax.tree_util.tree_leaves(jax.device_get(trained.params))):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+
+    # resume loads it and keeps training
+    opt2 = Optimizer(model, BatchDataSet(x, y, 32), nn.ClassNLLCriterion(),
+                     end_when=Trigger.max_epoch(1)).resume(ck)
+    assert opt2._init_params is not None and opt2._init_opt_state is not None
+    t2 = opt2.optimize()
+    assert t2 is not None
+
+
+def test_sharded_refuses_overwrite(tmp_path, rng):
+    p = str(tmp_path / "model.1")
+    save_sharded({"a": jnp.ones(3)}, p)
+    try:
+        save_sharded({"a": jnp.zeros(3)}, p)
+        raise AssertionError("expected FileExistsError")
+    except FileExistsError:
+        pass
+    save_sharded({"a": jnp.zeros(3)}, p, overwrite=True)
+    np.testing.assert_allclose(np.asarray(restore_sharded(p)["a"]), 0)
+
+
+def test_compute_dtype_bf16_trains(rng):
+    """bf16 compute path: step runs, loss finite, params stay fp32."""
+    x, y = _data(128)
+    model = Sequential(nn.Linear(4, 32), nn.Tanh(), nn.Linear(32, 2),
+                       nn.LogSoftMax())
+    opt = Optimizer(model, BatchDataSet(x, y, 64), nn.ClassNLLCriterion(),
+                    optim_method=SGD(learning_rate=0.2, momentum=0.9),
+                    end_when=Trigger.max_epoch(3),
+                    compute_dtype=jnp.bfloat16)
+    trained = opt.optimize()
+    for leaf in jax.tree_util.tree_leaves(trained.params):
+        assert leaf.dtype == jnp.float32
+        assert np.all(np.isfinite(np.asarray(leaf, np.float32)))
