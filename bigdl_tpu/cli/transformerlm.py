@@ -1,0 +1,85 @@
+"""Transformer language model training (the long-context successor to the
+reference's SimpleRNN/tiny-shakespeare pipeline, models/rnn/Train.scala —
+same input.txt corpus format and perplexity metric, modern model).
+
+    python -m bigdl_tpu.cli.transformerlm train -f data/ --seqLength 256 \
+        --dModel 256 --numLayers 4 --flash --remat
+"""
+
+from __future__ import annotations
+
+import argparse
+import math
+import os
+
+from bigdl_tpu.cli import common
+
+
+def main(argv=None):
+    common.setup_logging()
+    p = argparse.ArgumentParser("bigdl-tpu transformerlm")
+    sub = p.add_subparsers(dest="cmd", required=True)
+    tr = sub.add_parser("train")
+    common.add_train_args(tr)
+    tr.add_argument("--vocabSize", type=int, default=4000)
+    tr.add_argument("--seqLength", type=int, default=128)
+    tr.add_argument("--dModel", type=int, default=128)
+    tr.add_argument("--numLayers", type=int, default=2)
+    tr.add_argument("--numHeads", type=int, default=4)
+    tr.add_argument("--dropout", type=float, default=0.0)
+    tr.add_argument("--flash", action="store_true",
+                    help="use the Pallas flash-attention kernel")
+    tr.add_argument("--remat", action="store_true",
+                    help="jax.checkpoint each block (HBM for FLOPs)")
+    tr.add_argument("--bf16", action="store_true")
+    tr.add_argument("--accumSteps", type=int, default=1)
+    args = p.parse_args(argv)
+
+    import numpy as np
+    import jax.numpy as jnp
+
+    from bigdl_tpu import nn
+    from bigdl_tpu.dataset import BatchDataSet
+    from bigdl_tpu.dataset.text import Dictionary, tokenize
+    from bigdl_tpu.models import transformer_lm
+
+    path = os.path.join(args.folder, "input.txt")
+    with open(path) as f:
+        tokens = tokenize(f.read())
+    d = Dictionary([tokens], vocab_size=args.vocabSize)
+    ids = np.asarray(d.ids(tokens), np.int32)
+
+    # non-overlapping next-token windows: x = w[:-1], y = w[1:]
+    s = args.seqLength + 1
+    n_win = len(ids) // s
+    if n_win < 2:
+        raise SystemExit(f"corpus too small: {len(ids)} tokens for "
+                         f"seqLength {args.seqLength}")
+    w = ids[: n_win * s].reshape(n_win, s)
+    x, y = w[:, :-1], w[:, 1:]
+    n_held = max(1, n_win // 10)
+    x, y, x_val, y_val = x[:-n_held], y[:-n_held], x[-n_held:], y[-n_held:]
+
+    model = transformer_lm(
+        len(d), d_model=args.dModel, num_layers=args.numLayers,
+        num_heads=args.numHeads, max_len=args.seqLength,
+        dropout=args.dropout, attn_impl="flash" if args.flash else None,
+        remat=args.remat,
+        # cast right after the embedding — the Optimizer-level cast only
+        # applies to float inputs, and LM input is int tokens
+        compute_dtype=jnp.bfloat16 if args.bf16 else None)
+    crit = nn.TimeDistributedCriterion(nn.ClassNLLCriterion())
+    train = BatchDataSet(x, y, args.batchSize, shuffle=True)
+    opt = common.build_optimizer(model, train, crit, args)
+    opt.accum_steps = max(1, args.accumSteps)
+    trained = opt.optimize()
+
+    logp = trained.module.forward(trained.params, jnp.asarray(x_val))
+    lp = np.asarray(logp)
+    nll = -np.mean(np.take_along_axis(lp, y_val[..., None], axis=-1))
+    print(f"perplexity is {math.exp(nll):.2f}")
+    return trained
+
+
+if __name__ == "__main__":
+    main()

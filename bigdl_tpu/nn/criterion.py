@@ -23,6 +23,7 @@ __all__ = [
     "MultiCriterion", "ParallelCriterion", "MultiLabelMarginCriterion",
     "MultiLabelSoftMarginCriterion", "MultiMarginCriterion",
     "SmoothL1Criterion", "SoftMarginCriterion", "L1Cost", "L1Penalty",
+    "TimeDistributedCriterion",
 ]
 
 
@@ -325,6 +326,24 @@ class SoftMarginCriterion(Criterion):
 
     def forward(self, input, target):
         return self._reduce(jax.nn.softplus(-target * input))
+
+
+class TimeDistributedCriterion(Criterion):
+    """Apply a per-sample criterion across a time dimension: input
+    (B, T, ...) + target (B, T, ...) are flattened to (B*T, ...) and fed to
+    ``base``. The sequence analog the LM/seq2seq paths need (per-token NLL
+    -> perplexity); the reference's Recurrent models instead emit one
+    prediction per window."""
+
+    def __init__(self, base: Criterion):
+        super().__init__()
+        self.base = base
+
+    def forward(self, input, target):
+        b, t = input.shape[0], input.shape[1]
+        inp = input.reshape((b * t,) + input.shape[2:])
+        tgt = target.reshape((b * t,) + target.shape[2:])
+        return self.base.forward(inp, tgt)
 
 
 class L1Cost(Criterion):

@@ -56,7 +56,7 @@ class Optimizer:
                  optim_method: Optional[OptimMethod] = None,
                  end_when: Optional[Trigger] = None,
                  strategy=None, seed: int = 42, log_every: int = 1,
-                 compute_dtype=None):
+                 compute_dtype=None, accum_steps: int = 1):
         self.model = model
         self.dataset = dataset
         self.criterion = criterion
@@ -68,6 +68,9 @@ class Optimizer:
         # replacement for the reference's truncated-fp16 gradient codec
         # (parameters/FP16CompressedTensor.scala)
         self.compute_dtype = compute_dtype
+        # accum_steps > 1: each optimizer update averages grads over that
+        # many microbatches (batch_size must be divisible by it)
+        self.accum_steps = accum_steps
         self._val_trigger = None
         self._val_dataset = None
         self._val_methods: Sequence[ValidationMethod] = ()
@@ -151,8 +154,9 @@ class Optimizer:
         model, criterion, opt = self.model, self.criterion, self.optim_method
 
         dtype = self.compute_dtype
+        accum = max(1, self.accum_steps)
 
-        def train_step(params, mod_state, opt_state, x, y, rng):
+        def grads_of(params, mod_state, x, y, rng):
             if dtype is not None and jnp.issubdtype(x.dtype, jnp.floating):
                 x = x.astype(dtype)
 
@@ -165,6 +169,37 @@ class Optimizer:
 
             (loss, new_ms), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(params)
+            return loss, new_ms, grads
+
+        def train_step(params, mod_state, opt_state, x, y, rng):
+            if accum == 1:
+                loss, new_ms, grads = grads_of(params, mod_state, x, y, rng)
+            else:
+                # gradient accumulation: the batch is split into `accum`
+                # microbatches scanned inside ONE jitted step — same HBM
+                # profile as a small batch, same update as the large one
+                if x.shape[0] % accum:
+                    raise ValueError(
+                        f"batch size {x.shape[0]} not divisible by "
+                        f"accum_steps={accum}")
+                xm = x.reshape((accum, x.shape[0] // accum) + x.shape[1:])
+                ym = y.reshape((accum, y.shape[0] // accum) + y.shape[1:])
+
+                def body(carry, mb):
+                    ms, g_acc, l_acc, i = carry
+                    xb, yb = mb
+                    r = jax.random.fold_in(rng, i)
+                    loss, ms, grads = grads_of(params, ms, xb, yb, r)
+                    g_acc = jax.tree_util.tree_map(jnp.add, g_acc, grads)
+                    return (ms, g_acc, l_acc + loss, i + 1), None
+
+                g0 = jax.tree_util.tree_map(
+                    lambda p_: jnp.zeros(p_.shape, jnp.float32), params)
+                (new_ms, grads, loss, _), _ = jax.lax.scan(
+                    body, (mod_state, g0, jnp.zeros((), jnp.float32), 0),
+                    (xm, ym))
+                grads = jax.tree_util.tree_map(lambda g: g / accum, grads)
+                loss = loss / accum
             if self.strategy is not None:
                 grads, loss = self.strategy.reduce_grads(grads, loss)
             new_params, new_opt = opt.update(grads, opt_state, params)

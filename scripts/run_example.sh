@@ -26,6 +26,8 @@ case "$MODEL" in
     exec python -m bigdl_tpu.cli.rnn train -f "$DATA" "$@" ;;
   autoencoder)
     exec python -m bigdl_tpu.cli.autoencoder train -f "$DATA" "$@" ;;
+  transformerlm)
+    exec python -m bigdl_tpu.cli.transformerlm train -f "$DATA" "$@" ;;
   textclassification)
     exec python -m bigdl_tpu.cli.textclassification -f "$DATA" "$@" ;;
   loadmodel)
