@@ -60,6 +60,13 @@ def main(argv=None):
     n_held = max(1, n_win // 10)
     x, y, x_val, y_val = x[:-n_held], y[:-n_held], x[-n_held:], y[-n_held:]
 
+    if len(x) < args.batchSize:
+        # BatchDataSet drops the short remainder; without this clamp a
+        # small corpus would train for zero steps and report garbage
+        print(f"warning: only {len(x)} training windows < batchSize "
+              f"{args.batchSize}; clamping batchSize to {len(x)}")
+        args.batchSize = len(x)
+
     model = transformer_lm(
         len(d), d_model=args.dModel, num_layers=args.numLayers,
         num_heads=args.numHeads, max_len=args.seqLength,

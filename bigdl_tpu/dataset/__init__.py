@@ -6,6 +6,7 @@ from bigdl_tpu.dataset.dataset import (
 )
 from bigdl_tpu.dataset import mnist, cifar, image, text, native
 from bigdl_tpu.dataset.native import NativePrefetchDataSet
+from bigdl_tpu.dataset.prefetch import PrefetchDataSet
 from bigdl_tpu.dataset.folder import (
     ImageFolderDataSet, load_image_folder, list_image_folder,
 )

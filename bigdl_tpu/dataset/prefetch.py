@@ -1,0 +1,92 @@
+"""Host-side prefetching: overlap batch preparation with device compute.
+
+The reference overlaps JPEG decode with training via MTLabeledBGRImgToBatch
+(coreNumber cloned transformer pipelines racing on an atomic batch counter,
+image/MTLabeledBGRImgToBatch.scala:48-133). Two TPU-native layers replace
+it:
+
+* the C++ prefetcher in ``native/`` for raw-format readers
+  (``NativePrefetchDataSet``), and
+* this pure-Python :class:`PrefetchDataSet`, which wraps ANY DataSet in a
+  background thread + bounded queue. While the device runs step N, the
+  host prepares batches N+1..N+depth. Python threads are enough here: the
+  wrapped pipeline's hot work (PIL decode, numpy ops) releases the GIL,
+  and the training thread spends its time blocked in device dispatch.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Iterator, Optional
+
+from bigdl_tpu.dataset.dataset import DataSet
+
+__all__ = ["PrefetchDataSet"]
+
+_DONE = object()
+
+
+class PrefetchDataSet(DataSet):
+    """``PrefetchDataSet(inner, depth=2)`` — iterate ``inner`` on a daemon
+    thread, handing batches over a bounded queue (depth = max batches
+    prepared ahead). Exceptions in the producer re-raise in the consumer.
+    """
+
+    def __init__(self, inner: DataSet, depth: int = 2):
+        if depth < 1:
+            raise ValueError(f"depth must be >= 1, got {depth}")
+        self.inner = inner
+        self.depth = depth
+
+    def __iter__(self) -> Iterator:
+        q: queue.Queue = queue.Queue(maxsize=self.depth)
+        err: list[BaseException] = []
+        stop = threading.Event()  # set when the consumer abandons the epoch
+
+        def put(item) -> bool:
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        def produce():
+            try:
+                for item in self.inner:
+                    if not put(item):
+                        return  # consumer gone — unwind, don't block forever
+            except BaseException as e:  # surfaced on the consumer side
+                err.append(e)
+            finally:
+                put(_DONE)
+
+        t = threading.Thread(target=produce, daemon=True,
+                             name="bigdl-prefetch")
+        t.start()
+        try:
+            while True:
+                item = q.get()
+                if item is _DONE:
+                    break
+                yield item
+        finally:
+            # normal exhaustion AND early exit (break / GeneratorExit):
+            # release the producer if it is blocked on a full queue
+            stop.set()
+            while not q.empty():
+                try:
+                    q.get_nowait()
+                except queue.Empty:
+                    break
+            t.join(timeout=5.0)
+        if err:
+            raise err[0]
+
+    def size(self) -> int:
+        return self.inner.size()
+
+    def shuffle(self, seed: Optional[int] = None) -> None:
+        self.inner.shuffle(seed)

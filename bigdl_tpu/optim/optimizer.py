@@ -18,6 +18,7 @@ log line "Train N in Xs. Throughput is R records/second. Loss is L"
 from __future__ import annotations
 
 import logging
+import math
 import os
 import time
 from typing import Any, Optional, Sequence
@@ -56,7 +57,8 @@ class Optimizer:
                  optim_method: Optional[OptimMethod] = None,
                  end_when: Optional[Trigger] = None,
                  strategy=None, seed: int = 42, log_every: int = 1,
-                 compute_dtype=None, accum_steps: int = 1):
+                 compute_dtype=None, accum_steps: int = 1,
+                 nan_check: bool = True):
         self.model = model
         self.dataset = dataset
         self.criterion = criterion
@@ -71,6 +73,11 @@ class Optimizer:
         # accum_steps > 1: each optimizer update averages grads over that
         # many microbatches (batch_size must be divisible by it)
         self.accum_steps = accum_steps
+        # NaN guard at every log point (SURVEY.md §5: functional purity
+        # removes the reference's race class; divergence detection is the
+        # failure mode left worth watching). Free: piggybacks on the loss
+        # sync the log line already pays for.
+        self.nan_check = nan_check
         self._val_trigger = None
         self._val_dataset = None
         self._val_methods: Sequence[ValidationMethod] = ()
@@ -259,6 +266,12 @@ class Optimizer:
                 if driver["iteration"] % self.log_every == 0:
                     loss_f = float(loss)
                     driver["loss"] = loss_f
+                    if self.nan_check and not math.isfinite(loss_f):
+                        raise FloatingPointError(
+                            f"loss became {loss_f} at iteration "
+                            f"{driver['iteration']} (epoch "
+                            f"{driver['epoch']}) — NaN guard tripped; last "
+                            f"checkpoint is the recovery point")
                     dt = time.time() - t0
                     self.metrics.add("computing time", dt)
                     logger.info(
