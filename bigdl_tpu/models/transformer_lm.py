@@ -54,6 +54,14 @@ class TransformerLM(Module):
             out.append(self.head)
         return tuple(out)
 
+    def tp_param_children(self):
+        """Param-key -> child mapping so megatron_specs can shard the
+        encoder blocks (and embedding) of a TP'd LM."""
+        out = {"emb": self.emb, "encoder": self.encoder, "ln_f": self.ln_f}
+        if self.head is not None:
+            out["head"] = self.head
+        return out
+
     def init(self, rng):
         ks = jax.random.split(rng, 3)
         p = {"emb": self.emb.init(ks[0]),

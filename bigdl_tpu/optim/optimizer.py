@@ -247,7 +247,13 @@ class Optimizer:
             epoch_start = time.time()
             records_this_epoch = 0
             opt_state = self.optim_method.set_epoch(opt_state, driver["epoch"])
-            for batch in self.dataset:
+            data_iter = iter(self.dataset)
+            while True:
+                t_fetch = time.time()
+                batch = next(data_iter, None)
+                if batch is None:
+                    break
+                self.metrics.add("get batch time", time.time() - t_fetch)
                 t0 = time.time()
                 x, y = batch
                 if self.strategy is not None:
@@ -278,6 +284,11 @@ class Optimizer:
                         "Train %d in %.4fs. Throughput is %.1f "
                         "records/second. Loss is %.4f",
                         n, dt, n / max(dt, 1e-9), loss_f)
+                    # reference logs metrics.summary() at debug each
+                    # iteration (DistriOptimizer.scala:245); guard so the
+                    # string is only built when it will be emitted
+                    if logger.isEnabledFor(logging.DEBUG):
+                        logger.debug("%s", self.metrics.summary())
                 self._maybe_validate(eval_fn, params, mod_state, driver)
                 self._maybe_checkpoint(params, mod_state, opt_state, driver)
                 if self.end_when(driver):

@@ -132,6 +132,17 @@ def megatron_specs(module, params, axis: str, n_shard: int):
             return lookup_spec(mod, p)
         if isinstance(mod, nn.SpatialConvolution):
             return conv_spec(mod, p)
+        # custom modules that keep child params under named keys (e.g.
+        # TransformerLM's "emb"/"encoder"/"ln_f") declare the mapping via
+        # tp_param_children() so the walk can descend into them
+        named = getattr(mod, "tp_param_children", None)
+        if named is not None and isinstance(p, dict):
+            mapping = named()
+            out = {k: rec(c, p[k]) for k, c in mapping.items() if k in p}
+            for k in p:
+                if k not in out:
+                    out[k] = replicated_specs(p[k])
+            return out
         children = mod.children()
         if children and isinstance(p, dict):
             out = {}

@@ -89,6 +89,30 @@ def test_tp_params_actually_sharded(rng):
     assert v0.sharding.is_equivalent_to(w0.sharding, 2)
 
 
+def test_tp_transformer_lm_sharded_matches(rng):
+    """TransformerLM (named param keys via tp_param_children) shards its
+    encoder blocks and reproduces the replicated forward."""
+    from bigdl_tpu.models import transformer_lm
+
+    mesh = make_mesh({"data": 2, "model": 4})
+    lm = transformer_lm(32, d_model=16, num_layers=2, num_heads=4,
+                        max_len=8)
+    params = lm.init(rng)
+    specs = megatron_specs(lm, params, "model", 4)
+    assert specs["encoder"]["0"]["mha"]["wq"] == P(None, "model")
+    assert specs["encoder"]["0"]["w2"] == P("model", None)
+
+    x = np.random.RandomState(0).randint(0, 32, (4, 8))
+    y_ref = lm.forward(params, jnp.asarray(x))
+    strat = TensorParallel(mesh, lm)
+    from bigdl_tpu.optim import SGD
+    sp, _, _ = strat.place(params, lm.init_state(),
+                           SGD(learning_rate=0.1).init(params))
+    y_tp = jax.jit(lambda p, xs: lm.forward(p, xs))(sp, jnp.asarray(x))
+    np.testing.assert_allclose(np.asarray(y_tp), np.asarray(y_ref),
+                               atol=1e-4)
+
+
 def test_tp_transformer_forward_sharded(rng):
     """A TP-sharded transformer forward under jit must equal the replicated
     forward (XLA inserts the Megatron collectives)."""
