@@ -146,6 +146,9 @@ class MultiHeadAttention(SimpleModule):
         if attn_impl == "flash":
             from bigdl_tpu.ops import flash_attention
             attn_impl = flash_attention
+        elif attn_impl == "blockwise":
+            from bigdl_tpu.ops import blockwise_attention
+            attn_impl = blockwise_attention
         self.attn_fn: AttnFn = attn_impl or dot_product_attention
 
     def init(self, rng):

@@ -8,6 +8,8 @@ attention (and, as they land, LRN and other fused ops). Every kernel has a
 pure-XLA fallback used off-TPU so the API is always importable.
 """
 
-from bigdl_tpu.ops.attention_kernel import flash_attention
+from bigdl_tpu.ops.attention_kernel import (
+    blockwise_attention, flash_attention,
+)
 
-__all__ = ["flash_attention"]
+__all__ = ["flash_attention", "blockwise_attention"]

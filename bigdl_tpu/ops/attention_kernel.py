@@ -30,9 +30,82 @@ import jax.numpy as jnp
 
 from bigdl_tpu.nn import attention as _dense
 
-__all__ = ["flash_attention"]
+__all__ = ["flash_attention", "blockwise_attention",
+           "online_softmax_update"]
 
 _NEG_INF = -1e30
+
+
+def online_softmax_update(q, kb, vb, m, l, acc, scale, valid=None):
+    """One block step of the streaming softmax shared by
+    :func:`blockwise_attention` and ring attention
+    (bigdl_tpu.parallel.sequence): fold K/V block (kb, vb) into the
+    running (max m, normalizer l, output accumulator acc) for queries q.
+    ``valid`` is an optional (..., s_q, bk) bool mask. All stats fp32.
+    """
+    logits = jnp.einsum("...qd,...kd->...qk", q, kb.astype(jnp.float32),
+                        preferred_element_type=jnp.float32) * scale
+    if valid is not None:
+        logits = jnp.where(valid, logits, _NEG_INF)
+    blk_max = jnp.max(logits, axis=-1, keepdims=True)
+    new_m = jnp.maximum(m, blk_max)
+    p = jnp.exp(logits - new_m)
+    if valid is not None:
+        p = jnp.where(valid, p, 0.0)
+    corr = jnp.exp(m - new_m)
+    l = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+    acc = acc * corr + jnp.einsum("...qk,...kd->...qd", p,
+                                  vb.astype(jnp.float32))
+    return new_m, l, acc
+
+
+def blockwise_attention(q, k, v, *, causal: bool = False,
+                        mask: Optional[jax.Array] = None,
+                        block_k: int = 128):
+    """O(seq) memory attention in pure JAX: ``lax.scan`` over K/V blocks
+    with an online softmax, the scan body wrapped in ``jax.checkpoint`` so
+    autodiff recomputes each block instead of saving the (s_q, block_k)
+    probability tiles — the remat-scan formulation of flash attention.
+    Differentiable end-to-end; serves as the flash kernel's backward path
+    and as a standalone ``attn_impl``. q,k,v: (b, h, s, d).
+    """
+    s_k = k.shape[-2]
+    bk = min(block_k, s_k)
+    if mask is not None or s_k % bk:
+        # arbitrary masks don't tile; ragged tails aren't worth the
+        # complexity — correctness over memory for those cases
+        return _dense.dot_product_attention(q, k, v, causal=causal,
+                                            mask=mask)
+    n_blk = s_k // bk
+    scale = 1.0 / (q.shape[-1] ** 0.5)
+    s_q = q.shape[-2]
+    q_offset = s_k - s_q  # bottom-right aligned causal
+    qf = q.astype(jnp.float32)
+    q_pos = q_offset + jnp.arange(s_q)
+
+    kb = k.reshape(k.shape[:-2] + (n_blk, bk, k.shape[-1]))
+    vb = v.reshape(v.shape[:-2] + (n_blk, bk, v.shape[-1]))
+    # scan carries move the block axis to the front
+    kb = jnp.moveaxis(kb, -3, 0)
+    vb = jnp.moveaxis(vb, -3, 0)
+
+    @jax.checkpoint
+    def body(carry, blk):
+        m, l, acc, j = carry
+        kj, vj = blk
+        valid = None
+        if causal:
+            k_pos = j * bk + jnp.arange(bk)
+            valid = q_pos[:, None] >= k_pos[None, :]
+        m, l, acc = online_softmax_update(qf, kj, vj, m, l, acc, scale,
+                                          valid)
+        return (m, l, acc, j + 1), None
+
+    m0 = jnp.full(qf.shape[:-1] + (1,), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros(qf.shape[:-1] + (1,), jnp.float32)
+    a0 = jnp.zeros(qf.shape, jnp.float32)
+    (_, l, acc, _), _ = jax.lax.scan(body, (m0, l0, a0, 0), (kb, vb))
+    return (acc / jnp.maximum(l, 1e-30)).astype(q.dtype)
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, scale: float,
@@ -43,6 +116,14 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, scale: float,
     q = q_ref[0].astype(jnp.float32)  # (BQ, d)
     bq = q.shape[0]
     n_k = seq_k // block_k
+    if causal:
+        # skip fully-future K blocks: the last query of this tile sits at
+        # q_offset + (j+1)*block_q - 1, so later blocks are all masked —
+        # halves the FLOPs of causal self-attention
+        q_end = q_offset + (j + 1) * block_q - 1
+        n_loop = jnp.minimum(n_k, q_end // block_k + 1)
+    else:
+        n_loop = n_k
 
     # bottom-right aligned causal (matches dot_product_attention): query i
     # sees keys <= (s_k - s_q) + i
@@ -77,7 +158,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, scale: float,
     m0 = jnp.full((bq, 1), _NEG_INF, jnp.float32)
     l0 = jnp.zeros((bq, 1), jnp.float32)
     a0 = jnp.zeros(q.shape, jnp.float32)
-    _, l, acc = jax.lax.fori_loop(0, n_k, body, (m0, l0, a0))
+    _, l, acc = jax.lax.fori_loop(0, n_loop, body, (m0, l0, a0))
     o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
 
 
@@ -144,11 +225,11 @@ def _flash_vjp_fwd(q, k, v, causal, block_q, block_k):
 
 def _flash_vjp_bwd(causal, block_q, block_k, res, g):
     q, k, v = res
-    # dense recompute backward (correct; flash-blockwise bwd is a future
-    # optimization)
+    # blockwise-remat recompute: O(seq) memory like the forward kernel
+    # (the dense path would materialize the (s, s) score matrix here)
     _, vjp = jax.vjp(
-        lambda q_, k_, v_: _dense.dot_product_attention(
-            q_, k_, v_, causal=causal, mask=None), q, k, v)
+        lambda q_, k_, v_: blockwise_attention(
+            q_, k_, v_, causal=causal, block_k=block_k), q, k, v)
     return vjp(g)
 
 

@@ -241,6 +241,9 @@ class Optimizer:
                   "loss": float("inf")}
         wall_start = time.time()
         records_this_epoch = 0
+        _end = object()  # end-of-epoch sentinel (None could be a real batch)
+        last_log_t = time.time()
+        fetch_accum = 0.0
 
         while not self.end_when(driver):
             driver["epoch_finished"] = False
@@ -250,10 +253,10 @@ class Optimizer:
             data_iter = iter(self.dataset)
             while True:
                 t_fetch = time.time()
-                batch = next(data_iter, None)
-                if batch is None:
+                batch = next(data_iter, _end)
+                if batch is _end:
                     break
-                self.metrics.add("get batch time", time.time() - t_fetch)
+                fetch_accum += time.time() - t_fetch
                 t0 = time.time()
                 x, y = batch
                 if self.strategy is not None:
@@ -279,7 +282,14 @@ class Optimizer:
                             f"{driver['epoch']}) — NaN guard tripped; last "
                             f"checkpoint is the recovery point")
                     dt = time.time() - t0
-                    self.metrics.add("computing time", dt)
+                    # both counters cover the SAME interval (since the last
+                    # log point), so their sums are comparable: host wall
+                    # time = batch fetch + compute/dispatch/device wait
+                    now = time.time()
+                    self.metrics.add("get batch time", fetch_accum)
+                    self.metrics.add("computing time",
+                                     (now - last_log_t) - fetch_accum)
+                    last_log_t, fetch_accum = now, 0.0
                     logger.info(
                         "Train %d in %.4fs. Throughput is %.1f "
                         "records/second. Loss is %.4f",

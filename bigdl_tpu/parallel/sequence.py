@@ -24,6 +24,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from bigdl_tpu.ops.attention_kernel import online_softmax_update
+
 __all__ = ["ring_attention", "make_ring_attention"]
 
 _NEG_INF = -1e30  # finite mask value: keeps exp() well-defined in blocks
@@ -47,27 +49,17 @@ def ring_attention(q, k, v, *, axis_name: str, causal: bool = False):
         kb, vb, m, l, o = carry
         # after t hops of "send to next", I hold the block born on (my - t)
         src = (my - t) % n
-        # bf16 multiply on the MXU, fp32 accumulate — same numerics as the
-        # dense path's preferred_element_type
-        logits = jnp.einsum("...qd,...kd->...qk", q, kb,
-                            preferred_element_type=jnp.float32) * scale
+        valid = None
         if causal:
             k_pos = src * s_k + jnp.arange(s_k)
             valid = q_pos[:, None] >= k_pos[None, :]
-            logits = jnp.where(valid, logits, _NEG_INF)
-        blk_max = jnp.max(logits, axis=-1, keepdims=True)
-        new_m = jnp.maximum(m, blk_max)
-        p = jnp.exp(logits - new_m)
-        if causal:
-            p = jnp.where(valid, p, 0.0)
-        corr = jnp.exp(m - new_m)
-        l = l * corr + jnp.sum(p, axis=-1, keepdims=True)
-        o = o * corr + jnp.einsum("...qk,...kd->...qd", p,
-                                  vb.astype(jnp.float32))
+        # shared streaming-softmax block update (bf16 multiply on the MXU,
+        # fp32 stats — same numerics as the dense path)
+        m, l, o = online_softmax_update(q, kb, vb, m, l, o, scale, valid)
         perm = [(i, (i + 1) % n) for i in range(n)]
         kb = jax.lax.ppermute(kb, axis_name, perm)
         vb = jax.lax.ppermute(vb, axis_name, perm)
-        return (kb, vb, new_m, l, o), None
+        return (kb, vb, m, l, o), None
 
     m0 = jnp.full(q.shape[:-1] + (1,), _NEG_INF, jnp.float32)
     l0 = jnp.zeros(q.shape[:-1] + (1,), jnp.float32)

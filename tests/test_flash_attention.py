@@ -73,6 +73,50 @@ def test_flash_gradients_match_dense():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-4)
 
 
+@pytest.mark.parametrize("causal", [False, True])
+def test_blockwise_matches_dense(causal):
+    from bigdl_tpu.ops import blockwise_attention
+
+    q, k, v = _qkv(s=96, seed=8)
+    ref = dot_product_attention(q, k, v, causal=causal)
+    out = blockwise_attention(q, k, v, causal=causal, block_k=32)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_blockwise_gradients_match_dense():
+    from bigdl_tpu.ops import blockwise_attention
+
+    q, k, v = _qkv(s=64, seed=9)
+    gb = jax.grad(lambda q, k, v: blockwise_attention(
+        q, k, v, causal=True, block_k=16).sum(), argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(lambda q, k, v: dot_product_attention(
+        q, k, v, causal=True).sum(), argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gb, gd):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-4)
+
+
+def test_blockwise_decode_alignment():
+    from bigdl_tpu.ops import blockwise_attention
+
+    rs = np.random.RandomState(10)
+    q = jnp.asarray(rs.randn(1, 2, 8, 8).astype(np.float32))
+    k = jnp.asarray(rs.randn(1, 2, 32, 8).astype(np.float32))
+    v = jnp.asarray(rs.randn(1, 2, 32, 8).astype(np.float32))
+    ref = dot_product_attention(q, k, v, causal=True)
+    out = blockwise_attention(q, k, v, causal=True, block_k=16)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_mha_blockwise_impl(rng):
+    mha_d = nn.MultiHeadAttention(32, 4, causal=True)
+    mha_b = nn.MultiHeadAttention(32, 4, causal=True,
+                                  attn_impl="blockwise")
+    p = mha_d.init(rng)
+    x = jnp.asarray(np.random.RandomState(11).randn(2, 16, 32), np.float32)
+    np.testing.assert_allclose(np.asarray(mha_b.forward(p, x)),
+                               np.asarray(mha_d.forward(p, x)), atol=2e-5)
+
+
 def test_mha_flash_impl_end_to_end(rng):
     """MultiHeadAttention(attn_impl='flash') == default impl."""
     mha_d = nn.MultiHeadAttention(32, 4, causal=True)

@@ -1,0 +1,89 @@
+"""Coverage for the small layers the main suites don't touch: Add/Mul,
+Copy/Contiguous/Echo identities, CriterionTable, ClassSimplexCriterion,
+L1HingeEmbeddingCriterion, SpatialShareConvolution, TemporalMaxPooling."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import torch
+import torch.nn.functional as F
+
+from bigdl_tpu import nn
+
+
+def test_mul_and_add(rng):
+    x = jnp.asarray(np.random.RandomState(0).randn(4, 6), jnp.float32)
+    mul = nn.Mul()
+    p = mul.init(rng)
+    np.testing.assert_allclose(np.asarray(mul.forward(p, x)),
+                               np.asarray(x) * float(p["weight"]), atol=1e-6)
+    add = nn.Add(6)
+    pa = add.init(rng)
+    np.testing.assert_allclose(np.asarray(add.forward(pa, x)),
+                               np.asarray(x) + np.asarray(pa["bias"]),
+                               atol=1e-6)
+
+
+def test_identity_family(rng, capsys):
+    x = jnp.asarray(np.random.RandomState(0).randn(2, 3), jnp.float32)
+    for mod in (nn.Copy(), nn.Contiguous()):
+        np.testing.assert_array_equal(np.asarray(mod.forward({}, x)),
+                                      np.asarray(x))
+    y = nn.Echo().forward({}, x)
+    np.testing.assert_array_equal(np.asarray(y), np.asarray(x))
+    assert "shape=(2, 3)" in capsys.readouterr().out
+
+
+def test_criterion_table_wraps_criterion():
+    x = jnp.asarray(np.random.RandomState(0).randn(4, 3), jnp.float32)
+    t = jnp.asarray(np.random.RandomState(1).randn(4, 3), jnp.float32)
+    ct = nn.CriterionTable(nn.MSECriterion())
+    np.testing.assert_allclose(float(ct.forward({}, (x, t))),
+                               float(nn.MSECriterion()(x, t)), atol=1e-6)
+
+
+def test_class_simplex_criterion_properties():
+    """Simplex embedding: unit-norm vertices, equal pairwise angles; loss
+    is zero when input sits exactly on the target's vertex."""
+    crit = nn.ClassSimplexCriterion(4)
+    s = np.asarray(crit._simplex)
+    np.testing.assert_allclose(np.linalg.norm(s, axis=1), 1.0, atol=1e-5)
+    dots = s @ s.T
+    off = dots[~np.eye(4, dtype=bool)]
+    np.testing.assert_allclose(off, off[0], atol=1e-5)
+    y = jnp.asarray([2, 0], jnp.int32)
+    perfect = jnp.asarray(s[np.asarray(y)])
+    assert float(crit(perfect, y)) < 1e-10
+
+
+def test_l1_hinge_embedding_matches_torch():
+    rs = np.random.RandomState(0)
+    x1 = rs.randn(5, 4).astype(np.float32)
+    x2 = rs.randn(5, 4).astype(np.float32)
+    y = np.asarray([1, -1, 1, -1, -1], np.float32)
+    ours = float(nn.L1HingeEmbeddingCriterion(margin=1.0)(
+        (jnp.asarray(x1), jnp.asarray(x2)), jnp.asarray(y)))
+    d = torch.pairwise_distance(torch.from_numpy(x1), torch.from_numpy(x2),
+                                p=1, eps=0.0)
+    theirs = float(F.hinge_embedding_loss(d, torch.from_numpy(y), margin=1.0))
+    np.testing.assert_allclose(ours, theirs, rtol=1e-5)
+
+
+def test_spatial_share_convolution_is_spatial_convolution(rng):
+    """API-parity alias: identical math to SpatialConvolution (buffer
+    sharing is XLA's memory planner's job)."""
+    a = nn.SpatialConvolution(3, 8, 3, 3, pad_w=1, pad_h=1)
+    b = nn.SpatialShareConvolution(3, 8, 3, 3, pad_w=1, pad_h=1)
+    p = a.init(rng)
+    x = jnp.asarray(np.random.RandomState(0).randn(2, 8, 8, 3), jnp.float32)
+    np.testing.assert_allclose(np.asarray(a.forward(p, x)),
+                               np.asarray(b.forward(p, x)), atol=1e-6)
+
+
+def test_temporal_max_pooling_matches_torch():
+    rs = np.random.RandomState(0)
+    x = rs.randn(2, 12, 5).astype(np.float32)
+    ours = nn.TemporalMaxPooling(3, 2).forward({}, jnp.asarray(x))
+    theirs = F.max_pool1d(torch.from_numpy(x).permute(0, 2, 1), 3,
+                          stride=2).permute(0, 2, 1).numpy()
+    np.testing.assert_allclose(np.asarray(ours), theirs, atol=1e-6)
