@@ -41,9 +41,11 @@ def online_softmax_update(q, kb, vb, m, l, acc, scale, valid=None):
     :func:`blockwise_attention` and ring attention
     (bigdl_tpu.parallel.sequence): fold K/V block (kb, vb) into the
     running (max m, normalizer l, output accumulator acc) for queries q.
-    ``valid`` is an optional (..., s_q, bk) bool mask. All stats fp32.
+    ``valid`` is an optional (..., s_q, bk) bool mask. Stats (m, l, acc)
+    are fp32; q/kb/vb keep their input dtype so bf16 operands take the
+    fast MXU path, with fp32 accumulation via ``preferred_element_type``.
     """
-    logits = jnp.einsum("...qd,...kd->...qk", q, kb.astype(jnp.float32),
+    logits = jnp.einsum("...qd,...kd->...qk", q, kb,
                         preferred_element_type=jnp.float32) * scale
     if valid is not None:
         logits = jnp.where(valid, logits, _NEG_INF)
@@ -54,8 +56,10 @@ def online_softmax_update(q, kb, vb, m, l, acc, scale, valid=None):
         p = jnp.where(valid, p, 0.0)
     corr = jnp.exp(m - new_m)
     l = l * corr + jnp.sum(p, axis=-1, keepdims=True)
-    acc = acc * corr + jnp.einsum("...qk,...kd->...qd", p,
-                                  vb.astype(jnp.float32))
+    # p down to V's dtype (flash-attention convention): both P@V operands
+    # bf16 on the MXU, fp32 accumulate; fp32 inputs are untouched
+    acc = acc * corr + jnp.einsum("...qk,...kd->...qd", p.astype(vb.dtype),
+                                  vb, preferred_element_type=jnp.float32)
     return new_m, l, acc
 
 
@@ -80,7 +84,6 @@ def blockwise_attention(q, k, v, *, causal: bool = False,
     scale = 1.0 / (q.shape[-1] ** 0.5)
     s_q = q.shape[-2]
     q_offset = s_k - s_q  # bottom-right aligned causal
-    qf = q.astype(jnp.float32)
     q_pos = q_offset + jnp.arange(s_q)
 
     kb = k.reshape(k.shape[:-2] + (n_blk, bk, k.shape[-1]))
@@ -97,13 +100,13 @@ def blockwise_attention(q, k, v, *, causal: bool = False,
         if causal:
             k_pos = j * bk + jnp.arange(bk)
             valid = q_pos[:, None] >= k_pos[None, :]
-        m, l, acc = online_softmax_update(qf, kj, vj, m, l, acc, scale,
+        m, l, acc = online_softmax_update(q, kj, vj, m, l, acc, scale,
                                           valid)
         return (m, l, acc, j + 1), None
 
-    m0 = jnp.full(qf.shape[:-1] + (1,), _NEG_INF, jnp.float32)
-    l0 = jnp.zeros(qf.shape[:-1] + (1,), jnp.float32)
-    a0 = jnp.zeros(qf.shape, jnp.float32)
+    m0 = jnp.full(q.shape[:-1] + (1,), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros(q.shape[:-1] + (1,), jnp.float32)
+    a0 = jnp.zeros(q.shape, jnp.float32)
     (_, l, acc, _), _ = jax.lax.scan(body, (m0, l0, a0, 0), (kb, vb))
     return (acc / jnp.maximum(l, 1e-30)).astype(q.dtype)
 
@@ -113,7 +116,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, scale: float,
     from jax.experimental import pallas as pl
 
     j = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32)  # (BQ, d)
+    q = q_ref[0]  # (BQ, d) — keep input dtype: bf16 operands on the MXU,
+    # fp32 accumulation via preferred_element_type below
     bq = q.shape[0]
     n_k = seq_k // block_k
     if causal:
@@ -135,7 +139,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, scale: float,
         kblk = k_ref[0, pl.dslice(kb * block_k, block_k), :]
         vblk = v_ref[0, pl.dslice(kb * block_k, block_k), :]
         s = jax.lax.dot_general(
-            q, kblk.astype(jnp.float32),
+            q, kblk,
             (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale  # (BQ, BK)
         if causal:
@@ -149,8 +153,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, scale: float,
             p = jnp.where(q_pos >= k_pos, p, 0.0)
         corr = jnp.exp(m - new_m)
         l = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+        # cast p down to V's dtype (flash-attention convention) so the
+        # P@V product is also a bf16 MXU matmul with fp32 accumulation
         acc = acc * corr + jax.lax.dot_general(
-            p, vblk.astype(jnp.float32),
+            p.astype(vblk.dtype), vblk,
             (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         return new_m, l, acc

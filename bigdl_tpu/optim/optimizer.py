@@ -58,7 +58,7 @@ class Optimizer:
                  end_when: Optional[Trigger] = None,
                  strategy=None, seed: int = 42, log_every: int = 1,
                  compute_dtype=None, accum_steps: int = 1,
-                 nan_check: bool = True):
+                 nan_check: bool = True, aux_loss_weight: float = 0.01):
         self.model = model
         self.dataset = dataset
         self.criterion = criterion
@@ -78,6 +78,11 @@ class Optimizer:
         # failure mode left worth watching). Free: piggybacks on the loss
         # sync the log line already pays for.
         self.nan_check = nan_check
+        # modules may surface auxiliary losses through their state tree as
+        # scalar leaves named "aux_loss" (nn.MoE load balancing); they are
+        # added to the criterion loss with this weight (Switch Transformer's
+        # 0.01 default). Set 0.0 to disable.
+        self.aux_loss_weight = aux_loss_weight
         self._val_trigger = None
         self._val_dataset = None
         self._val_methods: Sequence[ValidationMethod] = ()
@@ -162,6 +167,19 @@ class Optimizer:
 
         dtype = self.compute_dtype
         accum = max(1, self.accum_steps)
+        aux_w = self.aux_loss_weight
+
+        def sum_aux_losses(state):
+            # modules surface auxiliary losses as scalar "aux_loss" state
+            # leaves (nn/moe.py); collect them so Optimizer-driven training
+            # gets load balancing without a hand-written step
+            total = jnp.zeros((), jnp.float32)
+            for path, leaf in jax.tree_util.tree_leaves_with_path(state):
+                last = path[-1] if path else None
+                if (isinstance(last, jax.tree_util.DictKey)
+                        and last.key == "aux_loss"):
+                    total = total + leaf.astype(jnp.float32)
+            return total
 
         def grads_of(params, mod_state, x, y, rng):
             if dtype is not None and jnp.issubdtype(x.dtype, jnp.floating):
@@ -172,7 +190,10 @@ class Optimizer:
                                           training=True, rng=rng)
                 if dtype is not None:
                     out = out.astype(jnp.float32)  # fp32 loss/softmax
-                return criterion(out, y), new_ms
+                loss = criterion(out, y)
+                if aux_w:
+                    loss = loss + aux_w * sum_aux_losses(new_ms)
+                return loss, new_ms
 
             (loss, new_ms), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(params)

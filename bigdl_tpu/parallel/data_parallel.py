@@ -58,9 +58,12 @@ def _zero1_spec(leaf, mesh: Mesh, axis: str) -> P:
 def opt_sharding_like_params(mesh, opt_state, params, param_shardings,
                              zero1_axis: Optional[str] = None):
     """Shardings for an optimizer-state pytree: subtrees that mirror the
-    params structure (velocity/m/v/accum) take the matching param sharding;
-    everything else replicates, optionally ZeRO-1 sharded over
-    ``zero1_axis``. Shared by the TP and pipeline strategies."""
+    params structure (velocity/m/v/accum) take the matching param sharding —
+    except leaves whose param is fully replicated (spec ``P()``), which under
+    ``zero1_axis`` get ZeRO-1 sharded instead (the momentum/m/v of non-TP-
+    split params is the bulk of optimizer memory; leaving it replicated would
+    defeat ZeRO-1 under TensorParallel). Everything else replicates,
+    optionally ZeRO-1 sharded. Shared by the TP and pipeline strategies."""
     p_struct = jax.tree_util.tree_structure(params)
 
     def fallback(x):
@@ -68,9 +71,17 @@ def opt_sharding_like_params(mesh, opt_state, params, param_shardings,
             return NamedSharding(mesh, _zero1_spec(x, mesh, zero1_axis))
         return NamedSharding(mesh, P())
 
+    def like_param(x, sh):
+        # replicated param + zero1 => shard its optimizer state anyway
+        if (zero1_axis is not None and hasattr(x, "ndim")
+                and isinstance(sh, NamedSharding)
+                and all(s is None for s in sh.spec)):
+            return NamedSharding(mesh, _zero1_spec(x, mesh, zero1_axis))
+        return sh
+
     def subtree(st):
         if jax.tree_util.tree_structure(st) == p_struct:
-            return param_shardings
+            return jax.tree_util.tree_map(like_param, st, param_shardings)
         return jax.tree_util.tree_map(fallback, st)
 
     if isinstance(opt_state, dict):

@@ -58,29 +58,42 @@ def _div(dim: int, n: int) -> bool:
     return n > 1 and dim % n == 0 and dim >= n
 
 
+def _pointwise(mod) -> bool:
+    """True for layers transparent to a feature-dim sharding — elementwise
+    activations and Dropout may sit between a column-parallel and a
+    row-parallel Linear without forcing a resharding. SoftMax/SoftMin/
+    LogSoftMax reduce over the feature axis, so they are NOT transparent:
+    pairing across them would force an all-gather per layer."""
+    from bigdl_tpu import nn
+    if isinstance(mod, (nn.SoftMax, nn.SoftMin, nn.LogSoftMax)):
+        return False
+    return (type(mod).__module__ == "bigdl_tpu.nn.activation"
+            or isinstance(mod, nn.Dropout))
+
+
 def megatron_specs(module, params, axis: str, n_shard: int):
     """Build the param-sharding spec pytree for ``module``'s ``params``.
 
-    Dispatches on layer type, recursing through containers. ``_state`` keeps
-    the column/row alternation across sibling Linears (Megatron pairing).
+    Dispatches on layer type, recursing through containers. Megatron
+    pairing is **structural**, not visit-order: within an ordered container
+    a Linear is column-split only when a second Linear follows it (possibly
+    through pointwise activations/Dropout) to take the matching row split —
+    so branchy models (Concat, lone classifier heads, odd Linear counts)
+    never silently land in an all-gather-heavy layout; unpaired Linears
+    replicate.
     """
     from bigdl_tpu import nn
 
-    state = {"linear_toggle": 0}
+    def linear_col_spec(p):
+        spec = {"weight": P(None, axis)}
+        if "bias" in p:
+            spec["bias"] = P(axis)
+        return spec
 
-    def linear_spec(mod, p):
-        # weight (in, out); alternate column (shard out) / row (shard in)
-        w = p["weight"]
-        col = state["linear_toggle"] % 2 == 0
-        spec = {"weight": P(), "bias": P()} if "bias" in p else {"weight": P()}
-        if col and _div(w.shape[1], n_shard):
-            spec["weight"] = P(None, axis)
-            if "bias" in p:
-                spec["bias"] = P(axis)
-            state["linear_toggle"] += 1
-        elif not col and _div(w.shape[0], n_shard):
-            spec["weight"] = P(axis, None)
-            state["linear_toggle"] += 1
+    def linear_row_spec(p):
+        spec = {"weight": P(axis, None)}
+        if "bias" in p:
+            spec["bias"] = P()
         return spec
 
     def mha_spec(mod, p):
@@ -121,13 +134,52 @@ def megatron_specs(module, params, axis: str, n_shard: int):
             return {"weight": P(None, axis)}
         return replicated_specs(p)
 
+    def seq_spec(children, p):
+        """Ordered-container walk with structural Megatron pairing."""
+        out = {}
+        n_c = len(children)
+        i = 0
+        while i < n_c:
+            k, c = str(i), children[i]
+            if isinstance(c, nn.Linear) and k in p:
+                # look past pointwise layers for the row-split partner
+                j = i + 1
+                while j < n_c and _pointwise(children[j]):
+                    j += 1
+                kj = str(j)
+                if (j < n_c and isinstance(children[j], nn.Linear)
+                        and kj in p
+                        and _div(p[k]["weight"].shape[1], n_shard)
+                        and _div(p[kj]["weight"].shape[0], n_shard)):
+                    out[k] = linear_col_spec(p[k])
+                    out[kj] = linear_row_spec(p[kj])
+                    for m in range(i + 1, j):  # pointwise layers between
+                        km = str(m)
+                        if km in p:
+                            out[km] = replicated_specs(p[km])
+                    i = j + 1
+                    continue
+                out[k] = replicated_specs(p[k])  # unpaired: replicate
+                i += 1
+                continue
+            if k in p:
+                out[k] = rec(c, p[k])
+            i += 1
+        # container-level params not belonging to an indexed child
+        for k in p:
+            if k not in out:
+                out[k] = replicated_specs(p[k])
+        return out
+
     def rec(mod, p):
         if isinstance(mod, nn.TransformerEncoderLayer):
             return block_spec(mod, p)
         if isinstance(mod, nn.MultiHeadAttention):
             return mha_spec(mod, p)
         if isinstance(mod, nn.Linear):
-            return linear_spec(mod, p)
+            # a Linear reached outside an ordered container has no partner
+            # to pair with — replicate (correct over clever)
+            return replicated_specs(p)
         if isinstance(mod, nn.LookupTable):
             return lookup_spec(mod, p)
         if isinstance(mod, nn.SpatialConvolution):
@@ -145,12 +197,17 @@ def megatron_specs(module, params, axis: str, n_shard: int):
             return out
         children = mod.children()
         if children and isinstance(p, dict):
+            from bigdl_tpu.core import Sequential
+            if isinstance(mod, Sequential):
+                return seq_spec(children, p)
+            # parallel containers (Concat/ConcatTable/ParallelTable/...):
+            # each branch recurses independently — pairing never spans
+            # branches that execute side by side
             out = {}
             for i, c in enumerate(children):
                 k = str(i)
                 if k in p:
                     out[k] = rec(c, p[k])
-            # container-level params not belonging to an indexed child
             for k in p:
                 if k not in out:
                     out[k] = replicated_specs(p[k])

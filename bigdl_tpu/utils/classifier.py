@@ -44,12 +44,18 @@ class Classifier:
         """Raw model outputs (e.g. log-probs) for every row of x."""
         n = len(x)
         if n == 0:
-            # probe one padded batch for the output shape so empty input
-            # round-trips with the right rank
-            probe = np.zeros((self.batch_size,) + np.asarray(x).shape[1:],
-                             np.float32)
-            y = self._fwd(self.params, self.mod_state, jnp.asarray(probe))
-            return np.zeros((0,) + np.asarray(y).shape[1:])
+            feat_shape = np.asarray(x).shape[1:]
+            if not feat_shape:
+                # a plain empty list carries no feature dims — nothing to
+                # trace a forward with; return a benign empty vector
+                return np.zeros((0,), np.float32)
+            # learn the output shape without compiling or executing the
+            # forward: abstract evaluation of the same jitted fn
+            probe = jax.ShapeDtypeStruct((self.batch_size,) + feat_shape,
+                                         jnp.float32)
+            y = jax.eval_shape(self._fwd, self.params, self.mod_state,
+                               probe)
+            return np.zeros((0,) + y.shape[1:])
         outs = []
         for i in range(0, n, self.batch_size):
             chunk = np.asarray(x[i:i + self.batch_size])

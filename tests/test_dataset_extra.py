@@ -132,6 +132,23 @@ def test_classifier_predict_matches_direct():
     assert scores.shape == (37, 4)
 
 
+def test_classifier_empty_input():
+    """Empty inputs round-trip without compiling a forward: shaped empty
+    arrays keep the output rank (via eval_shape), a bare empty list gets a
+    benign empty vector (ADVICE r1: the old probe crashed on rank-1)."""
+    from bigdl_tpu import nn
+    from bigdl_tpu.core import Sequential
+
+    model = Sequential(nn.Linear(6, 4), nn.LogSoftMax())
+    params = model.init(jax.random.PRNGKey(0))
+    clf = Classifier(model, params, batch_size=16)
+    scores = clf.predict_scores(np.zeros((0, 6), np.float32))
+    assert scores.shape == (0, 4)
+    assert clf.predict(np.zeros((0, 6), np.float32)).shape == (0,)
+    assert clf.predict_scores([]).shape == (0,)
+    assert clf.predict([]).shape == (0,)
+
+
 def test_classifier_predict_iter():
     from bigdl_tpu import nn
     from bigdl_tpu.core import Sequential

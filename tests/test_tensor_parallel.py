@@ -40,6 +40,37 @@ def test_megatron_specs_transformer_block(rng):
     assert specs["ln1"]["weight"] == P()
 
 
+def test_megatron_specs_structural_pairing_branchy(rng):
+    """Pairing is structural, not visit-order: Concat branches pair
+    independently, a lone classifier head after an odd Linear count
+    replicates instead of silently going column-parallel."""
+    model = Sequential(
+        nn.Concat(
+            Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 8)),
+            Sequential(nn.Linear(8, 32), nn.Tanh(), nn.Linear(32, 8)),
+        ),
+        nn.Linear(16, 10),  # lone head — must replicate
+    )
+    params = model.init(rng)
+    specs = megatron_specs(model, params, "model", 2)
+    for b in ("0", "1"):  # both branches pair col/row internally
+        assert specs["0"][b]["0"]["weight"] == P(None, "model")
+        assert specs["0"][b]["2"]["weight"] == P("model", None)
+    assert specs["1"]["weight"] == P()
+    assert specs["1"]["bias"] == P()
+
+
+def test_megatron_specs_odd_linear_chain(rng):
+    """Three chained Linears: first two pair, third replicates."""
+    model = Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 8),
+                       nn.ReLU(), nn.Linear(8, 4))
+    params = model.init(rng)
+    specs = megatron_specs(model, params, "model", 2)
+    assert specs["0"]["weight"] == P(None, "model")
+    assert specs["2"]["weight"] == P("model", None)
+    assert specs["4"]["weight"] == P()
+
+
 def test_indivisible_dims_stay_replicated(rng):
     model = Sequential(nn.Linear(8, 7), nn.Tanh(), nn.Linear(7, 3))
     params = model.init(rng)
@@ -87,6 +118,12 @@ def test_tp_params_actually_sharded(rng):
     # optimizer state inherits the param sharding (velocity tree)
     v0 = opt_state["velocity"]["0"]["weight"]
     assert v0.sharding.is_equivalent_to(w0.sharding, 2)
+    # ADVICE r1: a REPLICATED param's optimizer state must still be ZeRO-1
+    # sharded over the data axis (it's the bulk of optimizer memory)
+    b2 = params["2"]["bias"]  # row-parallel Linear keeps bias replicated
+    assert all(s is None for s in b2.sharding.spec)
+    v2 = opt_state["velocity"]["2"]["bias"]
+    assert "data" in str(v2.sharding.spec), v2.sharding
 
 
 def test_tp_transformer_lm_sharded_matches(rng):
