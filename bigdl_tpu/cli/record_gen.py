@@ -1,0 +1,49 @@
+"""Record-shard generator CLI (reference
+models/utils/ImageNetSeqFileGenerator.scala — parallel workers packing
+ImageNet folders into 512-image Hadoop SequenceFiles; here: .btr record
+shards, bigdl_tpu/dataset/recordfile.py).
+
+    python -m bigdl_tpu.cli.record_gen -f /data/imagenet -o /data/records \
+        -b 512 -p 8
+
+Expects ``<folder>/train`` and/or ``<folder>/val`` label-by-folder trees
+(falls back to treating ``<folder>`` itself as one split). Training then
+reads the shards with ``RecordImageDataSet(out_dir/train, ...)``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser("bigdl-tpu record-gen")
+    p.add_argument("-f", "--folder", required=True,
+                   help="imagenet-style root (train/ and val/ subfolders)")
+    p.add_argument("-o", "--output", required=True)
+    p.add_argument("-b", "--blockSize", type=int, default=512,
+                   help="images per shard (reference default 512)")
+    p.add_argument("-p", "--parallel", type=int, default=8)
+    p.add_argument("--limit", type=int, default=None,
+                   help="cap images per split (debug)")
+    args = p.parse_args(argv)
+
+    from bigdl_tpu.dataset.recordfile import write_image_shards
+
+    splits = [s for s in ("train", "val")
+              if os.path.isdir(os.path.join(args.folder, s))]
+    if not splits:
+        splits = [""]
+    for s in splits:
+        src = os.path.join(args.folder, s) if s else args.folder
+        dst = os.path.join(args.output, s) if s else args.output
+        shards = write_image_shards(
+            src, dst, prefix=s or "data",
+            images_per_shard=args.blockSize, workers=args.parallel,
+            limit=args.limit)
+        print(f"{src}: wrote {len(shards)} shards to {dst}")
+
+
+if __name__ == "__main__":
+    main()

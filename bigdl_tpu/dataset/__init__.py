@@ -11,3 +11,9 @@ from bigdl_tpu.dataset.folder import (
     ImageFolderDataSet, load_image_folder, list_image_folder,
 )
 from bigdl_tpu.dataset.distributed import ShardedDataSet, host_shard
+from bigdl_tpu.dataset.recordfile import (
+    RecordWriter, RecordReader, write_image_shards, list_shards,
+)
+from bigdl_tpu.dataset.streaming import (
+    StreamingImageFolder, RecordImageDataSet,
+)

@@ -13,11 +13,9 @@ from __future__ import annotations
 
 import os
 from concurrent.futures import ThreadPoolExecutor
-from typing import Iterator, Optional, Sequence
+from typing import Optional, Sequence
 
 import numpy as np
-
-from bigdl_tpu.dataset.dataset import DataSet, MiniBatch
 
 __all__ = ["list_image_folder", "load_image_folder", "ImageFolderDataSet",
            "IMAGENET_MEAN", "IMAGENET_STD"]
@@ -83,50 +81,26 @@ def load_image_folder(root: str, size: tuple[int, int] = (224, 224),
         (0, *size, 3), np.uint8), labels, classes
 
 
-class ImageFolderDataSet(DataSet):
-    """Lazy batched image-folder dataset: decodes per batch with a thread
-    pool, so arbitrarily large datasets stream from disk (the ImageNet path
-    — reference DataSet.SeqFileFolder streams Hadoop SequenceFiles; here we
-    stream the files themselves)."""
+def ImageFolderDataSet(root: str, batch_size: int,
+                       size: tuple[int, int] = (224, 224),
+                       train: bool = False,
+                       mean: Optional[Sequence[float]] = None,
+                       std: Optional[Sequence[float]] = None,
+                       seed: int = 0, n_threads: int = 8,
+                       drop_remainder: bool = True, **kw):
+    """Lazy batched image-folder dataset, streaming from disk (the ImageNet
+    path — reference DataSet.SeqFileFolder streams Hadoop SequenceFiles).
 
-    def __init__(self, root: str, batch_size: int,
-                 size: tuple[int, int] = (224, 224), train: bool = False,
-                 mean: Optional[Sequence[float]] = None,
-                 std: Optional[Sequence[float]] = None,
-                 seed: int = 0, n_threads: int = 8,
-                 drop_remainder: bool = True):
-        self.paths, self.labels, self.classes = list_image_folder(root)
-        self.batch_size = batch_size
-        self.img_size = size
-        self.train = train
-        self._rng = np.random.RandomState(seed)
-        self.n_threads = n_threads
-        self.drop_remainder = drop_remainder
-        c = 3
-        self.mean = (np.asarray(mean, np.float32) if mean is not None
-                     else np.zeros(c, np.float32))
-        self.std = (np.asarray(std, np.float32) if std is not None
-                    else np.ones(c, np.float32))
+    Backed by :class:`bigdl_tpu.dataset.streaming.StreamingImageFolder`:
+    ``train=True`` gets **per-sample** random crop + horizontal flip inside
+    the multithreaded decode pool (reference MTLabeledBGRImgToBatch
+    semantics); eval decodes scale-to-fill + center crop. Extra keyword
+    arguments (``short_side``, ``augment``, ``window``, ``hflip``) pass
+    through to the streaming pipeline.
+    """
+    from bigdl_tpu.dataset.streaming import StreamingImageFolder
 
-    def __iter__(self) -> Iterator[MiniBatch]:
-        n = len(self.paths)
-        order = np.arange(n)
-        if self.train:
-            self._rng.shuffle(order)
-        end = (n - self.batch_size + 1) if self.drop_remainder else n
-        with ThreadPoolExecutor(max_workers=self.n_threads) as ex:
-            for i in range(0, max(end, 0), self.batch_size):
-                idx = order[i:i + self.batch_size]
-                imgs = list(ex.map(
-                    lambda j: _decode(self.paths[j], self.img_size), idx))
-                x = (np.stack(imgs).astype(np.float32) - self.mean) / self.std
-                if self.train and self._rng.rand() < 0.5:
-                    x = x[:, :, ::-1, :].copy()  # batch hflip augment
-                yield MiniBatch(x, self.labels[idx])
-
-    def size(self) -> int:
-        return len(self.paths)
-
-    def shuffle(self, seed=None):
-        if seed is not None:
-            self._rng = np.random.RandomState(seed)
+    return StreamingImageFolder(
+        root, batch_size, crop=tuple(size), train=train, mean=mean,
+        std=std, seed=seed, n_threads=n_threads,
+        drop_remainder=drop_remainder, **kw)

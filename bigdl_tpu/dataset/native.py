@@ -76,6 +76,12 @@ def _load() -> Optional[ctypes.CDLL]:
                                     ctypes.c_void_p, ctypes.c_int64]
     lib.bt_free.restype = None
     lib.bt_free.argtypes = [ctypes.c_void_p]
+    lib.bt_augment_sample.restype = ctypes.c_int
+    lib.bt_augment_sample.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ctypes.c_void_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ctypes.c_int, ctypes.c_int, ctypes.c_void_p, ctypes.c_void_p,
+    ]
     _lib = lib
     return _lib
 
@@ -83,6 +89,28 @@ def _load() -> Optional[ctypes.CDLL]:
 def available() -> bool:
     """True when the native library is loadable (builds it if needed)."""
     return _load() is not None
+
+
+def augment_sample_native(img: np.ndarray, out: np.ndarray, off_h: int,
+                          off_w: int, flip: bool, mean: np.ndarray,
+                          std: np.ndarray) -> None:
+    """One-pass crop+flip+normalize (C ``bt_augment_sample``; GIL released
+    during the call, so the streaming decode pool scales across cores).
+    ``img``: contiguous uint8 (H, W, C); ``out``: float32 (ch, cw, C)."""
+    lib = _load()
+    mean = np.ascontiguousarray(mean, np.float32)
+    std = np.ascontiguousarray(std, np.float32)
+    ok = lib.bt_augment_sample(
+        img.ctypes.data_as(ctypes.c_void_p), img.shape[0], img.shape[1],
+        img.shape[2], out.ctypes.data_as(ctypes.c_void_p), out.shape[0],
+        out.shape[1], off_h, off_w, int(flip),
+        mean.ctypes.data_as(ctypes.c_void_p),
+        std.ctypes.data_as(ctypes.c_void_p))
+    if not ok:
+        raise ValueError(
+            f"crop {out.shape[:2]} at offset ({off_h}, {off_w}) falls "
+            f"outside source image {img.shape[:2]} — is short_side "
+            f"smaller than the crop?")
 
 
 class NativePrefetchDataSet(DataSet):

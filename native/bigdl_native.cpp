@@ -90,6 +90,27 @@ struct Pipeline {
     }
 };
 
+// Core pixel loop shared by the in-memory pipeline and the streaming
+// per-sample augment: crop at (off_h, off_w) from a (src_h, src_w, c)
+// uint8 HWC source, optional horizontal flip, per-channel (x - mean)/std
+// normalize into float HWC dst of (ch, cw, c).
+void augment_core(const uint8_t* src, int src_w, int c, float* dst, int ch,
+                  int cw, int off_h, int off_w, bool flip, const float* mean,
+                  const float* stdev) {
+    for (int y = 0; y < ch; ++y) {
+        const uint8_t* row =
+            src + ((int64_t)(y + off_h) * src_w + off_w) * c;
+        float* out_row = dst + (int64_t)y * cw * c;
+        for (int x = 0; x < cw; ++x) {
+            int sx = flip ? (cw - 1 - x) : x;
+            const uint8_t* px = row + (int64_t)sx * c;
+            float* out = out_row + (int64_t)x * c;
+            for (int k = 0; k < c; ++k)
+                out[k] = ((float)px[k] - mean[k]) / stdev[k];
+        }
+    }
+}
+
 // Fill one sample slot: crop (random or center), optional horizontal flip,
 // per-channel (x - mean) / std normalization, uint8 HWC -> float HWC.
 void fill_sample(const Pipeline* p, const uint8_t* src, float* dst,
@@ -111,19 +132,8 @@ void fill_sample(const Pipeline* p, const uint8_t* src, float* dst,
         std::uniform_int_distribution<int> d(0, 1);
         flip = d(rng) == 1;
     }
-    const float* mean = p->mean.data();
-    const float* stdev = p->stdev.data();
-    for (int y = 0; y < ch; ++y) {
-        const uint8_t* row = src + ((int64_t)(y + off_h) * p->w + off_w) * c;
-        float* out_row = dst + (int64_t)y * cw * c;
-        for (int x = 0; x < cw; ++x) {
-            int sx = flip ? (cw - 1 - x) : x;
-            const uint8_t* px = row + (int64_t)sx * c;
-            float* out = out_row + (int64_t)x * c;
-            for (int k = 0; k < c; ++k)
-                out[k] = ((float)px[k] - mean[k]) / stdev[k];
-        }
-    }
+    augment_core(src, p->w, c, dst, ch, cw, off_h, off_w, flip,
+                 p->mean.data(), p->stdev.data());
 }
 
 void worker_main(Pipeline* p) {
@@ -254,6 +264,28 @@ void bt_pipeline_destroy(void* h) {
     p->cv_ready.notify_all();
     for (auto& t : p->workers) t.join();
     delete p;
+}
+
+// Streaming per-sample augment (the pixel half of the reference's
+// MTLabeledBGRImgToBatch worker, image/MTLabeledBGRImgToBatch.scala:48-133):
+// python worker threads decode JPEG via libjpeg (GIL released), then call
+// this (GIL released by ctypes) for crop+flip+normalize — so the whole
+// per-sample path runs parallel across the decode pool. Offsets/flip are
+// chosen by the caller (per-sample seeded RNG lives host-side for
+// reproducibility).
+// Returns 1 on success, 0 when the crop window falls outside the source
+// (caller must raise — silently leaving dst uninitialized would feed
+// garbage batches to training).
+int bt_augment_sample(const uint8_t* src, int src_h, int src_w, int c,
+                      float* dst, int crop_h, int crop_w, int off_h,
+                      int off_w, int flip, const float* mean,
+                      const float* stdev) {
+    if (!src || !dst || off_h < 0 || off_w < 0 || crop_h + off_h > src_h ||
+        crop_w + off_w > src_w)
+        return 0;
+    augment_core(src, src_w, c, dst, crop_h, crop_w, off_h, off_w,
+                 flip != 0, mean, stdev);
+    return 1;
 }
 
 // ---------------------------------------------------------------- readers
