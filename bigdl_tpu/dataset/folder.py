@@ -48,22 +48,26 @@ def list_image_folder(root: str) -> tuple[list[str], np.ndarray, list[str]]:
 
 
 def _decode(path: str, size: Optional[tuple[int, int]]) -> np.ndarray:
-    from PIL import Image
+    """Scale-to-fill + center crop, the standard eval transform (reference
+    BGRImage.readImage). The resize convention lives in ONE place —
+    streaming.decode_resize — so eval/predict numerics can't drift from
+    the training pipeline's."""
+    from bigdl_tpu.dataset.streaming import decode_resize
 
-    with Image.open(path) as im:
-        im = im.convert("RGB")
-        if size is not None:
-            # scale shorter side to max(size) then center-crop, the standard
-            # eval transform (reference BGRImage.readImage scales to
-            # scaleTo on the shorter side)
-            th, tw = size
-            scale = max(th / im.height, tw / im.width)
-            im = im.resize((max(tw, int(round(im.width * scale))),
-                            max(th, int(round(im.height * scale)))))
-            left = (im.width - tw) // 2
-            top = (im.height - th) // 2
-            im = im.crop((left, top, left + tw, top + th))
-        return np.asarray(im, dtype=np.uint8)
+    with open(path, "rb") as f:
+        raw = f.read()
+    if size is None:
+        import io
+
+        from PIL import Image
+
+        with Image.open(io.BytesIO(raw)) as im:
+            return np.asarray(im.convert("RGB"), dtype=np.uint8)
+    img = decode_resize(raw, short_side=None, fill=size)
+    th, tw = size
+    top = (img.shape[0] - th) // 2
+    left = (img.shape[1] - tw) // 2
+    return img[top:top + th, left:left + tw]
 
 
 def load_image_folder(root: str, size: tuple[int, int] = (224, 224),

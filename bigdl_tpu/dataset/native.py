@@ -19,6 +19,7 @@ from __future__ import annotations
 import ctypes
 import os
 import subprocess
+import threading
 from typing import Optional, Sequence
 
 import numpy as np
@@ -33,24 +34,38 @@ _LIB_PATH = os.path.join(_NATIVE_DIR, "libbigdl_native.so")
 
 _lib: Optional[ctypes.CDLL] = None
 _load_attempted = False
+_load_lock = threading.Lock()  # streaming workers probe concurrently
 
 
 def _load() -> Optional[ctypes.CDLL]:
     global _lib, _load_attempted
-    if _load_attempted:
+    with _load_lock:
+        if _load_attempted:
+            return _lib
+        _load_attempted = True
+        _lib = _load_impl()
         return _lib
-    _load_attempted = True
-    if not os.path.exists(_LIB_PATH):
-        try:  # build on first use (g++ is part of the toolchain)
-            subprocess.run(["make", "-C", _NATIVE_DIR], check=True,
-                           capture_output=True, timeout=120)
-        except Exception:
+
+
+def _load_impl() -> Optional[ctypes.CDLL]:
+    try:  # always run make: incremental, and rebuilds a stale .so whose
+        # symbols predate the current bindings (g++ is in the toolchain)
+        subprocess.run(["make", "-C", _NATIVE_DIR], check=True,
+                       capture_output=True, timeout=120)
+    except Exception:
+        if not os.path.exists(_LIB_PATH):
             return None
     try:
         lib = ctypes.CDLL(_LIB_PATH)
-    except OSError:
+        _bind(lib)
+    except (OSError, AttributeError):
+        # AttributeError: prebuilt .so missing a newer symbol — fall back
+        # to the pure-python paths rather than crashing available()
         return None
+    return lib
 
+
+def _bind(lib: ctypes.CDLL) -> None:
     lib.bt_pipeline_create.restype = ctypes.c_void_p
     lib.bt_pipeline_create.argtypes = [
         ctypes.c_void_p, ctypes.c_int64, ctypes.c_int, ctypes.c_int,
@@ -82,8 +97,6 @@ def _load() -> Optional[ctypes.CDLL]:
         ctypes.c_void_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
         ctypes.c_int, ctypes.c_int, ctypes.c_void_p, ctypes.c_void_p,
     ]
-    _lib = lib
-    return _lib
 
 
 def available() -> bool:

@@ -82,6 +82,12 @@ def augment_sample(img: np.ndarray, crop: tuple[int, int],
     per-channel normalize. One C pass when the native lib is loadable."""
     ch, cw = crop
     h, w = img.shape[:2]
+    if h < ch or w < cw:
+        # validate before either backend: the C path would reject this and
+        # the numpy path would silently mis-crop
+        raise ValueError(
+            f"crop {crop} larger than decoded image ({h}, {w}) — is "
+            f"short_side smaller than the crop?")
     if rng is not None:
         off_h = rng.randint(0, h - ch + 1) if h > ch else 0
         off_w = rng.randint(0, w - cw + 1) if w > cw else 0
@@ -172,6 +178,9 @@ class _StreamingImageBase(DataSet):
         else:
             order = np.arange(n)
         n_batches = n // bs if self.drop_remainder else -(-n // bs)
+        cap = getattr(self, "_batch_cap", None)
+        if cap is not None:
+            n_batches = min(n_batches, cap(bs))
         with ThreadPoolExecutor(max_workers=self.n_threads) as ex:
             pending: deque = deque()
 
@@ -229,27 +238,43 @@ class RecordImageDataSet(_StreamingImageBase):
     ``shards``: directory, glob, or explicit list. ``shard=(i, k)``
     restricts to shard files ``i::k`` — per-host partitioning for
     multi-process training (the locality feeding that replaces
-    ZippedPartitionsWithLocalityRDD).
+    ZippedPartitionsWithLocalityRDD). Partitioned datasets cap their
+    batch count at the SMALLEST partition's so every host steps the same
+    number of times (unequal counts would deadlock the first collective
+    after the shortest host stops — same guarantee as ShardedDataSet).
     """
 
     def __init__(self, shards, batch_size: int,
                  shard: Optional[tuple[int, int]] = None, **kw):
-        files = (list(shards) if isinstance(shards, (list, tuple))
-                 else rf.list_shards(shards))
+        all_files = (list(shards) if isinstance(shards, (list, tuple))
+                     else rf.list_shards(shards))
+        if not all_files:
+            raise FileNotFoundError(f"no record shards under {shards!r}")
+        counts = dict(zip(all_files, self._count_records(all_files)))
+        files = all_files
         if shard is not None:
             i, k = shard
-            files = files[i::k]
-        if not files:
-            raise FileNotFoundError(f"no record shards under {shards!r}")
+            files = all_files[i::k]
+            # every host sees the full shard list, so each can compute the
+            # global minimum partition size without communicating
+            min_part = min(sum(counts[p] for p in all_files[j::k])
+                           for j in range(k))
+            self._batch_cap = lambda bs: max(min_part // bs, 0)
         self.shard_files = files
-        counts = []
-        for p in files:
-            with rf.RecordReader(p) as r:
-                counts.append(len(r))
         # global sample id j -> (shard, record) via cumulative counts
-        self._cum = np.cumsum([0] + counts)
+        self._cum = np.cumsum([0] + [counts[p] for p in files])
         self._tls = threading.local()  # per-thread reader handles
         super().__init__(batch_size, **kw)
+
+    @staticmethod
+    def _count_records(files: list) -> list:
+        """Parallel index reads — thousands of shards on network storage
+        would otherwise serialize open+seek round-trips at startup."""
+        def count(p):
+            with rf.RecordReader(p) as r:
+                return len(r)
+        with ThreadPoolExecutor(max_workers=min(16, len(files))) as ex:
+            return list(ex.map(count, files))
 
     def _reader(self, s: int) -> rf.RecordReader:
         cache = getattr(self._tls, "readers", None)

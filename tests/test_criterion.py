@@ -176,3 +176,35 @@ def test_grad_through_criterion():
     tx = torch.from_numpy(LOGITS).requires_grad_(True)
     F.cross_entropy(tx, torch.from_numpy(LABELS)).backward()
     np.testing.assert_allclose(g, tx.grad.numpy(), atol=1e-5)
+
+
+def test_l1_hinge_embedding():
+    """L1HingeEmbedding composed from torch primitives (no direct torch
+    functional): d = ||x1-x2||_1; y=1 -> d, y=-1 -> max(0, margin-d)."""
+    from bigdl_tpu import nn as bnn
+
+    x1 = R.randn(B, 6).astype(np.float32)
+    x2 = R.randn(B, 6).astype(np.float32)
+    y = np.where(R.rand(B) > 0.5, 1, -1).astype(np.float32)
+    ours = float(bnn.L1HingeEmbeddingCriterion(margin=0.7)(
+        (jnp.asarray(x1), jnp.asarray(x2)), jnp.asarray(y)))
+    d = torch.abs(torch.from_numpy(x1) - torch.from_numpy(x2)).sum(-1)
+    yt = torch.from_numpy(y)
+    per = torch.where(yt > 0, d, torch.clamp(0.7 - d, min=0.0))
+    np.testing.assert_allclose(ours, float(per.mean()), rtol=1e-5)
+
+
+def test_time_distributed_vs_looped_torch():
+    """TimeDistributed(ClassNLL) over (B, T, C) == mean of torch nll over
+    the flattened time steps."""
+    from bigdl_tpu import nn as bnn
+
+    T_ = 5
+    logits = R.randn(B, T_, C).astype(np.float32)
+    labels = R.randint(0, C, (B, T_))
+    logp = torch.log_softmax(torch.from_numpy(logits), -1)
+    ours = float(bnn.TimeDistributedCriterion(bnn.ClassNLLCriterion())(
+        jnp.asarray(np.asarray(logp)), jnp.asarray(labels)))
+    theirs = torch.nn.functional.nll_loss(
+        logp.reshape(-1, C), torch.from_numpy(labels).reshape(-1))
+    np.testing.assert_allclose(ours, float(theirs), rtol=1e-5)

@@ -104,6 +104,11 @@ def test_record_dataset_host_shard_partition(tmp_path, image_root):
     b = RecordImageDataSet(out, batch_size=2, shard=(1, 2))
     assert a.size() + b.size() == 15
     assert set(a.shard_files).isdisjoint(b.shard_files)
+    # shards are 4/4/4/3 -> partitions 8 and 7 samples; both hosts must
+    # step the SAME number of batches (min partition // bs = 3) or
+    # multi-host SPMD deadlocks at the first collective after the shorter
+    # host stops
+    assert len(list(a)) == len(list(b)) == 3
 
 
 # ------------------------------------------------- per-sample augmentation
