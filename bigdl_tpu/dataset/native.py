@@ -49,9 +49,19 @@ def _load() -> Optional[ctypes.CDLL]:
 
 def _load_impl() -> Optional[ctypes.CDLL]:
     try:  # always run make: incremental, and rebuilds a stale .so whose
-        # symbols predate the current bindings (g++ is in the toolchain)
-        subprocess.run(["make", "-C", _NATIVE_DIR], check=True,
-                       capture_output=True, timeout=120)
+        # symbols predate the current bindings (g++ is in the toolchain).
+        # flock serializes concurrent builds across PROCESSES sharing the
+        # filesystem (multi-host runs) — dlopen of a half-linked .so is
+        # undefined behavior
+        import fcntl
+
+        with open(os.path.join(_NATIVE_DIR, ".build.lock"), "w") as lk:
+            fcntl.flock(lk, fcntl.LOCK_EX)
+            try:
+                subprocess.run(["make", "-C", _NATIVE_DIR], check=True,
+                               capture_output=True, timeout=120)
+            finally:
+                fcntl.flock(lk, fcntl.LOCK_UN)
     except Exception:
         if not os.path.exists(_LIB_PATH):
             return None

@@ -142,10 +142,10 @@ class Optimizer:
     def resume(self, checkpoint_dir: str) -> "Optimizer":
         """Load the newest model.<n>/state.<n> pair from a directory
         (either single-blob or orbax-sharded snapshots)."""
-        from bigdl_tpu.utils.file import latest_checkpoint
+        from bigdl_tpu.utils.file import isdir, latest_checkpoint
         m = latest_checkpoint(checkpoint_dir, "model.")
         s = latest_checkpoint(checkpoint_dir, "state.")
-        if m and os.path.isdir(m):  # orbax checkpoints are directories
+        if m and isdir(m):  # orbax checkpoints are directories
             from bigdl_tpu.utils.orbax_ckpt import restore_sharded
             blob = restore_sharded(m)
             self._init_params = blob["params"]

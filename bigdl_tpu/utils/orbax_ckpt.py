@@ -35,19 +35,36 @@ def save_sharded(tree: Any, path: str, overwrite: bool = False) -> None:
     barriers on both sides, so hosts never race on the shared directory."""
     import orbax.checkpoint as ocp
 
-    path = os.path.abspath(path)
+    from bigdl_tpu.utils.file import is_remote
+
+    # gs://... stays a URI (orbax handles object stores via etils.epath);
+    # only local paths are absolutized
+    path = path if is_remote(path) else os.path.abspath(path)
 
     def barrier(tag):
         if jax.process_count() > 1:
             from jax.experimental import multihost_utils
             multihost_utils.sync_global_devices(tag)
 
+    def exists(p):
+        if is_remote(p):
+            from etils import epath
+            return epath.Path(p).exists()
+        return os.path.exists(p)
+
+    def remove(p):
+        if is_remote(p):
+            from etils import epath
+            epath.Path(p).rmtree()
+        else:
+            import shutil
+            shutil.rmtree(p)
+
     barrier(f"ckpt-pre:{path}")
-    if jax.process_index() == 0 and os.path.exists(path):
+    if jax.process_index() == 0 and exists(path):
         if not overwrite:
             raise FileExistsError(path)
-        import shutil
-        shutil.rmtree(path)
+        remove(path)
     barrier(f"ckpt-clean:{path}")
     with ocp.Checkpointer(ocp.StandardCheckpointHandler()) as ckptr:
         ckptr.save(path, tree)
@@ -59,7 +76,9 @@ def restore_sharded(path: str, like: Optional[Any] = None) -> Any:
     placed training state to resume without a host gather."""
     import orbax.checkpoint as ocp
 
-    path = os.path.abspath(path)
+    from bigdl_tpu.utils.file import is_remote
+
+    path = path if is_remote(path) else os.path.abspath(path)
     with ocp.Checkpointer(ocp.StandardCheckpointHandler()) as ckptr:
         if like is None:
             return ckptr.restore(path)

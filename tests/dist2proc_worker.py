@@ -1,0 +1,78 @@
+"""Worker process for tests/test_distributed_2proc.py (run via
+subprocess): real 2-process jax.distributed DP training on CPU devices
+with gloo collectives — the analog of the reference testing its
+distributed optimizer on local-mode Spark (DistriOptimizerSpec.scala:36-38,
+multi-node-on-one-host).
+"""
+
+import json
+import sys
+
+
+def main() -> None:
+    pid, nproc = int(sys.argv[1]), int(sys.argv[2])
+    port, out_path, ckpt_dir = sys.argv[3], sys.argv[4], sys.argv[5]
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+
+    from bigdl_tpu.parallel import init_distributed
+
+    init_distributed(f"localhost:{port}", nproc, pid)
+    assert jax.process_count() == nproc
+
+    import numpy as np
+
+    from bigdl_tpu import nn
+    from bigdl_tpu.core import Sequential
+    from bigdl_tpu.dataset import ShardedDataSet, host_shard
+    from bigdl_tpu.optim import Optimizer, SGD, Trigger
+    from bigdl_tpu.parallel import DataParallel, make_mesh
+    from bigdl_tpu.utils.orbax_ckpt import restore_sharded
+
+    # every host holds the full arrays; ShardedDataSet hands each its
+    # disjoint slice of every global batch
+    rs = np.random.RandomState(0)
+    x = rs.rand(64, 8).astype(np.float32) * 2 - 1
+    y = rs.randint(0, 4, 64).astype(np.int32)
+
+    # host_shard: the file-partitioning path for can't-fit-in-one-host data
+    sl = host_shard(len(x))
+    assert (sl.stop - sl.start) == len(x) // nproc
+
+    model = Sequential(nn.Linear(8, 16), nn.Tanh(), nn.Linear(16, 4),
+                       nn.LogSoftMax())
+    ds = ShardedDataSet(x, y, global_batch_size=16, shuffle=True)
+    mesh = make_mesh({"data": jax.device_count()})
+    strat = DataParallel(mesh)  # shard_batch goes through
+    # make_array_from_process_local_data because process_count() > 1
+
+    opt = Optimizer(model, ds, nn.ClassNLLCriterion(),
+                    optim_method=SGD(learning_rate=0.5, momentum=0.9),
+                    end_when=Trigger.max_iteration(3), strategy=strat,
+                    seed=7)
+    opt.set_checkpoint(Trigger.several_iteration(3), ckpt_dir,
+                       overwrite=True, sharded=True)
+    trained = opt.optimize()
+
+    params = jax.device_get(trained.params)
+    leaves = jax.tree_util.tree_leaves(params)
+    digest = float(sum(np.abs(l).sum() for l in leaves))
+
+    # restore the orbax-sharded snapshot back onto the placed shardings
+    blob = restore_sharded(f"{ckpt_dir}/model.3", like=None)
+    r_leaves = jax.tree_util.tree_leaves(blob["params"])
+    restore_ok = all(
+        np.allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+        for a, b in zip(r_leaves, leaves))
+
+    with open(out_path, "w") as f:
+        json.dump({"pid": pid, "digest": digest,
+                   "restore_ok": bool(restore_ok),
+                   "devices": jax.device_count()}, f)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,57 @@
+"""2-process jax.distributed training test (reference: distributed logic
+verified for real on local-mode Spark, DistriOptimizerSpec.scala:36-38 —
+here: two OS processes x 4 virtual CPU devices each, gloo collectives,
+ShardedDataSet + make_array_from_process_local_data + orbax sharded
+checkpoint save/restore across both).
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+_WORKER = os.path.join(os.path.dirname(__file__), "dist2proc_worker.py")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_dp_training(tmp_path):
+    port = _free_port()
+    ckpt = str(tmp_path / "ckpt")
+    repo_root = os.path.dirname(os.path.dirname(_WORKER))
+    env = dict(os.environ,
+               XLA_FLAGS="--xla_force_host_platform_device_count=4",
+               PYTHONPATH=repo_root)
+    env.pop("JAX_PLATFORMS", None)  # worker sets platform via jax.config
+    procs, outs = [], []
+    for pid in range(2):
+        out = str(tmp_path / f"result{pid}.json")
+        outs.append(out)
+        procs.append(subprocess.Popen(
+            [sys.executable, _WORKER, str(pid), "2", str(port), out, ckpt],
+            env=env, cwd=os.path.dirname(os.path.dirname(_WORKER)),
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
+    logs = []
+    for p in procs:
+        try:
+            stdout, _ = p.communicate(timeout=240)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail("distributed worker timed out (collective hang?)")
+        logs.append(stdout)
+    for p, log in zip(procs, logs):
+        assert p.returncode == 0, f"worker failed:\n{log[-3000:]}"
+
+    results = [json.load(open(o)) for o in outs]
+    assert all(r["devices"] == 8 for r in results)  # 2 procs x 4 devices
+    assert all(r["restore_ok"] for r in results), results
+    # replicated params must be identical on both hosts after 3 sync steps
+    assert abs(results[0]["digest"] - results[1]["digest"]) < 1e-5, results

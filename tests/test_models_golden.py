@@ -157,7 +157,11 @@ class TBottleneck(tnn.Module):
         return torch.relu(self.main(x) + self.short(x))
 
 
-def torch_resnet(depth, n_cls, layers=(3, 4, 6, 3)):
+_T_LAYERS = {50: (3, 4, 6, 3), 101: (3, 4, 23, 3), 152: (3, 8, 36, 3)}
+
+
+def torch_resnet(depth, n_cls):
+    layers = _T_LAYERS[depth]
     mods = [tnn.Conv2d(3, 64, 7, 2, 3, bias=False), tnn.BatchNorm2d(64),
             tnn.ReLU(), tnn.MaxPool2d(3, 2, 1)]
     cin = 64
