@@ -1,9 +1,12 @@
 """Benchmark entry point — prints ONE JSON line for the driver, always.
 
 Measures sync-SGD training throughput (fwd+bwd+update — the reference's
-"records/second" metric, DistriOptimizer.scala:241-244) plus MFU from the
-compiled step's HLO FLOPs, on ResNet-50 — the BASELINE.json north-star
-config. The harness itself is bigdl_tpu.cli.perf (the DistriOptimizerPerf
+"records/second" metric, DistriOptimizer.scala:241-244) plus MFU, on
+ResNet-50 — the BASELINE.json north-star config. The MFU numerator is an
+analytic matmul+conv FLOPs count from the train-step jaxpr
+(bigdl_tpu/utils/flops.py), cross-checked against XLA cost_analysis; the
+``mfu_basis``/``peak_flops_device_match`` fields say exactly which
+numerator and peak were used. The harness itself is bigdl_tpu.cli.perf (the DistriOptimizerPerf
 analog, dl/.../models/utils/DistriOptimizerPerf.scala:35-150); this file is
 the crash-proof driver wrapper.
 
@@ -116,6 +119,12 @@ def main() -> None:
                        f"_{result['dtype']}"),
             "value": result["images_per_second_per_chip"],
             "mfu": result.get("mfu"),
+            "mfu_pct": result.get("mfu_pct"),
+            "mfu_basis": result.get("mfu_basis"),
+            "peak_flops_assumed": result.get("peak_flops_assumed"),
+            "peak_flops_device_match": result.get("peak_flops_device_match"),
+            "step_gflops_analytic": result.get("step_gflops_analytic"),
+            "step_gflops_hlo": result.get("step_gflops_hlo"),
             "backend": result.get("backend", "unknown"),
             "device": result.get("device", "unknown"),
             "records_per_second": result.get("records_per_second"),
@@ -124,6 +133,8 @@ def main() -> None:
         })
         if "tokens_per_second" in result:
             line["tokens_per_second"] = result["tokens_per_second"]
+        if "flops_disagreement" in result:
+            line["flops_disagreement"] = result["flops_disagreement"]
     if errors:
         line["error"] = "; ".join(errors)
     print(json.dumps(line))

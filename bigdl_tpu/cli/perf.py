@@ -14,22 +14,31 @@ import json
 import time
 
 
-_PEAK_FLOPS = {
-    # bf16 peak per chip (public figures); used for the MFU estimate
-    "tpu v4": 275e12,
-    "tpu v5e": 197e12,
-    "tpu v5p": 459e12,
-    "tpu v6e": 918e12,
-    "cpu": 1e12,  # nominal, so MFU stays defined in CPU test runs
-}
+# bf16 peak per chip (public figures); used for the MFU estimate. Matched
+# by substring against the *squashed* (space-stripped, lowered) device_kind,
+# most specific first, so real-world kinds like "TPU v5 lite" (v5e), "TPU
+# v5p slice", "TPU v4 lite" all resolve. Round-2 bug: the old table missed
+# "TPU v5 lite" and fell back silently to the 1e12 nominal, inflating MFU
+# ~197x; the match label is now reported alongside the number so a fallback
+# can never hide again.
+_PEAK_FLOPS = (
+    ("v6lite", 918e12), ("v6e", 918e12), ("trillium", 918e12),
+    ("v5lite", 197e12), ("v5e", 197e12),
+    ("v5p", 459e12),
+    ("v4lite", 138e12), ("v4", 275e12),
+    ("v3", 123e12), ("v2", 46e12),
+    ("cpu", 1e12),  # nominal, so MFU stays defined in CPU test runs
+)
 
 
-def _peak_flops(device) -> float:
-    kind = getattr(device, "device_kind", "cpu").lower()
-    for k, v in _PEAK_FLOPS.items():
-        if k.replace("tpu ", "") in kind.replace(" ", "").lower():
-            return v
-    return _PEAK_FLOPS["cpu"]
+def _peak_flops(device):
+    """Return (peak_bf16_flops, matched_label) for one chip."""
+    kind = getattr(device, "device_kind", "cpu") or "cpu"
+    squashed = kind.replace(" ", "").replace("-", "").lower()
+    for k, v in _PEAK_FLOPS:
+        if k in squashed:
+            return v, k
+    return 1e12, f"UNMATCHED({kind})->1e12-nominal"
 
 
 _LM_VOCAB = 32000  # shared by the model head and the synthetic token data
@@ -131,18 +140,37 @@ def run(model_name: str, batch: int, iterations: int, data_type: str,
         x, y = jnp.asarray(x_host), jnp.asarray(y_host)
 
     k = jax.random.PRNGKey(1)
-    # AOT-compile once; the same Compiled object supplies the FLOPs estimate
-    # for MFU *and* runs the benchmark loop (one XLA compile total)
-    step_flops = 0.0
+    # Two independent FLOPs estimates for the MFU numerator:
+    #  * analytic — walk the train-step jaxpr and sum 2*MAC for every
+    #    dot_general / conv (utils/flops.py); auditable, backend-free;
+    #  * HLO — compiled.cost_analysis()["flops"], XLA's own count.
+    # MFU is reported from the analytic number; both appear in the JSON
+    # and a >2x disagreement is flagged rather than silently trusted.
+    flops_analytic, flops_error = 0.0, None
+    try:
+        from bigdl_tpu.utils.flops import fn_flops
+
+        flops_analytic = fn_flops(train_step, params, mod_state, opt_state,
+                                  x, y, k)
+    except Exception as e:  # record, never hide — the basis field (below)
+        flops_error = f"{type(e).__name__}: {e}"[:200]
+    flops_hlo = 0.0
     try:
         compiled = step.lower(params, mod_state, opt_state, x, y, k).compile()
         cost = compiled.cost_analysis()
         if isinstance(cost, (list, tuple)):  # older jax returns [dict]
             cost = cost[0] if cost else {}
-        step_flops = float(cost.get("flops", 0.0) or 0.0)
+        flops_hlo = float(cost.get("flops", 0.0) or 0.0)
+        # under SPMD cost_analysis reports the per-device partitioned
+        # module; scale to global so both numerators share a basis
+        if strategy is not None:
+            flops_hlo *= len(jax.devices())
         step = compiled
     except Exception:
         pass
+    step_flops = flops_analytic or flops_hlo
+    mfu_basis = ("analytic" if flops_analytic
+                 else ("hlo" if flops_hlo else None))
 
     params, mod_state, opt_state, loss = step(params, mod_state, opt_state,
                                               x, y, k)
@@ -159,7 +187,8 @@ def run(model_name: str, batch: int, iterations: int, data_type: str,
 
     ips = batch * iterations / dt
     n_dev = len(jax.devices()) if strategy is not None else 1
-    peak = _peak_flops(jax.devices()[0]) * n_dev
+    peak_per_chip, peak_label = _peak_flops(jax.devices()[0])
+    peak = peak_per_chip * n_dev
     mfu = (step_flops * iterations / dt) / peak if step_flops else None
     out = {
         "model": model_name,
@@ -170,9 +199,22 @@ def run(model_name: str, batch: int, iterations: int, data_type: str,
         "images_per_second_per_chip": round(ips / n_dev, 2),
         "dtype": str(dtype.__name__ if hasattr(dtype, "__name__")
                      else dtype),
+        # MFU is a FRACTION in [0,1]; mfu_pct is the human-facing percent
         "mfu": round(mfu, 4) if mfu is not None else None,
+        "mfu_pct": round(mfu * 100, 2) if mfu is not None else None,
+        "mfu_basis": mfu_basis,
+        "peak_flops_assumed": peak_per_chip,
+        "peak_flops_device_match": peak_label,
+        "step_gflops_analytic": round(flops_analytic / 1e9, 3),
+        "step_gflops_hlo": round(flops_hlo / 1e9, 3),
         "device": getattr(jax.devices()[0], "device_kind", "unknown"),
     }
+    if flops_error is not None:
+        out["flops_analytic_error"] = flops_error
+    if flops_analytic and flops_hlo:
+        ratio = flops_hlo / flops_analytic
+        if ratio > 2.0 or ratio < 0.5:
+            out["flops_disagreement"] = round(ratio, 3)
     if is_lm:
         out["tokens_per_second"] = round(ips * in_shape[0], 1)
     print(json.dumps(out))

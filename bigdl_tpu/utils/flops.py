@@ -1,0 +1,101 @@
+"""Analytic matmul/conv FLOPs from a traced jaxpr.
+
+The reference trusts its perf harness because the metric is simple and
+auditable (records/second, DistriOptimizerPerf.scala:35-150). Our MFU
+metric needs a FLOPs numerator that is equally auditable: XLA's
+``compiled.cost_analysis()["flops"]`` is backend-dependent and opaque, so
+we count FLOPs ourselves by walking the jaxpr of the (uncompiled) train
+step and summing the two primitives where essentially all deep-learning
+FLOPs live:
+
+* ``dot_general``: 2 x batch x M x N x K
+* ``conv_general_dilated``: 2 x |out| x (C_in/groups) x prod(kernel spatial)
+
+Everything else (elementwise, reductions, layout) is bandwidth-bound on
+TPU and excluded by convention — this is the standard "model FLOPs"
+denominator used for MFU. Control-flow bodies are recursed into
+(``scan`` multiplied by trip count, ``cond`` by the most expensive
+branch); ``remat`` bodies are counted once (algorithmic FLOPs, not
+executed FLOPs, per the usual MFU definition).
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+from jax.extend import core as jex_core
+
+
+def _prod(xs) -> float:
+    out = 1.0
+    for x in xs:
+        out *= float(x)
+    return out
+
+
+def _eqn_flops(eqn) -> float:
+    name = eqn.primitive.name
+    if name == "dot_general":
+        (lc, rc), (lb, _rb) = eqn.params["dimension_numbers"]
+        lhs = eqn.invars[0].aval.shape
+        rhs = eqn.invars[1].aval.shape
+        batch = _prod(lhs[i] for i in lb)
+        contract = _prod(lhs[i] for i in lc)
+        m = _prod(lhs[i] for i in range(len(lhs))
+                  if i not in lb and i not in lc)
+        rb, rcs = set(_rb), set(rc)
+        n = _prod(rhs[i] for i in range(len(rhs))
+                  if i not in rb and i not in rcs)
+        return 2.0 * batch * m * n * contract
+    if name == "conv_general_dilated":
+        out_shape = eqn.outvars[0].aval.shape
+        kernel = eqn.invars[1].aval.shape
+        dn = eqn.params["dimension_numbers"]
+        k_spatial = _prod(kernel[d] for d in dn.rhs_spec[2:])
+        cin_per_group = float(kernel[dn.rhs_spec[1]])
+        return 2.0 * _prod(out_shape) * cin_per_group * k_spatial
+    return 0.0
+
+
+def _sub_jaxprs(params):
+    for v in params.values():
+        if isinstance(v, jex_core.ClosedJaxpr):
+            yield v.jaxpr
+        elif isinstance(v, jex_core.Jaxpr):
+            yield v
+        elif isinstance(v, (tuple, list)):
+            for w in v:
+                if isinstance(w, jex_core.ClosedJaxpr):
+                    yield w.jaxpr
+                elif isinstance(w, jex_core.Jaxpr):
+                    yield w
+
+
+def jaxpr_flops(jaxpr) -> float:
+    """Total matmul+conv FLOPs of one evaluation of ``jaxpr``."""
+    if isinstance(jaxpr, jex_core.ClosedJaxpr):
+        jaxpr = jaxpr.jaxpr
+    total = 0.0
+    for eqn in jaxpr.eqns:
+        total += _eqn_flops(eqn)
+        name = eqn.primitive.name
+        if name == "cond":
+            total += max((jaxpr_flops(b) for b in eqn.params["branches"]),
+                         default=0.0)
+            continue
+        mult = 1.0
+        if name == "scan":
+            mult = float(eqn.params.get("length", 1))
+        elif name == "while":
+            # trip count is dynamic; count the body once (lower bound)
+            mult = 1.0
+        for sub in _sub_jaxprs(eqn.params):
+            total += mult * jaxpr_flops(sub)
+    return total
+
+
+def fn_flops(fn, *args, **kwargs) -> float:
+    """Matmul+conv FLOPs of ``fn(*args, **kwargs)`` via abstract tracing."""
+    closed = jax.make_jaxpr(fn)(*args, **kwargs)
+    return jaxpr_flops(closed)

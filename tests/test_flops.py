@@ -1,0 +1,101 @@
+"""Analytic FLOPs counter (utils/flops.py) — the MFU numerator must be
+auditable, so its counting rules are pinned here against hand-derived
+values (reference intent: DistriOptimizerPerf.scala's records/second is
+trustworthy because it is trivially auditable; our MFU needs the same)."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from bigdl_tpu.utils.flops import fn_flops
+
+
+def test_matmul_2mnk():
+    M, N, K = 64, 32, 128
+    f = fn_flops(lambda a, b: a @ b, jnp.zeros((M, K)), jnp.zeros((K, N)))
+    assert f == 2 * M * N * K
+
+
+def test_batched_dot_general():
+    B, M, N, K = 4, 8, 16, 32
+    f = fn_flops(jnp.matmul, jnp.zeros((B, M, K)), jnp.zeros((B, K, N)))
+    assert f == 2 * B * M * N * K
+
+
+def test_conv_nhwc():
+    x = jnp.zeros((8, 16, 16, 3))
+    w = jnp.zeros((3, 3, 3, 32))
+
+    def conv(x, w):
+        return jax.lax.conv_general_dilated(
+            x, w, (1, 1), "SAME", dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+    assert fn_flops(conv, x, w) == 2 * 8 * 16 * 16 * 32 * 3 * 9
+
+
+def test_grouped_conv_counts_per_group_channels():
+    # depthwise: groups == C, so C_in/groups == 1
+    x = jnp.zeros((2, 8, 8, 16))
+    w = jnp.zeros((3, 3, 1, 16))
+
+    def conv(x, w):
+        return jax.lax.conv_general_dilated(
+            x, w, (1, 1), "SAME", feature_group_count=16,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+    assert fn_flops(conv, x, w) == 2 * 2 * 8 * 8 * 16 * 1 * 9
+
+
+def test_grad_adds_backward_matmuls():
+    M, N, K = 16, 8, 32
+
+    def loss(w, x):
+        return jnp.sum((x @ w) ** 2)
+
+    fwd = fn_flops(lambda w, x: x @ w, jnp.zeros((K, N)), jnp.zeros((M, K)))
+    grad = fn_flops(jax.grad(loss), jnp.zeros((K, N)), jnp.zeros((M, K)))
+    # grad wrt w only: fwd matmul + dw matmul
+    assert grad == 2 * fwd
+
+
+def test_scan_multiplies_by_length():
+    def body(c, x):
+        return c @ x, ()
+
+    def scanned(c, xs):
+        return jax.lax.scan(body, c, xs)[0]
+
+    f = fn_flops(scanned, jnp.zeros((32, 32)), jnp.zeros((10, 32, 32)))
+    assert f == 10 * 2 * 32 ** 3
+
+
+def test_cond_takes_max_branch():
+    def f(pred, a):
+        return jax.lax.cond(pred, lambda a: a @ a @ a, lambda a: a @ a, a)
+
+    one = fn_flops(lambda a: a @ a, jnp.zeros((16, 16)))
+    both = fn_flops(f, jnp.array(True), jnp.zeros((16, 16)))
+    assert both == 2 * one  # max branch has two matmuls, not three
+
+
+def test_resnet50_in_expected_range():
+    # the auditable cross-check from VERDICT round 2: ResNet-50 fwd @224
+    # is ~4.1 GMACs/image => ~8.2 GF fwd, 20-30 GF per training image
+    from bigdl_tpu import models, nn
+    model = models.resnet50(1000)
+    params = model.init(jax.random.PRNGKey(0))
+    state = model.init_state()
+    x = jnp.zeros((2, 224, 224, 3))
+    y = jnp.zeros((2,), jnp.int32)
+    crit = nn.ClassNLLCriterion()
+
+    def train_loss(p, s, x, y):
+        def loss_fn(p):
+            out, ms = model.apply(p, s, x, training=True,
+                                  rng=jax.random.PRNGKey(0))
+            return crit(out, y), ms
+        (_, _), grads = jax.value_and_grad(loss_fn, has_aux=True)(p)
+        return grads
+
+    per_image = fn_flops(train_loss, params, state, x, y) / 2
+    assert 20e9 < per_image < 32e9, per_image
