@@ -32,7 +32,8 @@ from bigdl_tpu.optim.method import OptimMethod, SGD
 from bigdl_tpu.optim.metrics import Metrics
 from bigdl_tpu.optim.triggers import Trigger
 from bigdl_tpu.optim.validation import ValidationMethod
-from bigdl_tpu.utils.file import save_pytree, load_pytree
+from bigdl_tpu.utils.file import (save_pytree, load_pytree,
+                                  exists as file_exists)
 
 logger = logging.getLogger("bigdl_tpu")
 
@@ -363,7 +364,7 @@ class Optimizer:
         n = driver["iteration"]
         target = os.path.join(self._ckpt_path, f"model.{n}")
         overwrite = getattr(self, "_ckpt_overwrite", False)
-        if os.path.exists(target) and not overwrite:
+        if file_exists(target) and not overwrite:
             raise FileExistsError(
                 f"{target} exists; pass overwrite=True to set_checkpoint "
                 f"(--overWriteCheckpoint) to clobber it")

@@ -26,12 +26,22 @@ import jax
 import numpy as np
 
 __all__ = ["save_pytree", "load_pytree", "latest_checkpoint", "is_remote",
-           "isdir"]
+           "isdir", "exists"]
 
 
 def is_remote(path: str) -> bool:
     """True for scheme-prefixed (fsspec) paths like gs://bucket/dir."""
     return "://" in path
+
+
+def exists(path: str) -> bool:
+    """Existence test that works on local paths and fsspec URIs (the
+    checkpoint overwrite guard must hold on ``gs://`` pod paths too —
+    reference File.scala:63-116 routes everything through one FS API)."""
+    if is_remote(path):
+        fs, p = _fs_for(path)
+        return fs.exists(p)
+    return os.path.exists(path)
 
 
 def isdir(path: str) -> bool:

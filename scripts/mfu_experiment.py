@@ -1,0 +1,79 @@
+"""One-off MFU experiment driver for PERF.md: variants x batch sizes.
+
+Usage: python scripts/mfu_experiment.py [variant] [batch]
+variant in {f32params, bf16params}; prints one JSON line per run.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bigdl_tpu import models, nn
+from bigdl_tpu.optim import SGD
+from bigdl_tpu.cli.perf import _peak_flops
+from bigdl_tpu.utils.flops import fn_flops
+
+
+def run(variant: str, batch: int, iters: int = 20):
+    model = models.resnet50(1000)
+    crit = nn.ClassNLLCriterion()
+    opt = SGD(learning_rate=0.01, momentum=0.9)
+
+    rng = np.random.RandomState(0)
+    x_host = rng.randn(batch, 224, 224, 3).astype(np.float32)
+    y_host = rng.randint(0, 1000, batch).astype(np.int32)
+
+    params = model.init(jax.random.PRNGKey(0))
+    mod_state = model.init_state()
+    opt_state = opt.init(params)
+    cast_params = variant == "bf16params"
+
+    def train_step(params, mod_state, opt_state, x, y, rng):
+        def loss_fn(p):
+            pc = (jax.tree.map(
+                lambda a: a.astype(jnp.bfloat16)
+                if jnp.issubdtype(a.dtype, jnp.floating) else a, p)
+                if cast_params else p)
+            out, ms = model.apply(pc, mod_state, x.astype(jnp.bfloat16),
+                                  training=True, rng=rng)
+            return crit(out.astype(jnp.float32), y), ms
+
+        (loss, ms), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        new_p, new_o = opt.update(grads, opt_state, params)
+        return new_p, ms, new_o, loss
+
+    x, y = jnp.asarray(x_host), jnp.asarray(y_host)
+    k = jax.random.PRNGKey(1)
+    flops = fn_flops(train_step, params, mod_state, opt_state, x, y, k)
+    step = jax.jit(train_step, donate_argnums=(0, 1, 2))
+    compiled = step.lower(params, mod_state, opt_state, x, y, k).compile()
+    params, mod_state, opt_state, loss = compiled(
+        params, mod_state, opt_state, x, y, k)
+    float(loss)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        params, mod_state, opt_state, loss = compiled(
+            params, mod_state, opt_state, x, y, k)
+    float(loss)
+    dt = time.perf_counter() - t0
+    peak, label = _peak_flops(jax.devices()[0])
+    print(json.dumps({
+        "variant": variant, "batch": batch,
+        "img_s": round(batch * iters / dt, 1),
+        "ms_step": round(dt / iters * 1000, 2),
+        "mfu_pct": round(100 * flops * iters / dt / peak, 2),
+        "gflops_step": round(flops / 1e9, 1), "peak": label,
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    variant = sys.argv[1] if len(sys.argv) > 1 else "bf16params"
+    batch = int(sys.argv[2]) if len(sys.argv) > 2 else 128
+    run(variant, batch)

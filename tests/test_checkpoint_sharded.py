@@ -123,3 +123,33 @@ def test_remote_fsspec_roundtrip(rng):
     for a, b in zip(jax.tree_util.tree_leaves(tree),
                     jax.tree_util.tree_leaves(back)):
         np.testing.assert_array_equal(a, b)
+
+
+def test_remote_overwrite_refused(rng):
+    """The Optimizer checkpoint overwrite guard must hold on remote URIs
+    too (round-2 weak #4: os.path.exists is always False for gs://, so
+    overwrite=False silently no-opped on exactly the pod-scale paths).
+    Exercised with memory:// via the fsspec-aware exists()."""
+    import numpy as np
+    import pytest
+
+    from bigdl_tpu import nn
+    from bigdl_tpu.dataset import BatchDataSet
+    from bigdl_tpu.models.lenet import lenet5
+    from bigdl_tpu.optim import Optimizer, SGD, Trigger
+    from bigdl_tpu.utils.file import exists, save_pytree
+
+    base = "memory://ckpts/guard"
+    assert not exists(f"{base}/model.999")
+    save_pytree({"a": np.zeros(2)}, f"{base}/model.2")
+    assert exists(f"{base}/model.2")
+
+    x = np.random.RandomState(0).randn(8, 28, 28, 1).astype(np.float32)
+    y = np.zeros(8, np.int32)
+    ds = BatchDataSet(x, y, batch_size=8)
+    opt = (Optimizer(lenet5(10), ds, nn.ClassNLLCriterion())
+           .set_optim_method(SGD(learning_rate=0.01))
+           .set_end_when(Trigger.max_iteration(2))
+           .set_checkpoint(Trigger.several_iteration(1), base))
+    with pytest.raises(FileExistsError, match="model.2"):
+        opt.optimize()

@@ -20,7 +20,7 @@ from torch import nn as tnn
 
 from bigdl_tpu import nn
 from bigdl_tpu.core.module import Container
-from bigdl_tpu.models import alexnet, resnet, vgg16
+from bigdl_tpu.models import alexnet, inception_v1_no_aux, resnet, vgg16
 
 # log-prob outputs of random-init nets are near-uniform (-log n_cls), so a
 # loose atol could false-pass a miswired classifier head; keep it tight
@@ -207,6 +207,65 @@ def torch_alexnet(n_cls):
         tnn.Linear(4096, n_cls), tnn.LogSoftmax(dim=-1))
 
 
+class TInceptionModule(tnn.Module):
+    """4-branch channel concat; branches registered b1..b4 so a depth-first
+    .modules() walk matches bigdl_tpu's Concat construction order — the
+    Concat-heavy topology is exactly where visit-order bugs hide
+    (reference InceptionSpec.scala)."""
+
+    def __init__(self, cin, config):
+        super().__init__()
+        (c1,), (c3r, c3), (c5r, c5), (cp,) = config
+        self.b1 = tnn.Sequential(tnn.Conv2d(cin, c1, 1), tnn.ReLU())
+        self.b2 = tnn.Sequential(tnn.Conv2d(cin, c3r, 1), tnn.ReLU(),
+                                 tnn.Conv2d(c3r, c3, 3, 1, 1), tnn.ReLU())
+        self.b3 = tnn.Sequential(tnn.Conv2d(cin, c5r, 1), tnn.ReLU(),
+                                 tnn.Conv2d(c5r, c5, 5, 1, 2), tnn.ReLU())
+        self.b4 = tnn.Sequential(
+            tnn.MaxPool2d(3, 1, 1, ceil_mode=True),
+            tnn.Conv2d(cin, cp, 1), tnn.ReLU())
+
+    def forward(self, x):
+        return torch.cat([self.b1(x), self.b2(x), self.b3(x), self.b4(x)],
+                         dim=1)
+
+
+_T_V1_CFG = [
+    ("3a", 192, [[64], [96, 128], [16, 32], [32]]),
+    ("3b", 256, [[128], [128, 192], [32, 96], [64]]),
+    ("4a", 480, [[192], [96, 208], [16, 48], [64]]),
+    ("4b", 512, [[160], [112, 224], [24, 64], [64]]),
+    ("4c", 512, [[128], [128, 256], [24, 64], [64]]),
+    ("4d", 512, [[112], [144, 288], [32, 64], [64]]),
+    ("4e", 528, [[256], [160, 320], [32, 128], [128]]),
+    ("5a", 832, [[256], [160, 320], [32, 128], [128]]),
+    ("5b", 832, [[384], [192, 384], [48, 128], [128]]),
+]
+
+
+def torch_inception_v1(n_cls):
+    cfg = dict((k, (cin, c)) for k, cin, c in _T_V1_CFG)
+    mods = [
+        tnn.Conv2d(3, 64, 7, 2, 3), tnn.ReLU(),
+        tnn.MaxPool2d(3, 2, ceil_mode=True),
+        tnn.LocalResponseNorm(5, 0.0001, 0.75, 1.0),
+        tnn.Conv2d(64, 64, 1), tnn.ReLU(),
+        tnn.Conv2d(64, 192, 3, 1, 1), tnn.ReLU(),
+        tnn.LocalResponseNorm(5, 0.0001, 0.75, 1.0),
+        tnn.MaxPool2d(3, 2, ceil_mode=True),
+        TInceptionModule(*cfg["3a"]), TInceptionModule(*cfg["3b"]),
+        tnn.MaxPool2d(3, 2, ceil_mode=True),
+        TInceptionModule(*cfg["4a"]), TInceptionModule(*cfg["4b"]),
+        TInceptionModule(*cfg["4c"]), TInceptionModule(*cfg["4d"]),
+        TInceptionModule(*cfg["4e"]),
+        tnn.MaxPool2d(3, 2, ceil_mode=True),
+        TInceptionModule(*cfg["5a"]), TInceptionModule(*cfg["5b"]),
+        tnn.AvgPool2d(7, 1), tnn.Dropout(0.4), tnn.Flatten(),
+        tnn.Linear(1024, n_cls), tnn.LogSoftmax(dim=-1),
+    ]
+    return tnn.Sequential(*mods)
+
+
 # ------------------------------------------------------------------ tests
 
 def test_resnet50_golden():
@@ -219,6 +278,13 @@ def test_vgg16_golden():
     """(reference: VGG specs via torch oracle)"""
     _compare(vgg16(17), torch_vgg16(17), (224, 224),
              first_fc_chw=(512, 7, 7))
+
+
+def test_inception_v1_golden():
+    """GoogLeNet (no aux): the Concat-heavy topology — 9 inception modules
+    x 4 branches each, ceil-mode pools, LRN placement (reference
+    InceptionSpec.scala)."""
+    _compare(inception_v1_no_aux(17), torch_inception_v1(17), (224, 224))
 
 
 def test_alexnet_golden():
