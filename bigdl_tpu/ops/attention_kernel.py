@@ -12,10 +12,15 @@ per the TPU Pallas playbook:
 * On non-TPU backends the kernel runs in interpret mode (tests), so one
   code path serves CPU tests and TPU execution.
 
-Backward: a ``jax.custom_vjp`` that recomputes attention with the dense
-XLA path (flash-style blockwise backward is a later optimization;
-``jax.checkpoint`` around the attention already gives the usual
-remat-memory profile for training).
+Backward: hand-tiled Pallas dq and dk/dv kernels (the standard flash
+backward split). The forward kernel emits the per-query logsumexp; the
+backward preprocesses ``delta = rowsum(do * o)`` in one cheap jnp pass,
+then dq runs on the forward's grid (one q tile per program, streaming K/V
+blocks) while dk/dv runs transposed (one k tile per program, streaming
+Q/dO blocks), both with causal block skipping. Probabilities are
+recomputed from q,k,lse — O(seq) memory end to end. Non-tileable shapes
+fall back to :func:`blockwise_attention` (remat-scan) under one
+``jax.custom_vjp``.
 
 ``nn.MultiHeadAttention(attn_impl="flash")`` routes here.
 """
@@ -111,8 +116,9 @@ def blockwise_attention(q, k, v, *, causal: bool = False,
     return (acc / jnp.maximum(l, 1e-30)).astype(q.dtype)
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, scale: float,
-                causal: bool, seq_k: int, block_q: int, q_offset: int):
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
+                scale: float, causal: bool, seq_k: int, block_q: int,
+                q_offset: int):
     from jax.experimental import pallas as pl
 
     j = pl.program_id(1)
@@ -164,8 +170,109 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, scale: float,
     m0 = jnp.full((bq, 1), _NEG_INF, jnp.float32)
     l0 = jnp.zeros((bq, 1), jnp.float32)
     a0 = jnp.zeros(q.shape, jnp.float32)
-    _, l, acc = jax.lax.fori_loop(0, n_loop, body, (m0, l0, a0))
-    o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+    m, l, acc = jax.lax.fori_loop(0, n_loop, body, (m0, l0, a0))
+    l_safe = jnp.maximum(l, 1e-30)
+    o_ref[0] = (acc / l_safe).astype(o_ref.dtype)
+    # per-query logsumexp, saved for the backward kernels' p recompute
+    lse_ref[0] = (m + jnp.log(l_safe))[:, 0]
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
+               block_k: int, scale: float, causal: bool, seq_k: int,
+               block_q: int, q_offset: int):
+    from jax.experimental import pallas as pl
+
+    j = pl.program_id(1)
+    q = q_ref[0]
+    do = do_ref[0]
+    lse = lse_ref[0][:, None]      # (BQ, 1) f32
+    delta = delta_ref[0][:, None]  # (BQ, 1) f32
+    bq = q.shape[0]
+    n_k = seq_k // block_k
+    if causal:
+        q_end = q_offset + (j + 1) * block_q - 1
+        n_loop = jnp.minimum(n_k, q_end // block_k + 1)
+    else:
+        n_loop = n_k
+    q_pos = (q_offset + j * block_q
+             + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0))
+
+    def body(kb, dq):
+        kblk = k_ref[0, pl.dslice(kb * block_k, block_k), :]
+        vblk = v_ref[0, pl.dslice(kb * block_k, block_k), :]
+        s = jax.lax.dot_general(
+            q, kblk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        p = jnp.exp(s - lse)  # rows already normalized via lse
+        if causal:
+            k_pos = kb * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 1)
+            p = jnp.where(q_pos >= k_pos, p, 0.0)
+        dp = jax.lax.dot_general(   # dO @ V^T  (BQ, BK)
+            do, vblk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale
+        return dq + jax.lax.dot_general(  # dS @ K  (BQ, d)
+            ds.astype(kblk.dtype), kblk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    dq = jax.lax.fori_loop(0, n_loop, body,
+                           jnp.zeros(q.shape, jnp.float32))
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def _dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref, dk_ref,
+                dv_ref, *, block_q: int, scale: float, causal: bool,
+                seq_q: int, block_k: int, q_offset: int):
+    from jax.experimental import pallas as pl
+
+    j = pl.program_id(1)  # k-block index
+    k = k_ref[0]  # (BK, d)
+    v = v_ref[0]
+    bk = k.shape[0]
+    n_q = seq_q // block_q
+    if causal:
+        # first q block whose last query can see this k block: queries at
+        # global position >= j*block_k, i.e. block index
+        # >= (j*block_k - q_offset) // block_q
+        start = jnp.maximum(0, (j * block_k - q_offset) // block_q)
+    else:
+        start = 0
+    k_pos = (j * block_k
+             + jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 1))
+
+    def body(qb, carry):
+        dk, dv = carry
+        qblk = q_ref[0, pl.dslice(qb * block_q, block_q), :]
+        doblk = do_ref[0, pl.dslice(qb * block_q, block_q), :]
+        lse = lse_ref[0, pl.dslice(qb * block_q, block_q)][:, None]
+        delta = delta_ref[0, pl.dslice(qb * block_q, block_q)][:, None]
+        s = jax.lax.dot_general(  # Q @ K^T  (BQ, BK)
+            qblk, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        p = jnp.exp(s - lse)
+        if causal:
+            q_pos = (q_offset + qb * block_q
+                     + jax.lax.broadcasted_iota(jnp.int32, (block_q, bk),
+                                                0))
+            p = jnp.where(q_pos >= k_pos, p, 0.0)
+        pc = p.astype(doblk.dtype)
+        dv = dv + jax.lax.dot_general(  # P^T @ dO  (BK, d)
+            pc, doblk, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(  # dO @ V^T  (BQ, BK)
+            doblk, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = (p * (dp - delta) * scale).astype(qblk.dtype)
+        dk = dk + jax.lax.dot_general(  # dS^T @ Q  (BK, d)
+            ds, qblk, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return dk, dv
+
+    z = jnp.zeros(k.shape, jnp.float32)
+    dk, dv = jax.lax.fori_loop(start, n_q, body, (z, z))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
 
 
 def _pad_to(x, mult, axis):
@@ -177,7 +284,21 @@ def _pad_to(x, mult, axis):
     return jnp.pad(x, widths), pad
 
 
+def _interpret() -> bool:
+    # compiled Mosaic lowering on TPU; interpret mode elsewhere (tests)
+    return jax.default_backend() != "tpu"
+
+
+def _tileable(s_q, s_k, block_k) -> bool:
+    # ragged key length would need a validity mask woven into the online
+    # softmax; the remat-scan path handles it (pad_to on K alone would
+    # let padded keys win the softmax)
+    bk = min(block_k, max(8, s_k))
+    return s_k % bk == 0
+
+
 def _flash_fwd(q, k, v, causal: bool, block_q: int, block_k: int):
+    """Pallas forward; returns (out, lse) with lse in (b*h, padded_sq)."""
     from jax.experimental import pallas as pl
 
     b, h, s_q, d = q.shape
@@ -190,20 +311,13 @@ def _flash_fwd(q, k, v, causal: bool, block_q: int, block_k: int):
 
     bq = min(block_q, max(8, s_q))
     bk = min(block_k, max(8, s_k))
-    if s_k % bk:
-        # ragged key length would need a validity mask woven into the
-        # online softmax; dense handles it (pad_to on K alone would let
-        # padded keys win the softmax)
-        return _dense.dot_product_attention(q, k, v, causal=causal,
-                                            mask=None)
     qf, pad_q = _pad_to(qf, bq, 1)
     sq, sk = qf.shape[1], kf.shape[1]
 
     kernel = functools.partial(_fwd_kernel, block_k=bk, scale=scale,
                                causal=causal, seq_k=sk, block_q=bq,
                                q_offset=s_k - s_q)
-    interpret = jax.default_backend() != "tpu"
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=(b * h, sq // bq),
         in_specs=[
@@ -211,32 +325,119 @@ def _flash_fwd(q, k, v, causal: bool, block_q: int, block_k: int):
             pl.BlockSpec((1, sk, d), lambda i, j: (i, 0, 0)),
             pl.BlockSpec((1, sk, d), lambda i, j: (i, 0, 0)),
         ],
+        out_specs=[
+            pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, bq), lambda i, j: (i, j)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, sq), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(qf, kf, vf)
+    o = out[:, :s_q] if pad_q else out
+    return o.reshape(b, h, s_q, d), lse
+
+
+def _flash_bwd(q, k, v, o, lse, g, causal: bool, block_q: int,
+               block_k: int):
+    """Pallas dq + dk/dv kernels over the recomputed probabilities."""
+    from jax.experimental import pallas as pl
+
+    b, h, s_q, d = q.shape
+    s_k = k.shape[-2]
+    scale = 1.0 / (d ** 0.5)
+
+    qf = q.reshape(b * h, s_q, d)
+    kf = k.reshape(b * h, s_k, d)
+    vf = v.reshape(b * h, s_k, d)
+    dof = g.reshape(b * h, s_q, d)
+    of = o.reshape(b * h, s_q, d)
+
+    bq = min(block_q, max(8, s_q))
+    bk = min(block_k, max(8, s_k))
+    # delta_i = sum_d dO_i * O_i — one cheap fused pass in plain XLA
+    delta = jnp.sum(dof.astype(jnp.float32) * of.astype(jnp.float32),
+                    axis=-1)
+    qf, pad_q = _pad_to(qf, bq, 1)
+    dof, _ = _pad_to(dof, bq, 1)
+    delta, _ = _pad_to(delta, bq, 1)
+    sq, sk = qf.shape[1], kf.shape[1]
+    q_offset = s_k - s_q
+    interpret = _interpret()
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, block_k=bk, scale=scale,
+                          causal=causal, seq_k=sk, block_q=bq,
+                          q_offset=q_offset),
+        grid=(b * h, sq // bq),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, sk, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, sk, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, bq), lambda i, j: (i, j)),
+            pl.BlockSpec((1, bq), lambda i, j: (i, j)),
+        ],
         out_specs=pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0)),
         out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
         interpret=interpret,
-    )(qf, kf, vf)
-    if pad_q:
-        out = out[:, :s_q]
-    return out.reshape(b, h, s_q, d)
+    )(qf, kf, vf, dof, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, block_q=bq, scale=scale,
+                          causal=causal, seq_q=sq, block_k=bk,
+                          q_offset=q_offset),
+        grid=(b * h, sk // bk),
+        in_specs=[
+            pl.BlockSpec((1, bk, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, sq, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, sq, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, sq), lambda i, j: (i, 0)),
+            pl.BlockSpec((1, sq), lambda i, j: (i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bk, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda i, j: (i, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, sk, d), k.dtype),
+            jax.ShapeDtypeStruct((b * h, sk, d), v.dtype),
+        ],
+        interpret=interpret,
+    )(kf, vf, qf, dof, lse, delta)
+
+    dq = (dq[:, :s_q] if pad_q else dq).reshape(b, h, s_q, d)
+    return dq, dk.reshape(b, h, s_k, d), dv.reshape(b, h, s_k, d)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
 def _flash(q, k, v, causal, block_q, block_k):
-    return _flash_fwd(q, k, v, causal, block_q, block_k)
+    if not _tileable(q.shape[-2], k.shape[-2], block_k):
+        return _dense.dot_product_attention(q, k, v, causal=causal,
+                                            mask=None)
+    return _flash_fwd(q, k, v, causal, block_q, block_k)[0]
 
 
 def _flash_vjp_fwd(q, k, v, causal, block_q, block_k):
-    return _flash(q, k, v, causal, block_q, block_k), (q, k, v)
+    if not _tileable(q.shape[-2], k.shape[-2], block_k):
+        out = _dense.dot_product_attention(q, k, v, causal=causal,
+                                           mask=None)
+        return out, (q, k, v, None, None)
+    out, lse = _flash_fwd(q, k, v, causal, block_q, block_k)
+    return out, (q, k, v, out, lse)
 
 
 def _flash_vjp_bwd(causal, block_q, block_k, res, g):
-    q, k, v = res
-    # blockwise-remat recompute: O(seq) memory like the forward kernel
-    # (the dense path would materialize the (s, s) score matrix here)
-    _, vjp = jax.vjp(
-        lambda q_, k_, v_: blockwise_attention(
-            q_, k_, v_, causal=causal, block_k=block_k), q, k, v)
-    return vjp(g)
+    q, k, v, o, lse = res
+    if lse is None:
+        # non-tileable fallback: blockwise-remat recompute, O(seq) memory
+        _, vjp = jax.vjp(
+            lambda q_, k_, v_: blockwise_attention(
+                q_, k_, v_, causal=causal, block_k=block_k), q, k, v)
+        return vjp(g)
+    return _flash_bwd(q, k, v, o, lse, g, causal, block_q, block_k)
 
 
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)

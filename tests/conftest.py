@@ -21,10 +21,21 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+# BIGDL_TPU_TESTS=1 keeps the real backend so @pytest.mark.tpu tests (the
+# compiled Pallas path) can run in the bench environment:
+#   BIGDL_TPU_TESTS=1 python -m pytest tests/ -m tpu
+if not os.environ.get("BIGDL_TPU_TESTS"):
+    jax.config.update("jax_platforms", "cpu")
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "tpu: needs a real TPU backend (compiled Pallas path); skipped on "
+        "the CPU test platform, run manually in the bench environment")
 
 
 @pytest.fixture

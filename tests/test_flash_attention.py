@@ -73,6 +73,66 @@ def test_flash_gradients_match_dense():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-4)
 
 
+@pytest.mark.parametrize("causal,sq,sk,dtype,tol", [
+    (False, 256, 256, jnp.float32, 1e-4),
+    (True, 256, 256, jnp.float32, 1e-4),
+    (True, 100, 256, jnp.float32, 1e-4),   # q padding + offset
+    (True, 128, 384, jnp.float32, 1e-4),   # cross-length causal
+    (True, 256, 256, jnp.bfloat16, 5e-2),
+])
+def test_flash_backward_kernels_match_dense(causal, sq, sk, dtype, tol):
+    """The Pallas dq and dk/dv backward kernels (not the remat fallback:
+    these shapes are tileable at the default 128 blocks) against dense
+    autodiff, including q-padding, bottom-right causal offset, bf16."""
+    rs = np.random.RandomState(12)
+    d = 64
+    q = jnp.asarray(rs.randn(1, 2, sq, d), dtype)
+    k = jnp.asarray(rs.randn(1, 2, sk, d), dtype)
+    v = jnp.asarray(rs.randn(1, 2, sk, d), dtype)
+    g = jnp.asarray(rs.randn(1, 2, sq, d), dtype)
+
+    def scalar(f):
+        return lambda q, k, v: jnp.vdot(
+            f(q, k, v).astype(jnp.float32), g.astype(jnp.float32))
+
+    gf = jax.grad(scalar(lambda q, k, v: flash_attention(
+        q, k, v, causal=causal)), argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(scalar(lambda q, k, v: dot_product_attention(
+        q, k, v, causal=causal)), argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gd):
+        np.testing.assert_allclose(np.asarray(a, np.float32),
+                                   np.asarray(b, np.float32), atol=tol)
+
+
+@pytest.mark.tpu
+def test_flash_compiled_on_tpu():
+    """Non-interpret (Mosaic-compiled) forward+backward parity — runs only
+    where a real TPU backend is present (VERDICT r2 item 8: CI otherwise
+    never compiles the kernel, so a lowering bug would ship silently)."""
+    if jax.default_backend() != "tpu":
+        pytest.skip("needs a TPU backend (kernel runs interpret elsewhere)")
+    rs = np.random.RandomState(13)
+    q = jnp.asarray(rs.randn(2, 4, 512, 64), jnp.bfloat16)
+    k = jnp.asarray(rs.randn(2, 4, 512, 64), jnp.bfloat16)
+    v = jnp.asarray(rs.randn(2, 4, 512, 64), jnp.bfloat16)
+
+    out = jax.jit(lambda q, k, v: flash_attention(
+        q, k, v, causal=True))(q, k, v)
+    ref = dot_product_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref, np.float32), atol=5e-2)
+
+    gf = jax.jit(jax.grad(lambda q, k, v: flash_attention(
+        q, k, v, causal=True).astype(jnp.float32).sum(),
+        argnums=(0, 1, 2)))(q, k, v)
+    gd = jax.grad(lambda q, k, v: dot_product_attention(
+        q, k, v, causal=True).astype(jnp.float32).sum(),
+        argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gd):
+        np.testing.assert_allclose(np.asarray(a, np.float32),
+                                   np.asarray(b, np.float32), atol=1e-1)
+
+
 @pytest.mark.parametrize("causal", [False, True])
 def test_blockwise_matches_dense(causal):
     from bigdl_tpu.ops import blockwise_attention
