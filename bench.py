@@ -56,7 +56,32 @@ def child(backend: str, model: str, batch: int, iters: int) -> None:
 
     from bigdl_tpu.cli import perf
 
-    out = perf.run(model, batch, iters, "random", use_bf16=True)
+    data_source = None
+    if model.endswith("_pipe"):
+        # "<model>_pipe": train from generated ImageNet-shape record
+        # shards — decode+augment+host->device inside the timed loop
+        import sys as _sys
+        import tempfile
+
+        model = model[:-len("_pipe")]
+        _sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "scripts"))
+        from input_pipeline_bench import make_jpegs
+
+        from bigdl_tpu.dataset.recordfile import write_image_shards
+
+        td = tempfile.mkdtemp(prefix="bench_pipe_")
+        img_root = os.path.join(td, "imgs")
+        make_jpegs(img_root, max(2 * batch, 256))
+        shard_dir = os.path.join(td, "shards")
+        write_image_shards(img_root, shard_dir, images_per_shard=256)
+        data_source = f"record:{shard_dir}"
+
+    out = perf.run(model, batch, iters, "random", use_bf16=True,
+                   data_source=data_source)
+    if data_source is not None:
+        out["model"] += "_pipe"
+        out["data_source"] = "record-shards (generated, ~120KB JPEGs)"
     out["backend"] = jax.default_backend()
     print("BENCH_RESULT " + json.dumps(out))
 

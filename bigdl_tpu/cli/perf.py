@@ -72,8 +72,29 @@ def build_model(name: str, class_num: int = 1000):
     return table[name](), size
 
 
+def _record_batches(source: str, batch: int, n_threads: int = 0):
+    """Endless MiniBatch iterator over ``record:<shard-dir>`` — the
+    train-from-storage bench path (decode + per-sample augment + batch +
+    host->device all inside the timed loop; round-2 weak #2: the synthetic
+    bench can't see an input-bound regime)."""
+    import os
+
+    from bigdl_tpu.dataset.streaming import RecordImageDataSet
+
+    ds = RecordImageDataSet(
+        source, batch_size=batch, crop=(224, 224), train=True,
+        short_side=256, mean=[123.68, 116.779, 103.939],
+        std=[58.4, 57.1, 57.4],
+        n_threads=n_threads or min(32, (os.cpu_count() or 4) * 2),
+        window=4)
+    while True:
+        for mb in ds:
+            yield mb
+
+
 def run(model_name: str, batch: int, iterations: int, data_type: str,
-        use_bf16: bool = True, data_parallel: bool = False):
+        use_bf16: bool = True, data_parallel: bool = False,
+        data_source: str | None = None):
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -178,8 +199,20 @@ def run(model_name: str, batch: int, iterations: int, data_type: str,
     # block_until_ready was observed returning before execution finished
     float(loss)  # compile + warmup
 
+    feed = None
+    if data_source is not None:
+        if data_source.startswith("record:"):
+            feed = _record_batches(data_source[len("record:"):], batch)
+        else:
+            raise SystemExit(f"unknown --data source {data_source!r}")
+        next(feed)  # warm the decode pool outside the timed region
+
     t0 = time.perf_counter()
     for _ in range(iterations):
+        if feed is not None:
+            mb = next(feed)
+            x = jnp.asarray(mb.input)   # host->device each step, like a
+            y = jnp.asarray(mb.target)  # real training epoch
         params, mod_state, opt_state, loss = step(params, mod_state,
                                                   opt_state, x, y, k)
     float(loss)  # scalar host read = true device sync (see note above)
@@ -231,9 +264,14 @@ def main(argv=None):
     p.add_argument("--f32", action="store_true",
                    help="disable bf16 compute")
     p.add_argument("--dataParallel", action="store_true")
+    p.add_argument("--data", default=None,
+                   help="feed from storage instead of a resident batch, "
+                        "e.g. record:/path/to/shards (timed loop then "
+                        "includes decode+augment+host->device)")
     args = p.parse_args(argv)
     run(args.model, args.batchSize, args.iteration, args.dataType,
-        use_bf16=not args.f32, data_parallel=args.dataParallel)
+        use_bf16=not args.f32, data_parallel=args.dataParallel,
+        data_source=args.data)
 
 
 if __name__ == "__main__":

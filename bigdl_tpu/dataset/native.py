@@ -107,6 +107,14 @@ def _bind(lib: ctypes.CDLL) -> None:
         ctypes.c_void_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
         ctypes.c_int, ctypes.c_int, ctypes.c_void_p, ctypes.c_void_p,
     ]
+    lib.bt_jpeg_available.restype = ctypes.c_int
+    lib.bt_jpeg_available.argtypes = []
+    lib.bt_decode_jpeg.restype = ctypes.c_int
+    lib.bt_decode_jpeg.argtypes = [
+        ctypes.c_char_p, ctypes.c_int64, ctypes.c_int, ctypes.c_int,
+        ctypes.c_int, ctypes.POINTER(ctypes.c_void_p),
+        ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int),
+    ]
 
 
 def available() -> bool:
@@ -134,6 +142,49 @@ def augment_sample_native(img: np.ndarray, out: np.ndarray, off_h: int,
             f"crop {out.shape[:2]} at offset ({off_h}, {off_w}) falls "
             f"outside source image {img.shape[:2]} — is short_side "
             f"smaller than the crop?")
+
+
+def jpeg_available() -> bool:
+    """True when the native lib was built against libjpeg.
+    BIGDL_NO_NATIVE_JPEG=1 forces the PIL path (A/B benchmarking)."""
+    if os.environ.get("BIGDL_NO_NATIVE_JPEG"):
+        return False
+    lib = _load()
+    try:
+        return bool(lib and lib.bt_jpeg_available())
+    except AttributeError:  # stale .so predating the decode symbols
+        return False
+
+
+def decode_jpeg(raw: bytes, short_side: Optional[int] = None,
+                fill: Optional[tuple[int, int]] = None):
+    """Native JPEG decode+resize (libjpeg DCT scaling + bilinear to the
+    exact target — the C counterpart of streaming.decode_resize). Returns
+    an RGB uint8 (H, W, 3) array, or None when the native path can't
+    serve this input (caller falls back to PIL). GIL released by ctypes,
+    so a thread pool of decoders scales across cores."""
+    if not jpeg_available():
+        return None
+    lib = _load()
+    if short_side is not None:
+        mode, th, tw = 0, int(short_side), 0
+    else:
+        mode, (th, tw) = 1, (int(fill[0]), int(fill[1]))
+    out = ctypes.c_void_p()
+    oh, ow = ctypes.c_int(), ctypes.c_int()
+    rc = lib.bt_decode_jpeg(raw, len(raw), mode, th, tw,
+                            ctypes.byref(out), ctypes.byref(oh),
+                            ctypes.byref(ow))
+    if rc != 0:
+        return None
+    try:
+        n = oh.value * ow.value * 3
+        img = np.frombuffer(
+            ctypes.cast(out, ctypes.POINTER(ctypes.c_uint8 * n)).contents,
+            dtype=np.uint8).reshape(oh.value, ow.value, 3).copy()
+    finally:
+        lib.bt_free(out)
+    return img
 
 
 class NativePrefetchDataSet(DataSet):

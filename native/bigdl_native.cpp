@@ -14,6 +14,7 @@
 // Exposed as a plain C ABI for ctypes (no pybind11 in this image).
 
 #include <atomic>
+#include <cmath>
 #include <condition_variable>
 #include <cstdint>
 #include <cstdio>
@@ -25,6 +26,11 @@
 #include <random>
 #include <thread>
 #include <vector>
+
+#ifdef BT_WITH_JPEG
+#include <csetjmp>
+#include <jpeglib.h>
+#endif
 
 namespace {
 
@@ -176,9 +182,155 @@ void worker_main(Pipeline* p) {
     }
 }
 
+// Bilinear resize, uint8 HWC -> uint8 HWC (half-pixel-centered sampling).
+void resize_bilinear(const uint8_t* src, int sh, int sw, int c, uint8_t* dst,
+                     int th, int tw) {
+    for (int y = 0; y < th; ++y) {
+        float fy = ((float)y + 0.5f) * sh / th - 0.5f;
+        if (fy < 0) fy = 0;
+        int y0 = (int)fy;
+        int y1 = y0 + 1 < sh ? y0 + 1 : sh - 1;
+        float wy = fy - y0;
+        for (int x = 0; x < tw; ++x) {
+            float fx = ((float)x + 0.5f) * sw / tw - 0.5f;
+            if (fx < 0) fx = 0;
+            int x0 = (int)fx;
+            int x1 = x0 + 1 < sw ? x0 + 1 : sw - 1;
+            float wx = fx - x0;
+            const uint8_t* p00 = src + ((int64_t)y0 * sw + x0) * c;
+            const uint8_t* p01 = src + ((int64_t)y0 * sw + x1) * c;
+            const uint8_t* p10 = src + ((int64_t)y1 * sw + x0) * c;
+            const uint8_t* p11 = src + ((int64_t)y1 * sw + x1) * c;
+            uint8_t* o = dst + ((int64_t)y * tw + x) * c;
+            for (int k = 0; k < c; ++k) {
+                float v = (1 - wy) * ((1 - wx) * p00[k] + wx * p01[k]) +
+                          wy * ((1 - wx) * p10[k] + wx * p11[k]);
+                o[k] = (uint8_t)(v + 0.5f);
+            }
+        }
+    }
+}
+
+#ifdef BT_WITH_JPEG
+struct JpegErr {
+    jpeg_error_mgr pub;
+    jmp_buf jb;
+};
+
+void jpeg_err_exit(j_common_ptr cinfo) {
+    longjmp(((JpegErr*)cinfo->err)->jb, 1);
+}
+#endif
+
 }  // namespace
 
 extern "C" {
+
+// 1 when the .so was built against libjpeg (bt_decode_jpeg functional).
+int bt_jpeg_available(void) {
+#ifdef BT_WITH_JPEG
+    return 1;
+#else
+    return 0;
+#endif
+}
+
+// Decode a JPEG and resize — the decode half of the reference's MT input
+// path (image/BGRImage.scala readRawImage + MTLabeledBGRImgToBatch), kept
+// native so the whole per-sample path runs without the Python interpreter:
+// libjpeg DCT scaling (scale_denom in {1,2,4,8}, decode near target size —
+// the "draft mode" trick) followed by an exact bilinear resize.
+//
+// mode 0: scale so min(h, w) == target_h (short-side convention, train);
+// mode 1: scale so the image covers (target_h, target_w) (fill, eval).
+// *out is malloc'd RGB HWC (caller frees with bt_free).
+// Returns 0 on success, -1 on decode error / no libjpeg at build time.
+int bt_decode_jpeg(const uint8_t* buf, int64_t len, int mode, int target_h,
+                   int target_w, uint8_t** out, int* out_h, int* out_w) {
+#ifndef BT_WITH_JPEG
+    (void)buf; (void)len; (void)mode; (void)target_h; (void)target_w;
+    (void)out; (void)out_h; (void)out_w;
+    return -1;
+#else
+    if (!buf || len <= 0 || !out || !out_h || !out_w || target_h <= 0)
+        return -1;
+    jpeg_decompress_struct cinfo;
+    JpegErr jerr;
+    cinfo.err = jpeg_std_error(&jerr.pub);
+    jerr.pub.error_exit = jpeg_err_exit;
+    std::vector<uint8_t> decoded;  // declared before setjmp (longjmp and
+    uint8_t* result = nullptr;     // non-trivial dtors don't mix)
+    if (setjmp(jerr.jb)) {
+        jpeg_destroy_decompress(&cinfo);
+        free(result);
+        return -1;
+    }
+    jpeg_create_decompress(&cinfo);
+    jpeg_mem_src(&cinfo, buf, (unsigned long)len);
+    if (jpeg_read_header(&cinfo, TRUE) != JPEG_HEADER_OK) {
+        jpeg_destroy_decompress(&cinfo);
+        return -1;
+    }
+    const int w0 = (int)cinfo.image_width, h0 = (int)cinfo.image_height;
+    if (w0 <= 0 || h0 <= 0) {
+        jpeg_destroy_decompress(&cinfo);
+        return -1;
+    }
+    // final dims (mirrors dataset/streaming.decode_resize arithmetic)
+    int th, tw;
+    if (mode == 0) {
+        const int ss = target_h;
+        const double scale = (double)ss / (w0 < h0 ? w0 : h0);
+        tw = (int)std::lround(w0 * scale);
+        th = (int)std::lround(h0 * scale);
+        if (tw < ss) tw = ss;
+        if (th < ss) th = ss;
+    } else {
+        if (target_w <= 0) {
+            jpeg_destroy_decompress(&cinfo);
+            return -1;
+        }
+        const double scale = std::fmax((double)target_h / h0,
+                                       (double)target_w / w0);
+        tw = (int)std::lround(w0 * scale);
+        th = (int)std::lround(h0 * scale);
+        if (tw < target_w) tw = target_w;
+        if (th < target_h) th = target_h;
+    }
+    // DCT-domain downscale: largest 1/d (d in 1,2,4,8) still >= target
+    int denom = 1;
+    while (denom * 2 <= 8 && w0 / (denom * 2) >= tw &&
+           h0 / (denom * 2) >= th)
+        denom *= 2;
+    cinfo.scale_num = 1;
+    cinfo.scale_denom = (unsigned)denom;
+    cinfo.out_color_space = JCS_RGB;  // grayscale/YCbCr sources converted
+    jpeg_start_decompress(&cinfo);
+    const int dw = (int)cinfo.output_width, dh = (int)cinfo.output_height;
+    if (cinfo.output_components != 3) {
+        jpeg_destroy_decompress(&cinfo);
+        return -1;
+    }
+    decoded.resize((size_t)dw * dh * 3);
+    while (cinfo.output_scanline < cinfo.output_height) {
+        uint8_t* row = decoded.data() + (size_t)cinfo.output_scanline * dw * 3;
+        jpeg_read_scanlines(&cinfo, &row, 1);
+    }
+    jpeg_finish_decompress(&cinfo);
+    jpeg_destroy_decompress(&cinfo);
+
+    result = (uint8_t*)malloc((size_t)th * tw * 3);
+    if (!result) return -1;
+    if (dw == tw && dh == th)
+        std::memcpy(result, decoded.data(), (size_t)th * tw * 3);
+    else
+        resize_bilinear(decoded.data(), dh, dw, 3, result, th, tw);
+    *out = result;
+    *out_h = th;
+    *out_w = tw;
+    return 0;
+#endif
+}
 
 // Create a pipeline over an in-memory uint8 image array [n, h, w, c] and
 // int32 labels [n]. Caller keeps images/labels alive until destroy.

@@ -1,0 +1,89 @@
+"""ImageNet-shape input-pipeline throughput bench (VERDICT r2 missing #2:
+prove decode+augment can feed the chip at its measured img/s).
+
+Generates realistic synthetic JPEGs (~100-200KB, short side ~375, the
+ImageNet file-size regime), packs them into .btr shards, then measures
+RecordImageDataSet streaming throughput (decode + per-sample random
+crop/flip + normalize + batch assembly) in train mode at 224x224.
+
+    python scripts/input_pipeline_bench.py [n_images] [n_threads] [batch]
+
+Prints one JSON line: images/sec plus the decode backend in use.
+Reference bar: MTLabeledBGRImgToBatch.scala:48-133 kept Xeon clusters
+saturated; our bar is >= the measured model img/s (BENCH_r03).
+"""
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+
+def make_jpegs(root: str, n: int, seed: int = 0) -> None:
+    from PIL import Image
+
+    rs = np.random.RandomState(seed)
+    d = os.path.join(root, "class0")
+    os.makedirs(d, exist_ok=True)
+    for i in range(n):
+        # smooth gradients + mild noise compress to ~the ImageNet size
+        # regime at q87; pure noise would be unrealistically large
+        h = int(rs.randint(375, 500))
+        w = int(rs.randint(480, 640))
+        yy = np.linspace(0, 255, h)[:, None]
+        xx = np.linspace(0, 255, w)[None, :]
+        base = np.stack([yy + 0 * xx, 0 * yy + xx, (yy + xx) / 2], -1)
+        img = (base + rs.randn(h, w, 3) * 28).clip(0, 255).astype(np.uint8)
+        Image.fromarray(img).save(os.path.join(d, f"{i}.jpg"), quality=87)
+
+
+def run(n_images: int = 512, n_threads: int = 16, batch: int = 128,
+        epochs: int = 2):
+    from bigdl_tpu.dataset import native
+    from bigdl_tpu.dataset.recordfile import write_image_shards
+    from bigdl_tpu.dataset.streaming import RecordImageDataSet
+
+    with tempfile.TemporaryDirectory() as td:
+        img_root = os.path.join(td, "imgs")
+        make_jpegs(img_root, n_images)
+        sizes = [os.path.getsize(os.path.join(img_root, "class0", f))
+                 for f in os.listdir(os.path.join(img_root, "class0"))]
+        shard_dir = os.path.join(td, "shards")
+        write_image_shards(img_root, shard_dir, images_per_shard=256)
+
+        ds = RecordImageDataSet(
+            shard_dir, batch_size=batch, crop=(224, 224), train=True,
+            short_side=256,
+            mean=[123.68, 116.779, 103.939], std=[58.4, 57.1, 57.4],
+            n_threads=n_threads, window=4)
+        # warmup epoch fragment: imports, thread pool, reader handles
+        next(iter(ds))
+        t0 = time.perf_counter()
+        n_done = 0
+        for _ in range(epochs):
+            for b in ds:
+                n_done += b.input.shape[0]
+        dt = time.perf_counter() - t0
+        out = {
+            "metric": "input_pipeline_imagenet_shape",
+            "images_per_second": round(n_done / dt, 1),
+            "n_images": n_images, "batch": batch,
+            "n_threads": n_threads,
+            "mean_jpeg_kb": round(float(np.mean(sizes)) / 1024, 1),
+            "native_jpeg_decode": native.jpeg_available(),
+            "seconds": round(dt, 2),
+        }
+        print(json.dumps(out), flush=True)
+        return out
+
+
+if __name__ == "__main__":
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 512
+    t = int(sys.argv[2]) if len(sys.argv) > 2 else 16
+    b = int(sys.argv[3]) if len(sys.argv) > 3 else 128
+    run(n, t, b)
