@@ -1,0 +1,89 @@
+"""Generate an MNIST-analog dataset as real idx-ubyte files.
+
+This sandbox has zero egress and ships no datasets, so the end-to-end
+convergence run (reference scripts/run.example.sh downloading MNIST and
+training LeNet) uses a procedurally rendered stand-in: PIL's built-in
+bitmap font draws digits 0-9 at 28x28 with per-sample random shift,
+rotation, scale jitter, and pixel noise — a real (non-linearly-separable)
+10-class problem with the exact MNIST file format, so
+``scripts/run_example.sh lenet <dir>`` runs unchanged.
+
+    python scripts/make_synth_mnist.py <out_dir> [n_train] [n_test]
+"""
+
+import os
+import struct
+import sys
+
+import numpy as np
+
+
+def render_digit(digit: int, rs: np.random.RandomState) -> np.ndarray:
+    from PIL import Image, ImageDraw, ImageFont
+
+    # draw large, then rotate/scale/shift into the 28x28 frame
+    canvas = Image.new("L", (40, 40), 0)
+    d = ImageDraw.Draw(canvas)
+    font = ImageFont.load_default()
+    d.text((14, 10), str(digit), fill=255, font=font)
+    angle = rs.uniform(-20, 20)
+    scale = rs.uniform(1.4, 2.2)
+    canvas = canvas.rotate(angle, resample=Image.BILINEAR, center=(17, 14))
+    nw = max(8, int(40 * scale))
+    canvas = canvas.resize((nw, nw), Image.BILINEAR)
+    arr = np.asarray(canvas, np.float32)
+    ys, xs = np.nonzero(arr > 32)
+    if len(ys) == 0:  # degenerate render; retry with fresh params
+        return render_digit(digit, rs)
+    cy, cx = int(ys.mean()), int(xs.mean())
+    oy = cy - 14 + rs.randint(-3, 4)
+    ox = cx - 14 + rs.randint(-3, 4)
+    out = np.zeros((28, 28), np.float32)
+    for y in range(28):
+        sy = y + oy
+        if 0 <= sy < arr.shape[0]:
+            sx0, sx1 = max(0, ox), min(arr.shape[1], ox + 28)
+            if sx1 > sx0:
+                out[y, max(0, -ox):max(0, -ox) + (sx1 - sx0)] = \
+                    arr[sy, sx0:sx1]
+    out += rs.randn(28, 28) * 12 + rs.uniform(0, 20)
+    return out.clip(0, 255).astype(np.uint8)
+
+
+def write_idx_images(path: str, images: np.ndarray) -> None:
+    n, h, w = images.shape
+    with open(path, "wb") as f:
+        f.write(struct.pack(">BBBB", 0, 0, 0x08, 3))
+        f.write(struct.pack(">III", n, h, w))
+        f.write(images.tobytes())
+
+
+def write_idx_labels(path: str, labels: np.ndarray) -> None:
+    with open(path, "wb") as f:
+        f.write(struct.pack(">BBBB", 0, 0, 0x08, 1))
+        f.write(struct.pack(">I", len(labels)))
+        f.write(labels.astype(np.uint8).tobytes())
+
+
+def make_split(n: int, seed: int):
+    rs = np.random.RandomState(seed)
+    labels = rs.randint(0, 10, n).astype(np.uint8)
+    images = np.stack([render_digit(int(l), rs) for l in labels])
+    return images, labels
+
+
+def main(out_dir: str, n_train: int = 20000, n_test: int = 4000) -> None:
+    os.makedirs(out_dir, exist_ok=True)
+    xtr, ytr = make_split(n_train, seed=1)
+    xte, yte = make_split(n_test, seed=2)
+    write_idx_images(os.path.join(out_dir, "train-images-idx3-ubyte"), xtr)
+    write_idx_labels(os.path.join(out_dir, "train-labels-idx1-ubyte"), ytr)
+    write_idx_images(os.path.join(out_dir, "t10k-images-idx3-ubyte"), xte)
+    write_idx_labels(os.path.join(out_dir, "t10k-labels-idx1-ubyte"), yte)
+    print(f"wrote {n_train} train / {n_test} test to {out_dir}")
+
+
+if __name__ == "__main__":
+    main(sys.argv[1] if len(sys.argv) > 1 else "./data/synth_mnist",
+         int(sys.argv[2]) if len(sys.argv) > 2 else 20000,
+         int(sys.argv[3]) if len(sys.argv) > 3 else 4000)
