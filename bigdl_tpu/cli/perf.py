@@ -56,6 +56,7 @@ def build_model(name: str, class_num: int = 1000):
         "vgg19": lambda: models.vgg19(class_num),
         "alexnet": lambda: models.alexnet(class_num),
         "resnet50": lambda: models.resnet50(class_num),
+        "resnet50_s2d": lambda: models.resnet50(class_num, s2d_stem=True),
         "lenet5": lambda: models.lenet5(10),
         # long-context flagship: 32k vocab, 512-token causal LM. The Pallas
         # kernel only off-interpret on TPU; elsewhere the dense path keeps
