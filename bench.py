@@ -115,6 +115,7 @@ def main() -> None:
 
     errors = []
     result = None
+    companions = {}
     probe, perr = _attempt("probe", model, batch, iters, PROBE_TIMEOUT)
     if probe is None:
         errors.append(f"backend probe failed ({perr}); skipping to cpu")
@@ -126,6 +127,26 @@ def main() -> None:
         result, err = _attempt("default", model, batch, iters, TPU_TIMEOUT)
         if err:
             errors.append(err)
+        if result is not None and os.environ.get(
+                "BENCH_COMPANIONS", "1") != "0":
+            # companion configs ride inside the same JSON line (the
+            # driver records one line; these are the VERDICT-requested
+            # transformer_lm and train-from-storage datapoints)
+            for cname, cmodel, cb, ci in (
+                    ("transformer_lm", "transformer_lm", 32, 10),
+                    ("resnet50_pipe", "resnet50_pipe", batch, iters)):
+                cres, cerr = _attempt("default", cmodel, cb, ci,
+                                      int(os.environ.get(
+                                          "BENCH_COMPANION_TIMEOUT",
+                                          "600")))
+                if cres is not None:
+                    companions[cname] = {
+                        k: cres.get(k) for k in (
+                            "images_per_second_per_chip", "mfu_pct",
+                            "tokens_per_second", "batch", "seconds")
+                        if cres.get(k) is not None}
+                else:
+                    companions[cname] = {"error": cerr}
     if result is None:
         # CPU fallback: tiny shapes so the line lands fast; marked as cpu
         result, err = _attempt("cpu", model, min(batch, 4), 2, CPU_TIMEOUT)
@@ -160,6 +181,8 @@ def main() -> None:
             line["tokens_per_second"] = result["tokens_per_second"]
         if "flops_disagreement" in result:
             line["flops_disagreement"] = result["flops_disagreement"]
+    if companions:
+        line["companions"] = companions
     if errors:
         line["error"] = "; ".join(errors)
     print(json.dumps(line))
