@@ -1,0 +1,40 @@
+#!/usr/bin/env bash
+# Round-3 TPU measurement sweep: runs every chip-dependent datapoint and
+# appends JSON/log lines to $OUT (default /tmp/tpu_capture.log). Each step
+# has its own timeout so one hang doesn't lose the rest.
+set -uo pipefail
+cd "$(dirname "$0")/.."
+OUT="${OUT:-/tmp/tpu_capture.log}"
+
+step() {
+  local name="$1" tmo="$2"; shift 2
+  echo "=== $name ($(date -u +%H:%M:%SZ))" | tee -a "$OUT"
+  timeout "$tmo" "$@" 2>&1 | tail -30 | tee -a "$OUT"
+  echo "=== end $name rc=$?" | tee -a "$OUT"
+}
+
+# MFU trajectory (b64..b512) + variants
+for B in 64 128 256 512; do
+  step "perf_resnet50_b$B" 900 python -m bigdl_tpu.cli.perf -m resnet50 -b "$B" -i 20 --dataType random
+done
+step "perf_resnet50_s2d_b128" 900 python -m bigdl_tpu.cli.perf -m resnet50_s2d -b 128 -i 20 --dataType random
+step "perf_resnet50_inner10_b128" 900 python -m bigdl_tpu.cli.perf -m resnet50 -b 128 -i 4 --innerSteps 10 --dataType random
+step "perf_transformer_lm_b32" 900 python -m bigdl_tpu.cli.perf -m transformer_lm -b 32 -i 10 --dataType random
+
+# flash kernel: compiled tests + microbench
+step "pytest_tpu_marked" 1200 env BIGDL_TPU_TESTS=1 python -m pytest tests/ -m tpu -q
+step "flash_bench" 1800 python scripts/flash_bench.py 4 8 64
+
+# train-from-storage (decode+augment+transfer in the loop)
+step "bench_pipe" 1800 python bench.py resnet50_pipe 128 20
+
+# convergence: LeNet on the MNIST-analog through the user-facing script
+if [ ! -f /tmp/synth_mnist_full/train-images-idx3-ubyte ]; then
+  step "make_synth_mnist" 1200 python scripts/make_synth_mnist.py /tmp/synth_mnist_full 20000 4000
+fi
+step "lenet_convergence" 1800 ./scripts/run_example.sh lenet /tmp/synth_mnist_full -b 128 --maxEpoch 20 --learningRate 0.1
+
+# the official bench line last (resnet50 + companions)
+step "bench_main" 2400 python bench.py
+
+echo "capture complete -> $OUT"
