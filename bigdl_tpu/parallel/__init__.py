@@ -1,7 +1,9 @@
 from bigdl_tpu.parallel.mesh import (
     init_distributed, make_mesh, local_mesh, P, NamedSharding,
 )
-from bigdl_tpu.parallel.data_parallel import DataParallel
+from bigdl_tpu.parallel.data_parallel import (
+    DataParallel, FullyShardedDataParallel,
+)
 from bigdl_tpu.parallel.tensor_parallel import (
     TensorParallel, megatron_specs, replicated_specs,
 )
