@@ -116,40 +116,46 @@ def blockwise_attention(q, k, v, *, causal: bool = False,
     return (acc / jnp.maximum(l, 1e-30)).astype(q.dtype)
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
-                scale: float, causal: bool, seq_k: int, block_q: int,
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
+                *, block_k: int, scale: float, causal: bool, block_q: int,
                 q_offset: int):
+    """3-D grid (bh, q_blocks, k_blocks): K/V stream block-by-block from
+    HBM (Pallas double-buffers across the innermost grid dim), online
+    softmax state lives in VMEM scratch — O(block) VMEM regardless of
+    sequence length, so 128k-token sequences fit."""
     from jax.experimental import pallas as pl
 
     j = pl.program_id(1)
-    q = q_ref[0]  # (BQ, d) — keep input dtype: bf16 operands on the MXU,
-    # fp32 accumulation via preferred_element_type below
-    bq = q.shape[0]
-    n_k = seq_k // block_k
-    if causal:
-        # skip fully-future K blocks: the last query of this tile sits at
-        # q_offset + (j+1)*block_q - 1, so later blocks are all masked —
-        # halves the FLOPs of causal self-attention
-        q_end = q_offset + (j + 1) * block_q - 1
-        n_loop = jnp.minimum(n_k, q_end // block_k + 1)
-    else:
-        n_loop = n_k
+    kk = pl.program_id(2)
+    n_k = pl.num_programs(2)
 
+    @pl.when(kk == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    bq = q_ref.shape[1]
     # bottom-right aligned causal (matches dot_product_attention): query i
-    # sees keys <= (s_k - s_q) + i
-    q_pos = (q_offset + j * block_q
-             + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0))
+    # sees keys <= (s_k - s_q) + i. Fully-future K blocks are skipped
+    # (grid step still runs, matmuls don't — half the causal FLOPs).
+    q_end = q_offset + (j + 1) * block_q - 1
+    live = True if not causal else kk * block_k <= q_end
 
-    def body(kb, carry):
-        m, l, acc = carry
-        kblk = k_ref[0, pl.dslice(kb * block_k, block_k), :]
-        vblk = v_ref[0, pl.dslice(kb * block_k, block_k), :]
+    @pl.when(live)
+    def _step():
+        q = q_ref[0]  # (BQ, d) — input dtype on the MXU, fp32 accumulate
+        kblk = k_ref[0]
+        vblk = v_ref[0]
+        m, l = m_scr[...], l_scr[...]
         s = jax.lax.dot_general(
-            q, kblk,
-            (((1,), (1,)), ((), ())),
+            q, kblk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale  # (BQ, BK)
         if causal:
-            k_pos = kb * block_k + jax.lax.broadcasted_iota(
+            q_pos = (q_offset + j * block_q
+                     + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k),
+                                                0))
+            k_pos = kk * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (bq, block_k), 1)
             s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
         blk_max = jnp.max(s, axis=-1, keepdims=True)
@@ -158,121 +164,125 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
         if causal:
             p = jnp.where(q_pos >= k_pos, p, 0.0)
         corr = jnp.exp(m - new_m)
-        l = l * corr + jnp.sum(p, axis=-1, keepdims=True)
-        # cast p down to V's dtype (flash-attention convention) so the
-        # P@V product is also a bf16 MXU matmul with fp32 accumulation
-        acc = acc * corr + jax.lax.dot_general(
-            p.astype(vblk.dtype), vblk,
-            (((1,), (0,)), ((), ())),
+        m_scr[...] = new_m
+        l_scr[...] = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+        # p cast to V's dtype (flash convention): P@V is a bf16 MXU
+        # matmul with fp32 accumulation
+        acc_scr[...] = acc_scr[...] * corr + jax.lax.dot_general(
+            p.astype(vblk.dtype), vblk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
-        return new_m, l, acc
 
-    m0 = jnp.full((bq, 1), _NEG_INF, jnp.float32)
-    l0 = jnp.zeros((bq, 1), jnp.float32)
-    a0 = jnp.zeros(q.shape, jnp.float32)
-    m, l, acc = jax.lax.fori_loop(0, n_loop, body, (m0, l0, a0))
-    l_safe = jnp.maximum(l, 1e-30)
-    o_ref[0] = (acc / l_safe).astype(o_ref.dtype)
-    # per-query logsumexp, saved for the backward kernels' p recompute
-    lse_ref[0] = (m + jnp.log(l_safe))[:, 0]
+    @pl.when(kk == n_k - 1)
+    def _emit():
+        l_safe = jnp.maximum(l_scr[...], 1e-30)
+        o_ref[0] = (acc_scr[...] / l_safe).astype(o_ref.dtype)
+        # per-query logsumexp, saved for the backward kernels' recompute
+        lse_ref[0] = (m_scr[...] + jnp.log(l_safe))[:, 0]
 
 
-def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
-               block_k: int, scale: float, causal: bool, seq_k: int,
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+               dq_scr, *, block_k: int, scale: float, causal: bool,
                block_q: int, q_offset: int):
     from jax.experimental import pallas as pl
 
     j = pl.program_id(1)
-    q = q_ref[0]
-    do = do_ref[0]
-    lse = lse_ref[0][:, None]      # (BQ, 1) f32
-    delta = delta_ref[0][:, None]  # (BQ, 1) f32
-    bq = q.shape[0]
-    n_k = seq_k // block_k
-    if causal:
-        q_end = q_offset + (j + 1) * block_q - 1
-        n_loop = jnp.minimum(n_k, q_end // block_k + 1)
-    else:
-        n_loop = n_k
-    q_pos = (q_offset + j * block_q
-             + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0))
+    kk = pl.program_id(2)
+    n_k = pl.num_programs(2)
 
-    def body(kb, dq):
-        kblk = k_ref[0, pl.dslice(kb * block_k, block_k), :]
-        vblk = v_ref[0, pl.dslice(kb * block_k, block_k), :]
+    @pl.when(kk == 0)
+    def _init():
+        dq_scr[...] = jnp.zeros_like(dq_scr)
+
+    bq = q_ref.shape[1]
+    q_end = q_offset + (j + 1) * block_q - 1
+    live = True if not causal else kk * block_k <= q_end
+
+    @pl.when(live)
+    def _step():
+        q = q_ref[0]
+        do = do_ref[0]
+        lse = lse_ref[0][:, None]      # (BQ, 1) f32
+        delta = delta_ref[0][:, None]  # (BQ, 1) f32
+        kblk = k_ref[0]
+        vblk = v_ref[0]
         s = jax.lax.dot_general(
             q, kblk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
         p = jnp.exp(s - lse)  # rows already normalized via lse
         if causal:
-            k_pos = kb * block_k + jax.lax.broadcasted_iota(
+            q_pos = (q_offset + j * block_q
+                     + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k),
+                                                0))
+            k_pos = kk * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (bq, block_k), 1)
             p = jnp.where(q_pos >= k_pos, p, 0.0)
         dp = jax.lax.dot_general(   # dO @ V^T  (BQ, BK)
             do, vblk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
         ds = p * (dp - delta) * scale
-        return dq + jax.lax.dot_general(  # dS @ K  (BQ, d)
+        dq_scr[...] += jax.lax.dot_general(  # dS @ K  (BQ, d)
             ds.astype(kblk.dtype), kblk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
-    dq = jax.lax.fori_loop(0, n_loop, body,
-                           jnp.zeros(q.shape, jnp.float32))
-    dq_ref[0] = dq.astype(dq_ref.dtype)
+    @pl.when(kk == n_k - 1)
+    def _emit():
+        dq_ref[0] = dq_scr[...].astype(dq_ref.dtype)
 
 
 def _dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref, dk_ref,
-                dv_ref, *, block_q: int, scale: float, causal: bool,
-                seq_q: int, block_k: int, q_offset: int):
+                dv_ref, dk_scr, dv_scr, *, block_q: int, scale: float,
+                causal: bool, block_k: int, q_offset: int):
     from jax.experimental import pallas as pl
 
-    j = pl.program_id(1)  # k-block index
-    k = k_ref[0]  # (BK, d)
-    v = v_ref[0]
-    bk = k.shape[0]
-    n_q = seq_q // block_q
-    if causal:
-        # first q block whose last query can see this k block: queries at
-        # global position >= j*block_k, i.e. block index
-        # >= (j*block_k - q_offset) // block_q
-        start = jnp.maximum(0, (j * block_k - q_offset) // block_q)
-    else:
-        start = 0
-    k_pos = (j * block_k
-             + jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 1))
+    j = pl.program_id(1)   # k-block index
+    qq = pl.program_id(2)  # q-block index (innermost: Q/dO stream)
+    n_q = pl.num_programs(2)
 
-    def body(qb, carry):
-        dk, dv = carry
-        qblk = q_ref[0, pl.dslice(qb * block_q, block_q), :]
-        doblk = do_ref[0, pl.dslice(qb * block_q, block_q), :]
-        lse = lse_ref[0, pl.dslice(qb * block_q, block_q)][:, None]
-        delta = delta_ref[0, pl.dslice(qb * block_q, block_q)][:, None]
+    @pl.when(qq == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
+
+    bk = k_ref.shape[1]
+    # q block is live iff its last query can see this k block
+    q_last = q_offset + (qq + 1) * block_q - 1
+    live = True if not causal else q_last >= j * block_k
+
+    @pl.when(live)
+    def _step():
+        k = k_ref[0]  # (BK, d)
+        v = v_ref[0]
+        qblk = q_ref[0]
+        doblk = do_ref[0]
+        lse = lse_ref[0][:, None]
+        delta = delta_ref[0][:, None]
         s = jax.lax.dot_general(  # Q @ K^T  (BQ, BK)
             qblk, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
         p = jnp.exp(s - lse)
         if causal:
-            q_pos = (q_offset + qb * block_q
+            q_pos = (q_offset + qq * block_q
                      + jax.lax.broadcasted_iota(jnp.int32, (block_q, bk),
                                                 0))
+            k_pos = (j * block_k
+                     + jax.lax.broadcasted_iota(jnp.int32, (block_q, bk),
+                                                1))
             p = jnp.where(q_pos >= k_pos, p, 0.0)
-        pc = p.astype(doblk.dtype)
-        dv = dv + jax.lax.dot_general(  # P^T @ dO  (BK, d)
-            pc, doblk, (((0,), (0,)), ((), ())),
+        dv_scr[...] += jax.lax.dot_general(  # P^T @ dO  (BK, d)
+            p.astype(doblk.dtype), doblk, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(  # dO @ V^T  (BQ, BK)
             doblk, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
         ds = (p * (dp - delta) * scale).astype(qblk.dtype)
-        dk = dk + jax.lax.dot_general(  # dS^T @ Q  (BK, d)
+        dk_scr[...] += jax.lax.dot_general(  # dS^T @ Q  (BK, d)
             ds, qblk, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
-        return dk, dv
 
-    z = jnp.zeros(k.shape, jnp.float32)
-    dk, dv = jax.lax.fori_loop(start, n_q, body, (z, z))
-    dk_ref[0] = dk.astype(dk_ref.dtype)
-    dv_ref[0] = dv.astype(dv_ref.dtype)
+    @pl.when(qq == n_q - 1)
+    def _emit():
+        dk_ref[0] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
 
 
 def _pad_to(x, mult, axis):
@@ -287,6 +297,14 @@ def _pad_to(x, mult, axis):
 def _interpret() -> bool:
     # compiled Mosaic lowering on TPU; interpret mode elsewhere (tests)
     return jax.default_backend() != "tpu"
+
+
+def pltpu_scratch(shape):
+    """fp32 VMEM scratch (online-softmax state carried across the
+    innermost grid dimension)."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    return pltpu.VMEM(shape, jnp.float32)
 
 
 def _tileable(s_q, s_k, block_k) -> bool:
@@ -315,23 +333,27 @@ def _flash_fwd(q, k, v, causal: bool, block_q: int, block_k: int):
     sq, sk = qf.shape[1], kf.shape[1]
 
     kernel = functools.partial(_fwd_kernel, block_k=bk, scale=scale,
-                               causal=causal, seq_k=sk, block_q=bq,
+                               causal=causal, block_q=bq,
                                q_offset=s_k - s_q)
     out, lse = pl.pallas_call(
         kernel,
-        grid=(b * h, sq // bq),
+        grid=(b * h, sq // bq, sk // bk),
         in_specs=[
-            pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, sk, d), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((1, sk, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, bq, d), lambda i, j, kk: (i, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda i, j, kk: (i, kk, 0)),
+            pl.BlockSpec((1, bk, d), lambda i, j, kk: (i, kk, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, bq), lambda i, j: (i, j)),
+            pl.BlockSpec((1, bq, d), lambda i, j, kk: (i, j, 0)),
+            pl.BlockSpec((1, bq), lambda i, j, kk: (i, j)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
             jax.ShapeDtypeStruct((b * h, sq), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu_scratch((bq, 1)), pltpu_scratch((bq, 1)),
+            pltpu_scratch((bq, d)),
         ],
         interpret=_interpret(),
     )(qf, kf, vf)
@@ -368,43 +390,43 @@ def _flash_bwd(q, k, v, o, lse, g, causal: bool, block_q: int,
 
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, block_k=bk, scale=scale,
-                          causal=causal, seq_k=sk, block_q=bq,
-                          q_offset=q_offset),
-        grid=(b * h, sq // bq),
+                          causal=causal, block_q=bq, q_offset=q_offset),
+        grid=(b * h, sq // bq, sk // bk),
         in_specs=[
-            pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, sk, d), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((1, sk, d), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, bq), lambda i, j: (i, j)),
-            pl.BlockSpec((1, bq), lambda i, j: (i, j)),
+            pl.BlockSpec((1, bq, d), lambda i, j, kk: (i, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda i, j, kk: (i, kk, 0)),
+            pl.BlockSpec((1, bk, d), lambda i, j, kk: (i, kk, 0)),
+            pl.BlockSpec((1, bq, d), lambda i, j, kk: (i, j, 0)),
+            pl.BlockSpec((1, bq), lambda i, j, kk: (i, j)),
+            pl.BlockSpec((1, bq), lambda i, j, kk: (i, j)),
         ],
-        out_specs=pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0)),
+        out_specs=pl.BlockSpec((1, bq, d), lambda i, j, kk: (i, j, 0)),
         out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+        scratch_shapes=[pltpu_scratch((bq, d))],
         interpret=interpret,
     )(qf, kf, vf, dof, lse, delta)
 
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, block_q=bq, scale=scale,
-                          causal=causal, seq_q=sq, block_k=bk,
-                          q_offset=q_offset),
-        grid=(b * h, sk // bk),
+                          causal=causal, block_k=bk, q_offset=q_offset),
+        grid=(b * h, sk // bk, sq // bq),
         in_specs=[
-            pl.BlockSpec((1, bk, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, bk, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, sq, d), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((1, sq, d), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((1, sq), lambda i, j: (i, 0)),
-            pl.BlockSpec((1, sq), lambda i, j: (i, 0)),
+            pl.BlockSpec((1, bk, d), lambda i, j, qq: (i, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda i, j, qq: (i, j, 0)),
+            pl.BlockSpec((1, bq, d), lambda i, j, qq: (i, qq, 0)),
+            pl.BlockSpec((1, bq, d), lambda i, j, qq: (i, qq, 0)),
+            pl.BlockSpec((1, bq), lambda i, j, qq: (i, qq)),
+            pl.BlockSpec((1, bq), lambda i, j, qq: (i, qq)),
         ],
         out_specs=[
-            pl.BlockSpec((1, bk, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, bk, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda i, j, qq: (i, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda i, j, qq: (i, j, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b * h, sk, d), k.dtype),
             jax.ShapeDtypeStruct((b * h, sk, d), v.dtype),
         ],
+        scratch_shapes=[pltpu_scratch((bk, d)), pltpu_scratch((bk, d))],
         interpret=interpret,
     )(kf, vf, qf, dof, lse, delta)
 
