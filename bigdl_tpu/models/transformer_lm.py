@@ -89,5 +89,90 @@ class TransformerLM(Module):
         return jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1), state
 
 
+    # --------------------------------------------- autoregressive decoding
+    def _embed_at(self, params, tokens, pos0):
+        """Embed (b, s) tokens that sit at absolute positions pos0..pos0+s."""
+        h = self.emb.forward(params["emb"], tokens)
+        if self.compute_dtype is not None:
+            h = h.astype(self.compute_dtype)
+        h = h * (self.d_model ** 0.5)
+        table = jnp.asarray(self.pos._table)
+        pe = jax.lax.dynamic_slice_in_dim(table, pos0, tokens.shape[1], 0)
+        return h + pe.astype(h.dtype)
+
+    def _logits(self, params, h):
+        h = self.ln_f.forward(params["ln_f"], h)
+        if self.head is not None:
+            return self.head.forward(params["head"], h)
+        return h @ params["emb"]["weight"].astype(h.dtype).T
+
+    def generate(self, params, prompt, max_new_tokens: int,
+                 temperature: float = 0.0, top_k: Optional[int] = None,
+                 rng=None):
+        """KV-cache autoregressive decoding (the inference path of the
+        long-context flagship — no analog in the reference, whose only
+        generative path is SimpleRNN truncated BPTT).
+
+        ``prompt``: (b, s) int32 token ids. One full-prompt prefill builds
+        the per-layer K/V cache, then each new token is one O(1)-length
+        step against the cache. temperature 0 = greedy; otherwise
+        softmax-temperature sampling, optionally top-k truncated.
+        Returns (b, max_new_tokens) sampled ids. Jit-compiled; cache size
+        is the model's max_len, so prompt+new must fit in it.
+        """
+        prompt = jnp.asarray(prompt, jnp.int32)
+        b, s = prompt.shape
+        max_len = self.pos.max_len
+        if s + max_new_tokens > max_len:
+            raise ValueError(f"prompt ({s}) + max_new_tokens "
+                             f"({max_new_tokens}) exceeds max_len {max_len}")
+        if rng is None:
+            rng = jax.random.PRNGKey(0)
+
+        def sample(logits, key):
+            if temperature <= 0.0:
+                return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            logits = logits / temperature
+            if top_k is not None:
+                kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
+                logits = jnp.where(logits < kth, -1e30, logits)
+            return jax.random.categorical(key, logits).astype(jnp.int32)
+
+        cache_dtype = self.compute_dtype or jnp.float32
+
+        def run(params, prompt, rng):
+            cache = self.encoder.init_cache(b, max_len, cache_dtype)
+            h = self._embed_at(params, prompt, 0)
+            h, cache = self.encoder.prefill(params["encoder"], h, cache)
+            logits = self._logits(params, h[:, -1:, :])[:, 0, :]
+
+            def body(i, carry):
+                buf, cache, logits, rng = carry
+                rng, key = jax.random.split(rng)
+                tok = sample(logits.astype(jnp.float32), key)
+                buf = jax.lax.dynamic_update_slice_in_dim(
+                    buf, tok[:, None], i, axis=1)
+                h = self._embed_at(params, tok[:, None], s + i)
+                h, cache = self.encoder.decode_step(
+                    params["encoder"], h, cache, s + i)
+                logits = self._logits(params, h)[:, 0, :]
+                return buf, cache, logits, rng
+
+            buf = jnp.zeros((b, max_new_tokens), jnp.int32)
+            buf, _, _, _ = jax.lax.fori_loop(
+                0, max_new_tokens, body, (buf, cache, logits, rng))
+            return buf
+
+        # one compile per (shape, sampling) config — re-jitting a fresh
+        # closure every call would recompile every time
+        key = (b, s, max_new_tokens, temperature, top_k)
+        cache_attr = getattr(self, "_gen_jit_cache", None)
+        if cache_attr is None:
+            cache_attr = self._gen_jit_cache = {}
+        if key not in cache_attr:
+            cache_attr[key] = jax.jit(run)
+        return cache_attr[key](params, prompt, rng)
+
+
 def transformer_lm(vocab: int, **kw) -> TransformerLM:
     return TransformerLM(vocab, **kw)

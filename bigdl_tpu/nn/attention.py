@@ -194,6 +194,55 @@ class MultiHeadAttention(SimpleModule):
         o = self._merge_heads(o)
         return o @ params["wo"].astype(dt) + params["bo"].astype(dt)
 
+    # ----------------------------------------------- autoregressive decode
+    def init_cache(self, batch: int, max_len: int, dtype=jnp.float32):
+        shape = (batch, self.num_heads, max_len, self.head_dim)
+        return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+    def _qkv(self, params, x):
+        dt = x.dtype
+        q = x @ params["wq"].astype(dt) + params["bq"].astype(dt)
+        k = x @ params["wk"].astype(dt) + params["bk"].astype(dt)
+        v = x @ params["wv"].astype(dt) + params["bv"].astype(dt)
+        return map(self._split_heads, (q, k, v))
+
+    def prefill(self, params, x, cache):
+        """Full-prompt forward that also writes K/V into the cache
+        (positions 0..s-1). Returns (out, cache)."""
+        q, k, v = self._qkv(params, x)
+        o = self.attn_fn(q, k, v, causal=True, mask=None)
+        cache = {
+            "k": jax.lax.dynamic_update_slice(
+                cache["k"], k.astype(cache["k"].dtype), (0, 0, 0, 0)),
+            "v": jax.lax.dynamic_update_slice(
+                cache["v"], v.astype(cache["v"].dtype), (0, 0, 0, 0)),
+        }
+        dt = x.dtype
+        o = self._merge_heads(o)
+        return o @ params["wo"].astype(dt) + params["bo"].astype(dt), cache
+
+    def decode_step(self, params, x, cache, idx):
+        """One-token step: x (b, 1, d), ``idx`` = tokens already cached.
+        Appends this token's K/V at ``idx`` and attends over 0..idx."""
+        q, k, v = self._qkv(params, x)
+        kc = jax.lax.dynamic_update_slice(
+            cache["k"], k.astype(cache["k"].dtype), (0, 0, idx, 0))
+        vc = jax.lax.dynamic_update_slice(
+            cache["v"], v.astype(cache["v"].dtype), (0, 0, idx, 0))
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, kc.astype(q.dtype),
+                       preferred_element_type=jnp.float32)
+        s = s / (self.head_dim ** 0.5)
+        live = jnp.arange(kc.shape[2])[None, None, None, :] <= idx
+        s = jnp.where(live, s, -1e30)
+        p = jax.nn.softmax(s, axis=-1)
+        o = jnp.einsum("bhqk,bhkd->bhqd", p.astype(q.dtype),
+                       vc.astype(q.dtype),
+                       preferred_element_type=jnp.float32).astype(x.dtype)
+        dt = x.dtype
+        o = self._merge_heads(o)
+        return (o @ params["wo"].astype(dt) + params["bo"].astype(dt),
+                {"k": kc, "v": vc})
+
 
 class PositionalEncoding(SimpleModule):
     """Sinusoidal positional encoding added to (batch, seq, d_model).
@@ -300,6 +349,27 @@ class TransformerEncoderLayer(Module):
         y = x + h
         return (y if mask is None else (y, mask)), state
 
+    # ----------------------------------------------- autoregressive decode
+    def init_cache(self, batch, max_len, dtype=jnp.float32):
+        return self.mha.init_cache(batch, max_len, dtype)
+
+    def _mlp(self, params, x):
+        dt = x.dtype
+        h = self.ln2.forward(params["ln2"], x)
+        h = h @ params["w1"].astype(dt) + params["b1"].astype(dt)
+        h = jax.nn.gelu(h)
+        return x + (h @ params["w2"].astype(dt) + params["b2"].astype(dt))
+
+    def prefill(self, params, x, cache):
+        h = self.ln1.forward(params["ln1"], x)
+        h, cache = self.mha.prefill(params["mha"], h, cache)
+        return self._mlp(params, x + h), cache
+
+    def decode_step(self, params, x, cache, idx):
+        h = self.ln1.forward(params["ln1"], x)
+        h, cache = self.mha.decode_step(params["mha"], h, cache, idx)
+        return self._mlp(params, x + h), cache
+
 
 class TransformerEncoder(Sequential):
     """Stack of encoder layers with optional remat.
@@ -335,3 +405,22 @@ class TransformerEncoder(Sequential):
             x, s = fn(params[k], state[k], x, r)
             new_state[k] = s
         return x, new_state
+
+    # ----------------------------------------------- autoregressive decode
+    def init_cache(self, batch, max_len, dtype=jnp.float32):
+        return {str(i): m.init_cache(batch, max_len, dtype)
+                for i, m in enumerate(self._modules)}
+
+    def prefill(self, params, x, cache):
+        new = {}
+        for i, m in enumerate(self._modules):
+            k = str(i)
+            x, new[k] = m.prefill(params[k], x, cache[k])
+        return x, new
+
+    def decode_step(self, params, x, cache, idx):
+        new = {}
+        for i, m in enumerate(self._modules):
+            k = str(i)
+            x, new[k] = m.decode_step(params[k], x, cache[k], idx)
+        return x, new
