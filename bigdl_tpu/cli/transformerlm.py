@@ -33,7 +33,24 @@ def main(argv=None):
                     help="jax.checkpoint each block (HBM for FLOPs)")
     tr.add_argument("--bf16", action="store_true")
     tr.add_argument("--accumSteps", type=int, default=1)
+    ge = sub.add_parser("generate",
+                        help="sample from a trained checkpoint (KV-cache "
+                             "decode)")
+    common.add_test_args(ge)
+    for flag, typ, dv in (("--vocabSize", int, 4000), ("--seqLength", int,
+                          128), ("--dModel", int, 128), ("--numLayers",
+                          int, 2), ("--numHeads", int, 4)):
+        ge.add_argument(flag, type=typ, default=dv)
+    ge.add_argument("--prompt", default="the ",
+                    help="prompt text (tokenized with the corpus dict)")
+    ge.add_argument("--numTokens", type=int, default=64)
+    ge.add_argument("--temperature", type=float, default=0.8)
+    ge.add_argument("--topK", type=int, default=40)
+    ge.add_argument("--seed", type=int, default=0)
     args = p.parse_args(argv)
+
+    if args.cmd == "generate":
+        return _generate(args)
 
     import numpy as np
     import jax.numpy as jnp
@@ -86,6 +103,38 @@ def main(argv=None):
     nll = -np.mean(np.take_along_axis(lp, y_val[..., None], axis=-1))
     print(f"perplexity is {math.exp(nll):.2f}")
     return trained
+
+
+def _generate(args):
+    """Sample continuations from a trained LM (reference rnn/Test.scala
+    samples from the trained SimpleRNN the same way: seed text -> ids ->
+    iterative next-token -> words)."""
+    import jax
+    import numpy as np
+
+    from bigdl_tpu.cli import common
+    from bigdl_tpu.dataset.text import Dictionary, tokenize
+    from bigdl_tpu.models import transformer_lm
+
+    path = os.path.join(args.folder, "input.txt")
+    with open(path) as f:
+        tokens = tokenize(f.read())
+    d = Dictionary([tokens], vocab_size=args.vocabSize)
+
+    model = transformer_lm(
+        len(d), d_model=args.dModel, num_layers=args.numLayers,
+        num_heads=args.numHeads, max_len=args.seqLength)
+    params, _ = common.load_trained(model, args.model)
+
+    prompt_ids = np.asarray([d.ids(tokenize(args.prompt))], np.int32)
+    if prompt_ids.shape[1] == 0:
+        raise SystemExit("empty prompt after tokenization")
+    out = model.generate(params, prompt_ids, args.numTokens,
+                         temperature=args.temperature, top_k=args.topK,
+                         rng=jax.random.PRNGKey(args.seed))
+    words = [d.id2word.get(int(i), "<unk>") for i in np.asarray(out)[0]]
+    print(args.prompt + " ".join(words))
+    return words
 
 
 if __name__ == "__main__":

@@ -133,7 +133,7 @@ class TransformerLM(Module):
             if temperature <= 0.0:
                 return jnp.argmax(logits, axis=-1).astype(jnp.int32)
             logits = logits / temperature
-            if top_k is not None:
+            if top_k is not None and top_k < self.vocab:
                 kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
                 logits = jnp.where(logits < kth, -1e30, logits)
             return jax.random.categorical(key, logits).astype(jnp.int32)

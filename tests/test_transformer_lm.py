@@ -112,6 +112,27 @@ def test_transformerlm_cli(tmp_path, capsys):
     assert "perplexity is" in capsys.readouterr().out
 
 
+def test_transformerlm_cli_generate(tmp_path, capsys):
+    """train -> checkpoint -> generate subcommand (KV-cache sampling)."""
+    from bigdl_tpu.cli import transformerlm
+
+    data = tmp_path / "corpus"
+    data.mkdir()
+    words = [f"w{i}" for i in range(6)]
+    (data / "input.txt").write_text(" ".join(words * 120))
+    ck = str(tmp_path / "ck")
+    transformerlm.main([
+        "train", "-f", str(data), "-b", "8", "--maxEpoch", "1",
+        "--seqLength", "12", "--dModel", "32", "--numLayers", "1",
+        "--logEvery", "1000", "--checkpoint", ck])
+    out = transformerlm.main([
+        "generate", "-f", str(data), "--model", ck, "--seqLength", "12",
+        "--dModel", "32", "--numLayers", "1", "--prompt", "w1 w2",
+        "--numTokens", "5", "--seed", "1"])
+    assert len(out) == 5
+    assert "w1 w2" in capsys.readouterr().out
+
+
 def test_generate_kv_cache_matches_full_forward_greedy():
     """KV-cache decode must reproduce exactly what full re-forward greedy
     decoding produces — the strongest equivalence check on the cache
