@@ -1,4 +1,7 @@
-from bigdl_tpu.optim.method import OptimMethod, SGD, Adagrad, Adam, RMSprop
+from bigdl_tpu.optim.method import (
+    OptimMethod, SGD, Adagrad, Adam, AdamW, LARS, RMSprop,
+    clip_by_global_norm, clip_by_value,
+)
 from bigdl_tpu.optim.schedules import (
     LearningRateSchedule, Default, Poly, Step, EpochDecay, EpochStep,
     Regime, EpochSchedule,

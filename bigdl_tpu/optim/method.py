@@ -224,3 +224,102 @@ class RMSprop(OptimMethod):
         return (jax.tree_util.tree_map(lambda t: t[0], out, is_leaf=is_t),
                 {"step": opt_state["step"] + 1, "epoch": opt_state["epoch"],
                  "sq": jax.tree_util.tree_map(lambda t: t[1], out, is_leaf=is_t)})
+
+
+class AdamW(Adam):
+    """Adam with decoupled weight decay (Loshchilov & Hutter) — the wd
+    term scales the weight directly instead of entering the moments.
+    Beyond the reference; same OptimMethod shape."""
+
+    def update(self, grads, opt_state, params):
+        t = opt_state["step"] + 1
+        lr = self.schedule(self.base_lr, opt_state["step"],
+                           opt_state["epoch"])
+        b1, b2 = self.beta1, self.beta2
+        bc1 = 1.0 - jnp.power(b1, t)
+        bc2 = 1.0 - jnp.power(b2, t)
+
+        def one(g, w, m, v):
+            m_new = b1 * m + (1 - b1) * g
+            v_new = b2 * v + (1 - b2) * jnp.square(g)
+            upd = (m_new / bc1) / (jnp.sqrt(v_new / bc2) + self.eps)
+            return w - lr * (upd + self.weight_decay * w), m_new, v_new
+
+        out = jax.tree_util.tree_map(one, grads, params,
+                                     opt_state["m"], opt_state["v"])
+        is_t = lambda t_: isinstance(t_, tuple)
+        pick = lambda i: jax.tree_util.tree_map(lambda t_: t_[i], out,
+                                                is_leaf=is_t)
+        return pick(0), {"step": t, "epoch": opt_state["epoch"],
+                         "m": pick(1), "v": pick(2)}
+
+
+class LARS(OptimMethod):
+    """Layer-wise Adaptive Rate Scaling (You et al.) — the large-batch
+    ImageNet optimizer: each layer's step is scaled by
+    trust * ||w|| / (||g|| + wd*||w|| + eps), then momentum-SGD applies.
+    Bias/BN leaves (ndim <= 1) skip both adaptation and weight decay, the
+    standard exclusion. Pairs with the b512+ batch sizes the v5e MFU
+    trajectory targets (PERF.md)."""
+
+    def __init__(self, learning_rate: float = 1.0, momentum: float = 0.9,
+                 weight_decay: float = 0.0, trust: float = 0.001,
+                 eps: float = 1e-9,
+                 schedule: Optional[LearningRateSchedule] = None):
+        self.base_lr = learning_rate
+        self.momentum = momentum
+        self.weight_decay = weight_decay
+        self.trust = trust
+        self.eps = eps
+        self.schedule = schedule if schedule is not None else Default(0.0)
+
+    def init(self, params):
+        return {"step": jnp.zeros((), jnp.float32),
+                "epoch": jnp.zeros((), jnp.float32),
+                "velocity": jax.tree_util.tree_map(jnp.zeros_like, params)}
+
+    def learning_rate(self, opt_state):
+        return self.schedule(self.base_lr, opt_state["step"],
+                             opt_state["epoch"])
+
+    def update(self, grads, opt_state, params):
+        lr = self.learning_rate(opt_state)
+        mu, wd = self.momentum, self.weight_decay
+
+        def one(g, w, v):
+            if w.ndim <= 1:  # bias/BN: plain momentum SGD, no wd/adaptation
+                v_new = mu * v + g
+                return w - lr * v_new, v_new
+            wn = jnp.sqrt(jnp.sum(jnp.square(w)))
+            gn = jnp.sqrt(jnp.sum(jnp.square(g)))
+            local = jnp.where(
+                (wn > 0) & (gn > 0),
+                self.trust * wn / (gn + wd * wn + self.eps), 1.0)
+            v_new = mu * v + local * (g + wd * w)
+            return w - lr * v_new, v_new
+
+        out = jax.tree_util.tree_map(one, grads, params,
+                                     opt_state["velocity"])
+        is_t = lambda t: isinstance(t, tuple)
+        return (jax.tree_util.tree_map(lambda t: t[0], out, is_leaf=is_t),
+                {"step": opt_state["step"] + 1, "epoch": opt_state["epoch"],
+                 "velocity": jax.tree_util.tree_map(lambda t: t[1], out,
+                                                    is_leaf=is_t)})
+
+
+def clip_by_global_norm(grads, max_norm: float):
+    """Scale the whole gradient pytree so its global L2 norm <= max_norm
+    (reference Optimizer.setGradientClippingByl2Norm — the later-BigDL API
+    the Optimizer facade mirrors)."""
+    sq = sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+             for g in jax.tree_util.tree_leaves(grads))
+    norm = jnp.sqrt(sq)
+    scale = jnp.minimum(1.0, max_norm / jnp.maximum(norm, 1e-12))
+    return jax.tree_util.tree_map(lambda g: (g * scale).astype(g.dtype),
+                                  grads), norm
+
+
+def clip_by_value(grads, lo: float, hi: float):
+    """Elementwise constant clipping (reference
+    Optimizer.setConstantGradientClipping)."""
+    return jax.tree_util.tree_map(lambda g: jnp.clip(g, lo, hi), grads)

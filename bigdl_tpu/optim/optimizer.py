@@ -131,6 +131,20 @@ class Optimizer:
         self._ckpt_sharded = sharded
         return self
 
+    def set_gradient_clipping_by_l2_norm(self, max_norm: float
+                                         ) -> "Optimizer":
+        """Global-L2-norm gradient clipping before the optimizer update
+        (reference Optimizer.setGradientClippingByl2Norm)."""
+        self._clip_norm = float(max_norm)
+        return self
+
+    def set_constant_gradient_clipping(self, lo: float, hi: float
+                                       ) -> "Optimizer":
+        """Elementwise gradient clipping to [lo, hi] (reference
+        Optimizer.setConstantGradientClipping)."""
+        self._clip_const = (float(lo), float(hi))
+        return self
+
     def set_state(self, params=None, mod_state=None,
                   opt_state=None) -> "Optimizer":
         """Warm-start from explicit pytrees (reference setState :66 +
@@ -231,6 +245,14 @@ class Optimizer:
                 loss = loss / accum
             if self.strategy is not None:
                 grads, loss = self.strategy.reduce_grads(grads, loss)
+            clip_const = getattr(self, "_clip_const", None)
+            if clip_const is not None:
+                from bigdl_tpu.optim.method import clip_by_value
+                grads = clip_by_value(grads, *clip_const)
+            clip_norm = getattr(self, "_clip_norm", None)
+            if clip_norm is not None:
+                from bigdl_tpu.optim.method import clip_by_global_norm
+                grads, _ = clip_by_global_norm(grads, clip_norm)
             new_params, new_opt = opt.update(grads, opt_state, params)
             return new_params, new_ms, new_opt, loss
 

@@ -149,3 +149,73 @@ def test_metrics():
     m.add("computing time", 3.0)
     assert m.mean("computing time") == 2.0
     assert "computing time" in m.summary()
+
+
+def test_adamw_decoupled_decay():
+    """AdamW wd must scale the weight directly (decoupled), not flow
+    through the moments: with zero grads, params shrink by lr*wd each
+    step while Adam-with-wd would move differently."""
+    import jax
+    import jax.numpy as jnp
+
+    from bigdl_tpu.optim import AdamW
+
+    opt = AdamW(learning_rate=0.1, weight_decay=0.5)
+    p = {"w": jnp.ones((3,))}
+    st = opt.init(p)
+    g = {"w": jnp.zeros((3,))}
+    p2, st = opt.update(g, st, p)
+    np.testing.assert_allclose(np.asarray(p2["w"]),
+                               np.ones(3) * (1 - 0.1 * 0.5), rtol=1e-6)
+
+
+def test_lars_layerwise_trust_ratio():
+    """LARS scales each matrix layer's step by trust*||w||/||g|| (wd=0)
+    and leaves 1-D leaves as plain momentum SGD."""
+    import jax.numpy as jnp
+
+    from bigdl_tpu.optim import LARS
+
+    opt = LARS(learning_rate=1.0, momentum=0.0, trust=0.01)
+    w = jnp.full((2, 2), 3.0)          # ||w|| = 6
+    b = jnp.full((2,), 3.0)
+    g = jnp.full((2, 2), 1.5)          # ||g|| = 3
+    gb = jnp.full((2,), 0.5)
+    p = {"w": w, "b": b}
+    st = opt.init(p)
+    p2, _ = opt.update({"w": g, "b": gb}, st, p)
+    # local lr = 0.01 * 6/3 = 0.02 -> step = 0.02 * 1.5 = 0.03
+    np.testing.assert_allclose(np.asarray(p2["w"]), 3.0 - 0.03, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(p2["b"]), 3.0 - 0.5, rtol=1e-6)
+
+
+def test_gradient_clipping_in_optimizer():
+    """Both clipping modes through the Optimizer facade (reference
+    setGradientClippingByl2Norm / setConstantGradientClipping)."""
+    import jax.numpy as jnp
+
+    from bigdl_tpu import nn
+    from bigdl_tpu.core import Sequential
+    from bigdl_tpu.dataset import BatchDataSet
+    from bigdl_tpu.optim import (Optimizer, SGD, Trigger,
+                                 clip_by_global_norm, clip_by_value)
+
+    g = {"a": jnp.asarray([3.0, 4.0])}   # norm 5
+    clipped, norm = clip_by_global_norm(g, 1.0)
+    np.testing.assert_allclose(float(norm), 5.0, rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(clipped["a"]), [0.6, 0.8],
+                               rtol=1e-5)
+    cv = clip_by_value({"a": jnp.asarray([-2.0, 2.0])}, -1.0, 1.0)
+    np.testing.assert_allclose(np.asarray(cv["a"]), [-1.0, 1.0])
+
+    # e2e: huge lr + tight clip must stay finite
+    x = np.random.RandomState(0).randn(32, 4).astype(np.float32) * 100
+    y = np.random.RandomState(1).randint(0, 2, 32).astype(np.int32)
+    model = Sequential(nn.Linear(4, 2), nn.LogSoftMax())
+    opt = (Optimizer(model, BatchDataSet(x, y, 16), nn.ClassNLLCriterion())
+           .set_optim_method(SGD(learning_rate=1.0))
+           .set_end_when(Trigger.max_iteration(5))
+           .set_gradient_clipping_by_l2_norm(0.1))
+    t = opt.optimize()
+    for leaf in jax.tree_util.tree_leaves(t.params):
+        assert np.all(np.isfinite(np.asarray(leaf)))
