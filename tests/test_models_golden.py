@@ -243,6 +243,51 @@ _T_V1_CFG = [
 ]
 
 
+class TInceptionModuleBN(tnn.Module):
+    """BN-Inception 4-branch module: conv(no bias) + BN + ReLU per conv,
+    branches registered b1..b4 (mirrors inception_module(with_bn=True))."""
+
+    def __init__(self, cin, config):
+        super().__init__()
+        (c1,), (c3r, c3), (c5r, c5), (cp,) = config
+
+        def cbr(ci, co, k, p=0):
+            return [tnn.Conv2d(ci, co, k, 1, p, bias=False),
+                    tnn.BatchNorm2d(co, eps=1e-3), tnn.ReLU()]
+
+        self.b1 = tnn.Sequential(*cbr(cin, c1, 1))
+        self.b2 = tnn.Sequential(*cbr(cin, c3r, 1), *cbr(c3r, c3, 3, 1))
+        self.b3 = tnn.Sequential(*cbr(cin, c5r, 1), *cbr(c5r, c5, 5, 2))
+        self.b4 = tnn.Sequential(tnn.MaxPool2d(3, 1, 1, ceil_mode=True),
+                                 *cbr(cin, cp, 1))
+
+    def forward(self, x):
+        return torch.cat([self.b1(x), self.b2(x), self.b3(x), self.b4(x)],
+                         dim=1)
+
+
+def torch_inception_v2(n_cls):
+    def cbr(ci, co, k, s=1, p=0):
+        return [tnn.Conv2d(ci, co, k, s, p, bias=False),
+                tnn.BatchNorm2d(co, eps=1e-3), tnn.ReLU()]
+
+    cfg = dict((k, (cin, c)) for k, cin, c in _T_V1_CFG)
+    mods = (cbr(3, 64, 7, 2, 3)
+            + [tnn.MaxPool2d(3, 2, ceil_mode=True)]
+            + cbr(64, 64, 1) + cbr(64, 192, 3, 1, 1)
+            + [tnn.MaxPool2d(3, 2, ceil_mode=True),
+               TInceptionModuleBN(*cfg["3a"]), TInceptionModuleBN(*cfg["3b"]),
+               tnn.MaxPool2d(3, 2, ceil_mode=True),
+               TInceptionModuleBN(*cfg["4a"]), TInceptionModuleBN(*cfg["4b"]),
+               TInceptionModuleBN(*cfg["4c"]), TInceptionModuleBN(*cfg["4d"]),
+               TInceptionModuleBN(*cfg["4e"]),
+               tnn.MaxPool2d(3, 2, ceil_mode=True),
+               TInceptionModuleBN(*cfg["5a"]), TInceptionModuleBN(*cfg["5b"]),
+               tnn.AvgPool2d(7, 1), tnn.Flatten(),
+               tnn.Linear(1024, n_cls), tnn.LogSoftmax(dim=-1)])
+    return tnn.Sequential(*mods)
+
+
 def torch_inception_v1(n_cls):
     cfg = dict((k, (cin, c)) for k, cin, c in _T_V1_CFG)
     mods = [
@@ -285,6 +330,15 @@ def test_inception_v1_golden():
     x 4 branches each, ceil-mode pools, LRN placement (reference
     InceptionSpec.scala)."""
     _compare(inception_v1_no_aux(17), torch_inception_v1(17), (224, 224))
+
+
+def test_inception_v2_golden():
+    """BN-Inception: BatchNorm running-stat wiring inside every Concat
+    branch — the other construction-order hazard (reference
+    InceptionSpec.scala v2 path)."""
+    from bigdl_tpu.models import inception_v2
+
+    _compare(inception_v2(17), torch_inception_v2(17), (224, 224))
 
 
 def test_alexnet_golden():
