@@ -323,3 +323,34 @@ def clip_by_value(grads, lo: float, hi: float):
     """Elementwise constant clipping (reference
     Optimizer.setConstantGradientClipping)."""
     return jax.tree_util.tree_map(lambda g: jnp.clip(g, lo, hi), grads)
+
+
+class EMA(OptimMethod):
+    """Exponential moving average of the weights, wrapped around any
+    inner OptimMethod: ema = decay*ema + (1-decay)*w after each update
+    (seeded from the init weights, so no debias term is needed).
+    Evaluate with :meth:`ema_params` — the standard eval-smoothing trick;
+    beyond the reference."""
+
+    def __init__(self, inner: OptimMethod, decay: float = 0.999):
+        self.inner = inner
+        self.decay = decay
+        self.schedule = getattr(inner, "schedule", None)
+
+    def init(self, params):
+        return {"inner": self.inner.init(params),
+                "ema": jax.tree_util.tree_map(jnp.array, params)}
+
+    def learning_rate(self, opt_state):
+        return self.inner.learning_rate(opt_state["inner"])
+
+    def update(self, grads, opt_state, params):
+        new_p, inner_st = self.inner.update(grads, opt_state["inner"],
+                                            params)
+        d = self.decay
+        ema = jax.tree_util.tree_map(
+            lambda e, w: d * e + (1 - d) * w, opt_state["ema"], new_p)
+        return new_p, {"inner": inner_st, "ema": ema}
+
+    def ema_params(self, opt_state):
+        return opt_state["ema"]

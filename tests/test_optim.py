@@ -219,3 +219,24 @@ def test_gradient_clipping_in_optimizer():
     t = opt.optimize()
     for leaf in jax.tree_util.tree_leaves(t.params):
         assert np.all(np.isfinite(np.asarray(leaf)))
+
+
+def test_ema_wrapper_tracks_weights():
+    """EMA(SGD): inner updates unchanged; shadow weights converge toward
+    the current weights at rate (1-decay)."""
+    import jax.numpy as jnp
+
+    from bigdl_tpu.optim import EMA, SGD
+
+    inner = SGD(learning_rate=0.5)
+    opt = EMA(inner, decay=0.5)
+    p = {"w": jnp.asarray([1.0])}
+    st = opt.init(p)
+    g = {"w": jnp.asarray([1.0])}
+    p, st = opt.update(g, st, p)          # w: 1 -> 0.5
+    np.testing.assert_allclose(np.asarray(p["w"]), [0.5])
+    # ema = 0.5*1.0 + 0.5*0.5 = 0.75
+    np.testing.assert_allclose(np.asarray(opt.ema_params(st)["w"]), [0.75])
+    p, st = opt.update(g, st, p)          # w: 0.5 -> 0.0
+    np.testing.assert_allclose(np.asarray(opt.ema_params(st)["w"]),
+                               [0.375])  # 0.5*0.75 + 0.5*0.0
