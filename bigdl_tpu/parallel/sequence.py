@@ -32,9 +32,17 @@ _NEG_INF = -1e30  # finite mask value: keeps exp() well-defined in blocks
                   # that are entirely masked out (true -inf would NaN)
 
 
-def ring_attention(q, k, v, *, axis_name: str, causal: bool = False):
+def ring_attention(q, k, v, *, axis_name: str, causal: bool = False,
+                   block_k: int = 512):
     """Blockwise ring attention. Must run inside shard_map with the seq
     dimension of q/k/v (shape ...,(b,h,s_local,d)) sharded on ``axis_name``.
+
+    ``block_k`` bounds the score-tile width *within* each ring hop: the
+    arriving K/V chunk is folded through the online softmax in sub-blocks
+    (under ``jax.checkpoint``), so peak memory is O(s_local x block_k)
+    instead of O(s_local^2) — at 8-way sequence parallel over a 128k
+    context the local chunk is 16k and a dense per-hop tile would be
+    16k x 16k per head.
     """
     n = jax.lax.psum(1, axis_name)
     my = jax.lax.axis_index(axis_name)
@@ -45,17 +53,39 @@ def ring_attention(q, k, v, *, axis_name: str, causal: bool = False):
     # global positions of my q rows
     q_pos = my * s_q + jnp.arange(s_q)
 
+    bk = min(block_k, s_k)
+    n_sub = s_k // bk if s_k % bk == 0 else 1
+    if n_sub == 1:
+        bk = s_k
+
+    def fold_chunk(src, kb, vb, m, l, o):
+        """Fold one arriving (s_local, d) K/V chunk, sub-block by
+        sub-block, into the streaming softmax state."""
+        kbs = kb.reshape(kb.shape[:-2] + (n_sub, bk, kb.shape[-1]))
+        vbs = vb.reshape(vb.shape[:-2] + (n_sub, bk, vb.shape[-1]))
+        kbs = jnp.moveaxis(kbs, -3, 0)
+        vbs = jnp.moveaxis(vbs, -3, 0)
+
+        @jax.checkpoint
+        def sub(carry, blk):
+            m, l, o, j = carry
+            kj, vj = blk
+            valid = None
+            if causal:
+                k_pos = src * s_k + j * bk + jnp.arange(bk)
+                valid = q_pos[:, None] >= k_pos[None, :]
+            m, l, o = online_softmax_update(q, kj, vj, m, l, o, scale,
+                                            valid)
+            return (m, l, o, j + 1), None
+
+        (m, l, o, _), _ = jax.lax.scan(sub, (m, l, o, 0), (kbs, vbs))
+        return m, l, o
+
     def step(carry, t):
         kb, vb, m, l, o = carry
         # after t hops of "send to next", I hold the block born on (my - t)
         src = (my - t) % n
-        valid = None
-        if causal:
-            k_pos = src * s_k + jnp.arange(s_k)
-            valid = q_pos[:, None] >= k_pos[None, :]
-        # shared streaming-softmax block update (bf16 multiply on the MXU,
-        # fp32 stats — same numerics as the dense path)
-        m, l, o = online_softmax_update(q, kb, vb, m, l, o, scale, valid)
+        m, l, o = fold_chunk(src, kb, vb, m, l, o)
         perm = [(i, (i + 1) % n) for i in range(n)]
         kb = jax.lax.ppermute(kb, axis_name, perm)
         vb = jax.lax.ppermute(vb, axis_name, perm)
@@ -73,7 +103,8 @@ def _scan_steps(step, carry, n):
 
 
 def make_ring_attention(mesh: Mesh, seq_axis: str = "seq",
-                        batch_axis: Optional[str] = None):
+                        batch_axis: Optional[str] = None,
+                        block_k: int = 512):
     """Wrap :func:`ring_attention` in shard_map so it can be passed directly
     as ``attn_impl`` to MultiHeadAttention. q/k/v are (b, h, s, d); s is
     sharded on ``seq_axis`` (and b on ``batch_axis`` when given)."""
@@ -84,7 +115,7 @@ def make_ring_attention(mesh: Mesh, seq_axis: str = "seq",
             raise NotImplementedError(
                 "ring attention supports causal masking only")
         fn = functools.partial(ring_attention, axis_name=seq_axis,
-                               causal=causal)
+                               causal=causal, block_k=block_k)
         return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
                              out_specs=spec, check_vma=False)(q, k, v)
 
