@@ -32,6 +32,7 @@ class TransformerLM(Module):
                  max_len: int = 2048, dropout: float = 0.0,
                  attn_impl=None, remat: bool = False,
                  tie_embeddings: bool = True, compute_dtype=None,
+                 num_kv_heads: Optional[int] = None,
                  name: Optional[str] = None):
         super().__init__(name or "TransformerLM")
         self.vocab = vocab
@@ -44,7 +45,8 @@ class TransformerLM(Module):
         self.pos = nn.PositionalEncoding(d_model, max_len)
         self.encoder = nn.TransformerEncoder(
             num_layers, d_model, num_heads, d_ff, causal=True,
-            dropout=dropout, attn_impl=attn_impl, remat=remat)
+            dropout=dropout, attn_impl=attn_impl, remat=remat,
+            num_kv_heads=num_kv_heads)
         self.ln_f = nn.LayerNorm(d_model)
         self.head = None if tie_embeddings else nn.Linear(d_model, vocab)
 

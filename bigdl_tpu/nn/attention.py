@@ -131,6 +131,7 @@ class MultiHeadAttention(SimpleModule):
         num_heads: int,
         causal: bool = False,
         attn_impl: Optional[AttnFn | str] = None,
+        num_kv_heads: Optional[int] = None,
         param_dtype=jnp.float32,
         name: Optional[str] = None,
     ):
@@ -141,6 +142,14 @@ class MultiHeadAttention(SimpleModule):
         self.d_model = d_model
         self.num_heads = num_heads
         self.head_dim = d_model // num_heads
+        # grouped-query attention: K/V projected to num_kv_heads heads
+        # and broadcast over num_heads//num_kv_heads query groups
+        # (num_kv_heads=1 is multi-query attention); shrinks the KV cache
+        # and the K/V projection FLOPs by the group factor
+        self.num_kv_heads = num_kv_heads or num_heads
+        if num_heads % self.num_kv_heads:
+            raise ValueError(f"num_heads {num_heads} not divisible by "
+                             f"num_kv_heads {self.num_kv_heads}")
         self.causal = causal
         self.param_dtype = param_dtype
         if attn_impl == "flash":
@@ -154,20 +163,29 @@ class MultiHeadAttention(SimpleModule):
     def init(self, rng):
         ks = jax.random.split(rng, 4)
         d = self.d_model
-        mk = lambda k: xavier_uniform(k, (d, d), d, d, self.param_dtype)
+        dkv = self.num_kv_heads * self.head_dim
+        mk = lambda k, dout: xavier_uniform(k, (d, dout), d, dout,
+                                            self.param_dtype)
         return {
-            "wq": mk(ks[0]), "wk": mk(ks[1]), "wv": mk(ks[2]),
-            "wo": mk(ks[3]),
+            "wq": mk(ks[0], d), "wk": mk(ks[1], dkv), "wv": mk(ks[2], dkv),
+            "wo": mk(ks[3], d),
             "bq": jnp.zeros((d,), self.param_dtype),
-            "bk": jnp.zeros((d,), self.param_dtype),
-            "bv": jnp.zeros((d,), self.param_dtype),
+            "bk": jnp.zeros((dkv,), self.param_dtype),
+            "bv": jnp.zeros((dkv,), self.param_dtype),
             "bo": jnp.zeros((d,), self.param_dtype),
         }
 
-    def _split_heads(self, x):
-        b, s, _ = x.shape
-        return x.reshape(b, s, self.num_heads, self.head_dim).transpose(
-            0, 2, 1, 3)
+    def _split_heads(self, x, n_heads: Optional[int] = None):
+        b, s, f = x.shape
+        n = n_heads or self.num_heads
+        return x.reshape(b, s, n, f // n).transpose(0, 2, 1, 3)
+
+    def _expand_kv(self, kv):
+        """Broadcast (b, n_kv, s, d) K/V over the query groups."""
+        g = self.num_heads // self.num_kv_heads
+        if g == 1:
+            return kv
+        return jnp.repeat(kv, g, axis=1)
 
     def _merge_heads(self, x):
         b, h, s, d = x.shape
@@ -187,7 +205,9 @@ class MultiHeadAttention(SimpleModule):
         q = q_in @ params["wq"].astype(dt) + params["bq"].astype(dt)
         k = kv_in @ params["wk"].astype(dt) + params["bk"].astype(dt)
         v = kv_in @ params["wv"].astype(dt) + params["bv"].astype(dt)
-        q, k, v = map(self._split_heads, (q, k, v))
+        q = self._split_heads(q)
+        k = self._expand_kv(self._split_heads(k, self.num_kv_heads))
+        v = self._expand_kv(self._split_heads(v, self.num_kv_heads))
         if mask is not None and mask.ndim == 2:  # (b, s_k) key-padding
             mask = mask[:, None, None, :]
         o = self.attn_fn(q, k, v, causal=self.causal, mask=mask)
@@ -196,7 +216,8 @@ class MultiHeadAttention(SimpleModule):
 
     # ----------------------------------------------- autoregressive decode
     def init_cache(self, batch: int, max_len: int, dtype=jnp.float32):
-        shape = (batch, self.num_heads, max_len, self.head_dim)
+        # GQA: the cache stores only num_kv_heads heads — the memory win
+        shape = (batch, self.num_kv_heads, max_len, self.head_dim)
         return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
 
     def _qkv(self, params, x):
@@ -204,13 +225,16 @@ class MultiHeadAttention(SimpleModule):
         q = x @ params["wq"].astype(dt) + params["bq"].astype(dt)
         k = x @ params["wk"].astype(dt) + params["bk"].astype(dt)
         v = x @ params["wv"].astype(dt) + params["bv"].astype(dt)
-        return map(self._split_heads, (q, k, v))
+        return (self._split_heads(q),
+                self._split_heads(k, self.num_kv_heads),
+                self._split_heads(v, self.num_kv_heads))
 
     def prefill(self, params, x, cache):
         """Full-prompt forward that also writes K/V into the cache
         (positions 0..s-1). Returns (out, cache)."""
         q, k, v = self._qkv(params, x)
-        o = self.attn_fn(q, k, v, causal=True, mask=None)
+        o = self.attn_fn(q, self._expand_kv(k), self._expand_kv(v),
+                         causal=True, mask=None)
         cache = {
             "k": jax.lax.dynamic_update_slice(
                 cache["k"], k.astype(cache["k"].dtype), (0, 0, 0, 0)),
@@ -229,14 +253,16 @@ class MultiHeadAttention(SimpleModule):
             cache["k"], k.astype(cache["k"].dtype), (0, 0, idx, 0))
         vc = jax.lax.dynamic_update_slice(
             cache["v"], v.astype(cache["v"].dtype), (0, 0, idx, 0))
-        s = jnp.einsum("bhqd,bhkd->bhqk", q, kc.astype(q.dtype),
+        ke = self._expand_kv(kc)
+        ve = self._expand_kv(vc)
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, ke.astype(q.dtype),
                        preferred_element_type=jnp.float32)
         s = s / (self.head_dim ** 0.5)
-        live = jnp.arange(kc.shape[2])[None, None, None, :] <= idx
+        live = jnp.arange(ke.shape[2])[None, None, None, :] <= idx
         s = jnp.where(live, s, -1e30)
         p = jax.nn.softmax(s, axis=-1)
         o = jnp.einsum("bhqk,bhkd->bhqd", p.astype(q.dtype),
-                       vc.astype(q.dtype),
+                       ve.astype(q.dtype),
                        preferred_element_type=jnp.float32).astype(x.dtype)
         dt = x.dtype
         o = self._merge_heads(o)
@@ -290,6 +316,7 @@ class TransformerEncoderLayer(Module):
         causal: bool = False,
         dropout: float = 0.0,
         attn_impl: Optional[AttnFn | str] = None,
+        num_kv_heads: Optional[int] = None,
         name: Optional[str] = None,
     ):
         super().__init__(name)
@@ -301,7 +328,8 @@ class TransformerEncoderLayer(Module):
         self.ln1 = LayerNorm(d_model)
         self.ln2 = LayerNorm(d_model)
         self.mha = MultiHeadAttention(d_model, num_heads, causal=causal,
-                                      attn_impl=attn_impl)
+                                      attn_impl=attn_impl,
+                                      num_kv_heads=num_kv_heads)
         # keep the MLP as explicit params (not a Sequential) for stable
         # checkpoint keys
         self._mlp_dims = (d_model, d_ff)
@@ -382,10 +410,13 @@ class TransformerEncoder(Sequential):
                  d_ff: Optional[int] = None, causal: bool = False,
                  dropout: float = 0.0,
                  attn_impl: Optional[AttnFn | str] = None,
-                 remat: bool = False, name: Optional[str] = None):
+                 remat: bool = False,
+                 num_kv_heads: Optional[int] = None,
+                 name: Optional[str] = None):
         layers = [
             TransformerEncoderLayer(d_model, num_heads, d_ff, causal,
-                                    dropout, attn_impl)
+                                    dropout, attn_impl,
+                                    num_kv_heads=num_kv_heads)
             for _ in range(num_layers)
         ]
         super().__init__(*layers, name=name)

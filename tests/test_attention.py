@@ -192,3 +192,48 @@ def test_ring_attention_sub_blocked(causal):
         dot_product_attention(q, k, v, causal=causal) ** 2))(q)
     np.testing.assert_allclose(np.asarray(g), np.asarray(g_ref),
                                atol=1e-4, rtol=1e-4)
+
+
+def test_gqa_matches_manually_expanded():
+    """GQA (num_kv_heads < num_heads) must equal standard MHA run with
+    the K/V heads explicitly repeated over the query groups."""
+    mha = MultiHeadAttention(16, 4, causal=True, num_kv_heads=2)
+    p = mha.init(jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 10, 16))
+    y = mha.forward(p, x)
+    assert y.shape == (2, 10, 16)
+    assert p["wk"].shape == (16, 2 * 4)  # num_kv_heads * head_dim
+
+    # manual reference: project, split to 2 kv heads, repeat to 4
+    q = (x @ p["wq"] + p["bq"]).reshape(2, 10, 4, 4).transpose(0, 2, 1, 3)
+    k = (x @ p["wk"] + p["bk"]).reshape(2, 10, 2, 4).transpose(0, 2, 1, 3)
+    v = (x @ p["wv"] + p["bv"]).reshape(2, 10, 2, 4).transpose(0, 2, 1, 3)
+    k = jnp.repeat(k, 2, axis=1)
+    v = jnp.repeat(v, 2, axis=1)
+    o = dot_product_attention(q, k, v, causal=True)
+    o = o.transpose(0, 2, 1, 3).reshape(2, 10, 16)
+    ref = o @ p["wo"] + p["bo"]
+    np.testing.assert_allclose(np.asarray(y), np.asarray(ref), atol=1e-5)
+
+
+def test_gqa_generate_equivalence():
+    """GQA KV-cache decode == full re-forward greedy (cache holds only
+    num_kv_heads heads)."""
+    from bigdl_tpu.models import transformer_lm
+
+    m = transformer_lm(40, d_model=32, num_layers=2, num_heads=4,
+                       num_kv_heads=2, max_len=32)
+    params = m.init(jax.random.PRNGKey(0))
+    cache = m.encoder.init_cache(1, 32)
+    assert cache["0"]["k"].shape == (1, 2, 32, 8)  # kv heads only
+    prompt = jnp.asarray(
+        np.random.RandomState(0).randint(0, 40, (2, 4)), jnp.int32)
+    toks = prompt
+    ref = []
+    for _ in range(6):
+        lp, _ = m.apply(params, None, toks)
+        nxt = jnp.argmax(lp[:, -1, :], axis=-1).astype(jnp.int32)
+        ref.append(nxt)
+        toks = jnp.concatenate([toks, nxt[:, None]], axis=1)
+    out = np.asarray(m.generate(params, prompt, 6, temperature=0.0))
+    np.testing.assert_array_equal(out, np.asarray(jnp.stack(ref, axis=1)))
