@@ -33,20 +33,30 @@ class TransformerLM(Module):
                  attn_impl=None, remat: bool = False,
                  tie_embeddings: bool = True, compute_dtype=None,
                  num_kv_heads: Optional[int] = None,
+                 pos_encoding: str = "sinusoidal",
                  name: Optional[str] = None):
         super().__init__(name or "TransformerLM")
+        if pos_encoding not in ("sinusoidal", "rope"):
+            raise ValueError(f"pos_encoding {pos_encoding!r} not in "
+                             f"('sinusoidal', 'rope')")
         self.vocab = vocab
         self.d_model = d_model
         self.tie = tie_embeddings
+        self.max_len = max_len
+        self.rope = pos_encoding == "rope"
         # token input is int, so the Optimizer-level compute_dtype cast
         # never fires for LMs; the cast belongs right after the embedding
         self.compute_dtype = compute_dtype
         self.emb = nn.LookupTable(vocab, d_model)
+        # RoPE replaces the additive table (rotation happens on q/k inside
+        # every attention layer — relative positions, better long-context
+        # extrapolation); self.pos still carries max_len for bounds
         self.pos = nn.PositionalEncoding(d_model, max_len)
         self.encoder = nn.TransformerEncoder(
             num_layers, d_model, num_heads, d_ff, causal=True,
             dropout=dropout, attn_impl=attn_impl, remat=remat,
-            num_kv_heads=num_kv_heads)
+            num_kv_heads=num_kv_heads, rope=self.rope,
+            rope_max_len=max_len)
         self.ln_f = nn.LayerNorm(d_model)
         self.head = None if tie_embeddings else nn.Linear(d_model, vocab)
 
@@ -79,7 +89,11 @@ class TransformerLM(Module):
         if self.compute_dtype is not None:
             h = h.astype(self.compute_dtype)
         h = h * (self.d_model ** 0.5)  # standard embedding scale
-        h = self.pos.forward({}, h)
+        if not self.rope:
+            h = self.pos.forward({}, h)
+        elif x.shape[-1] > self.max_len:
+            raise ValueError(f"sequence length {x.shape[-1]} exceeds "
+                             f"max_len {self.max_len}")
         h, _ = self.encoder.apply(params["encoder"],
                                   self.encoder.init_state(), h,
                                   training=training, rng=rng)
@@ -98,6 +112,8 @@ class TransformerLM(Module):
         if self.compute_dtype is not None:
             h = h.astype(self.compute_dtype)
         h = h * (self.d_model ** 0.5)
+        if self.rope:  # rotation happens inside each attention layer
+            return h
         table = jnp.asarray(self.pos._table)
         pe = jax.lax.dynamic_slice_in_dim(table, pos0, tokens.shape[1], 0)
         return h + pe.astype(h.dtype)

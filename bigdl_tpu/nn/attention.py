@@ -116,13 +116,34 @@ class LayerNorm(SimpleModule):
         return y.astype(x.dtype)
 
 
+def rope_tables(max_len: int, dim: int, base: float = 10000.0):
+    """cos/sin tables for rotary position embeddings (RoPE), NeoX-style
+    half-split pairing: dims [0:dim/2] rotate with [dim/2:dim]."""
+    import numpy as np
+
+    inv = 1.0 / (base ** (np.arange(0, dim, 2).astype(np.float32) / dim))
+    ang = np.arange(max_len).astype(np.float32)[:, None] * inv[None, :]
+    return np.cos(ang), np.sin(ang)  # each (max_len, dim/2)
+
+
+def apply_rope(x, cos, sin):
+    """Rotate (..., s, d) by per-position tables (s, d/2)."""
+    d2 = x.shape[-1] // 2
+    x1, x2 = x[..., :d2], x[..., d2:]
+    c = cos.astype(x.dtype)
+    s = sin.astype(x.dtype)
+    return jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+
+
 class MultiHeadAttention(SimpleModule):
     """Multi-head (self- or cross-) attention.
 
     ``attn_impl`` swaps the inner attention: None -> plain XLA path;
     "flash" -> Pallas flash-attention kernel; or any callable with the
     ``dot_product_attention`` signature (ring attention passes a shard_map'd
-    callable here).
+    callable here). ``rope=True`` rotates q/k by position (RoPE) instead
+    of relying on an additive encoding — relative-position attention that
+    extrapolates better at long context; self-attention only.
     """
 
     def __init__(
@@ -132,6 +153,8 @@ class MultiHeadAttention(SimpleModule):
         causal: bool = False,
         attn_impl: Optional[AttnFn | str] = None,
         num_kv_heads: Optional[int] = None,
+        rope: bool = False,
+        rope_max_len: int = 8192,
         param_dtype=jnp.float32,
         name: Optional[str] = None,
     ):
@@ -152,6 +175,12 @@ class MultiHeadAttention(SimpleModule):
                              f"num_kv_heads {self.num_kv_heads}")
         self.causal = causal
         self.param_dtype = param_dtype
+        self.rope = rope
+        if rope:
+            if self.head_dim % 2:
+                raise ValueError("RoPE needs an even head_dim")
+            self._rope_cos, self._rope_sin = rope_tables(
+                rope_max_len, self.head_dim)
         if attn_impl == "flash":
             from bigdl_tpu.ops import flash_attention
             attn_impl = flash_attention
@@ -187,6 +216,15 @@ class MultiHeadAttention(SimpleModule):
             return kv
         return jnp.repeat(kv, g, axis=1)
 
+    def _rope(self, x, pos0):
+        """Rotate (b, h, s, d) starting at absolute position ``pos0``."""
+        s = x.shape[-2]
+        cos = jax.lax.dynamic_slice_in_dim(
+            jnp.asarray(self._rope_cos), pos0, s, 0)
+        sin = jax.lax.dynamic_slice_in_dim(
+            jnp.asarray(self._rope_sin), pos0, s, 0)
+        return apply_rope(x, cos, sin)
+
     def _merge_heads(self, x):
         b, h, s, d = x.shape
         return x.transpose(0, 2, 1, 3).reshape(b, s, h * d)
@@ -206,8 +244,14 @@ class MultiHeadAttention(SimpleModule):
         k = kv_in @ params["wk"].astype(dt) + params["bk"].astype(dt)
         v = kv_in @ params["wv"].astype(dt) + params["bv"].astype(dt)
         q = self._split_heads(q)
-        k = self._expand_kv(self._split_heads(k, self.num_kv_heads))
-        v = self._expand_kv(self._split_heads(v, self.num_kv_heads))
+        k = self._split_heads(k, self.num_kv_heads)
+        v = self._split_heads(v, self.num_kv_heads)
+        if self.rope:
+            if q_in is not kv_in:
+                raise ValueError("RoPE supports self-attention only")
+            q = self._rope(q, 0)
+            k = self._rope(k, 0)
+        k, v = self._expand_kv(k), self._expand_kv(v)
         if mask is not None and mask.ndim == 2:  # (b, s_k) key-padding
             mask = mask[:, None, None, :]
         o = self.attn_fn(q, k, v, causal=self.causal, mask=mask)
@@ -231,8 +275,11 @@ class MultiHeadAttention(SimpleModule):
 
     def prefill(self, params, x, cache):
         """Full-prompt forward that also writes K/V into the cache
-        (positions 0..s-1). Returns (out, cache)."""
+        (positions 0..s-1; RoPE-rotated K is what gets cached, so decode
+        steps never re-rotate history). Returns (out, cache)."""
         q, k, v = self._qkv(params, x)
+        if self.rope:
+            q, k = self._rope(q, 0), self._rope(k, 0)
         o = self.attn_fn(q, self._expand_kv(k), self._expand_kv(v),
                          causal=True, mask=None)
         cache = {
@@ -249,6 +296,8 @@ class MultiHeadAttention(SimpleModule):
         """One-token step: x (b, 1, d), ``idx`` = tokens already cached.
         Appends this token's K/V at ``idx`` and attends over 0..idx."""
         q, k, v = self._qkv(params, x)
+        if self.rope:
+            q, k = self._rope(q, idx), self._rope(k, idx)
         kc = jax.lax.dynamic_update_slice(
             cache["k"], k.astype(cache["k"].dtype), (0, 0, idx, 0))
         vc = jax.lax.dynamic_update_slice(
@@ -317,6 +366,8 @@ class TransformerEncoderLayer(Module):
         dropout: float = 0.0,
         attn_impl: Optional[AttnFn | str] = None,
         num_kv_heads: Optional[int] = None,
+        rope: bool = False,
+        rope_max_len: int = 8192,
         name: Optional[str] = None,
     ):
         super().__init__(name)
@@ -329,7 +380,8 @@ class TransformerEncoderLayer(Module):
         self.ln2 = LayerNorm(d_model)
         self.mha = MultiHeadAttention(d_model, num_heads, causal=causal,
                                       attn_impl=attn_impl,
-                                      num_kv_heads=num_kv_heads)
+                                      num_kv_heads=num_kv_heads,
+                                      rope=rope, rope_max_len=rope_max_len)
         # keep the MLP as explicit params (not a Sequential) for stable
         # checkpoint keys
         self._mlp_dims = (d_model, d_ff)
@@ -412,11 +464,13 @@ class TransformerEncoder(Sequential):
                  attn_impl: Optional[AttnFn | str] = None,
                  remat: bool = False,
                  num_kv_heads: Optional[int] = None,
+                 rope: bool = False, rope_max_len: int = 8192,
                  name: Optional[str] = None):
         layers = [
             TransformerEncoderLayer(d_model, num_heads, d_ff, causal,
                                     dropout, attn_impl,
-                                    num_kv_heads=num_kv_heads)
+                                    num_kv_heads=num_kv_heads,
+                                    rope=rope, rope_max_len=rope_max_len)
             for _ in range(num_layers)
         ]
         super().__init__(*layers, name=name)

@@ -172,3 +172,71 @@ def test_generate_bounds_checked():
     params = m.init(jax.random.PRNGKey(0))
     with pytest.raises(ValueError, match="max_len"):
         m.generate(params, np.zeros((1, 6), np.int32), 4)
+
+
+def test_rope_lm_generate_equivalence():
+    """RoPE LM: KV-cache greedy decode == full re-forward greedy (the
+    decode path rotates each new q/k at its absolute position; cached
+    keys were rotated when written)."""
+    import jax
+    import jax.numpy as jnp
+
+    from bigdl_tpu.models import transformer_lm
+
+    m = transformer_lm(40, d_model=32, num_layers=2, num_heads=4,
+                       max_len=32, pos_encoding="rope")
+    params = m.init(jax.random.PRNGKey(0))
+    prompt = jnp.asarray(
+        np.random.RandomState(1).randint(0, 40, (2, 5)), jnp.int32)
+    toks = prompt
+    ref = []
+    for _ in range(6):
+        lp, _ = m.apply(params, None, toks)
+        nxt = jnp.argmax(lp[:, -1, :], axis=-1).astype(jnp.int32)
+        ref.append(nxt)
+        toks = jnp.concatenate([toks, nxt[:, None]], axis=1)
+    out = np.asarray(m.generate(params, prompt, 6, temperature=0.0))
+    np.testing.assert_array_equal(out, np.asarray(jnp.stack(ref, axis=1)))
+
+
+def test_rope_rotation_preserves_same_position_dot():
+    """<R(p)q, R(p)k> == <q, k>: rotation by the same angle is an
+    isometry, so only relative position enters attention scores."""
+    import jax.numpy as jnp
+
+    from bigdl_tpu.nn.attention import apply_rope, rope_tables
+
+    rs = np.random.RandomState(0)
+    q = jnp.asarray(rs.randn(1, 1, 4, 16), jnp.float32)
+    k = jnp.asarray(rs.randn(1, 1, 4, 16), jnp.float32)
+    cos, sin = rope_tables(8, 16)
+    qr = apply_rope(q, jnp.asarray(cos[2:6]), jnp.asarray(sin[2:6]))
+    kr = apply_rope(k, jnp.asarray(cos[2:6]), jnp.asarray(sin[2:6]))
+    np.testing.assert_allclose(
+        np.asarray(jnp.sum(qr * kr, -1)), np.asarray(jnp.sum(q * k, -1)),
+        rtol=1e-5)
+
+
+def test_rope_relative_shift_invariance():
+    """Causal RoPE attention outputs are invariant to shifting all
+    positions by a constant (pure relative encoding)."""
+    import jax
+    import jax.numpy as jnp
+
+    from bigdl_tpu.nn.attention import apply_rope, rope_tables
+    from bigdl_tpu.nn.attention import dot_product_attention
+
+    rs = np.random.RandomState(2)
+    q = jnp.asarray(rs.randn(1, 2, 6, 16), jnp.float32)
+    k = jnp.asarray(rs.randn(1, 2, 6, 16), jnp.float32)
+    v = jnp.asarray(rs.randn(1, 2, 6, 16), jnp.float32)
+    cos, sin = rope_tables(64, 16)
+
+    def attn_at(p0):
+        c = jnp.asarray(cos[p0:p0 + 6])
+        s = jnp.asarray(sin[p0:p0 + 6])
+        return dot_product_attention(apply_rope(q, c, s),
+                                     apply_rope(k, c, s), v, causal=True)
+
+    np.testing.assert_allclose(np.asarray(attn_at(0)),
+                               np.asarray(attn_at(17)), atol=1e-5)
