@@ -68,9 +68,33 @@ def main() -> None:
         np.allclose(np.asarray(a), np.asarray(b), atol=1e-6)
         for a, b in zip(r_leaves, leaves))
 
+    # FSDP across the same two processes: params sharded over the global
+    # data axis (spanning both hosts), GSPMD all-gathers over gloo; the
+    # trained result must match the DP run bit-for-bit (same data/seed)
+    from bigdl_tpu.parallel import FullyShardedDataParallel
+
+    ds2 = ShardedDataSet(x, y, global_batch_size=16, shuffle=True)
+    fstrat = FullyShardedDataParallel(make_mesh({"data":
+                                                 jax.device_count()}))
+    fopt = Optimizer(model, ds2, nn.ClassNLLCriterion(),
+                     optim_method=SGD(learning_rate=0.5, momentum=0.9),
+                     end_when=Trigger.max_iteration(3), strategy=fstrat,
+                     seed=7)
+    ftrained = fopt.optimize()
+    # FSDP params span both processes' devices; device_get would throw on
+    # non-addressable shards — allgather assembles the global values
+    from jax.experimental import multihost_utils
+
+    f_leaves = jax.tree_util.tree_leaves(
+        multihost_utils.process_allgather(ftrained.params, tiled=True))
+    fsdp_matches_dp = all(
+        np.allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+        for a, b in zip(f_leaves, leaves))
+
     with open(out_path, "w") as f:
         json.dump({"pid": pid, "digest": digest,
                    "restore_ok": bool(restore_ok),
+                   "fsdp_matches_dp": bool(fsdp_matches_dp),
                    "devices": jax.device_count()}, f)
 
 

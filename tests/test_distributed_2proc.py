@@ -55,3 +55,5 @@ def test_two_process_dp_training(tmp_path):
     assert all(r["restore_ok"] for r in results), results
     # replicated params must be identical on both hosts after 3 sync steps
     assert abs(results[0]["digest"] - results[1]["digest"]) < 1e-5, results
+    # FSDP over the cross-host mesh must reproduce the DP result
+    assert all(r["fsdp_matches_dp"] for r in results), results
