@@ -68,6 +68,21 @@ def online_softmax_update(q, kb, vb, m, l, acc, scale, valid=None):
     return new_m, l, acc
 
 
+def _as_key_padding(mask, b, s_k):
+    """Normalize a mask to (b, s_k) bool when it is a key-padding mask
+    ((b, s_k) or (b|1, 1, 1, s_k)); None when it is something richer."""
+    if mask is None:
+        return None
+    if mask.ndim == 2 and mask.shape == (b, s_k):
+        return mask
+    if (mask.ndim == 4 and mask.shape[-1] == s_k
+            and mask.shape[1] == 1 and mask.shape[2] == 1
+            and mask.shape[0] in (1, b)):
+        m = mask[:, 0, 0, :]
+        return jnp.broadcast_to(m, (b, s_k))
+    return None
+
+
 def blockwise_attention(q, k, v, *, causal: bool = False,
                         mask: Optional[jax.Array] = None,
                         block_k: int = 128):
@@ -77,10 +92,15 @@ def blockwise_attention(q, k, v, *, causal: bool = False,
     probability tiles — the remat-scan formulation of flash attention.
     Differentiable end-to-end; serves as the flash kernel's backward path
     and as a standalone ``attn_impl``. q,k,v: (b, h, s, d).
+
+    Key-padding masks ((b, s_k) or (b|1,1,1,s_k) bool, True=attend) tile
+    along the scan and stay on this path; richer (s_q, s_k) masks fall
+    back to dense.
     """
     s_k = k.shape[-2]
     bk = min(block_k, s_k)
-    if mask is not None or s_k % bk:
+    kv_mask = _as_key_padding(mask, q.shape[0], s_k)
+    if (mask is not None and kv_mask is None) or s_k % bk:
         # arbitrary masks don't tile; ragged tails aren't worth the
         # complexity — correctness over memory for those cases
         return _dense.dot_product_attention(q, k, v, causal=causal,
@@ -96,15 +116,27 @@ def blockwise_attention(q, k, v, *, causal: bool = False,
     # scan carries move the block axis to the front
     kb = jnp.moveaxis(kb, -3, 0)
     vb = jnp.moveaxis(vb, -3, 0)
+    scan_in = (kb, vb)
+    if kv_mask is not None:
+        # (b, n_blk, bk) -> (n_blk, b, 1, 1, bk): broadcasts against the
+        # (b, h, s_q, bk) logits inside the block update
+        mb = jnp.moveaxis(kv_mask.reshape(kv_mask.shape[0], n_blk, bk),
+                          1, 0)[:, :, None, None, :]
+        scan_in = (kb, vb, mb)
 
     @jax.checkpoint
     def body(carry, blk):
         m, l, acc, j = carry
-        kj, vj = blk
+        if kv_mask is not None:
+            kj, vj, mj = blk
+        else:
+            (kj, vj), mj = blk, None
         valid = None
         if causal:
             k_pos = j * bk + jnp.arange(bk)
             valid = q_pos[:, None] >= k_pos[None, :]
+        if mj is not None:
+            valid = mj if valid is None else (valid & mj)
         m, l, acc = online_softmax_update(q, kj, vj, m, l, acc, scale,
                                           valid)
         return (m, l, acc, j + 1), None
@@ -112,7 +144,7 @@ def blockwise_attention(q, k, v, *, causal: bool = False,
     m0 = jnp.full(q.shape[:-1] + (1,), _NEG_INF, jnp.float32)
     l0 = jnp.zeros(q.shape[:-1] + (1,), jnp.float32)
     a0 = jnp.zeros(q.shape, jnp.float32)
-    (_, l, acc, _), _ = jax.lax.scan(body, (m0, l0, a0, 0), (kb, vb))
+    (_, l, acc, _), _ = jax.lax.scan(body, (m0, l0, a0, 0), scan_in)
     return (acc / jnp.maximum(l, 1e-30)).astype(q.dtype)
 
 
@@ -470,10 +502,14 @@ def flash_attention(q, k, v, *, causal: bool = False,
                     block_q: int = 128, block_k: int = 128):
     """(b, h, s, d) attention via the Pallas online-softmax kernel.
 
-    Falls back to the dense XLA path when an explicit ``mask`` is given
-    (arbitrary masks don't tile) or when key length isn't tileable.
+    Key-padding masks route to :func:`blockwise_attention` (same O(seq)
+    memory, XLA-fused); richer masks fall back to the dense path; ragged
+    key lengths fall back inside the custom_vjp.
     """
     if mask is not None:
+        if _as_key_padding(mask, q.shape[0], k.shape[-2]) is not None:
+            return blockwise_attention(q, k, v, causal=causal, mask=mask,
+                                       block_k=block_k)
         return _dense.dot_product_attention(q, k, v, causal=causal,
                                             mask=mask)
     return _flash(q, k, v, causal, block_q, block_k)

@@ -185,3 +185,40 @@ def test_mha_flash_impl_end_to_end(rng):
     x = jnp.asarray(np.random.RandomState(7).randn(2, 16, 32), np.float32)
     np.testing.assert_allclose(np.asarray(mha_f.forward(p, x)),
                                np.asarray(mha_d.forward(p, x)), atol=2e-5)
+
+
+def test_blockwise_key_padding_mask_matches_dense():
+    """Key-padding masks stay on the O(seq) blockwise path (round-3: they
+    previously forced the dense fallback) — parity incl. gradients."""
+    from bigdl_tpu.ops import blockwise_attention
+
+    rs = np.random.RandomState(14)
+    b, h, s, d = 2, 2, 64, 16
+    q = jnp.asarray(rs.randn(b, h, s, d), jnp.float32)
+    k = jnp.asarray(rs.randn(b, h, s, d), jnp.float32)
+    v = jnp.asarray(rs.randn(b, h, s, d), jnp.float32)
+    keep = jnp.asarray(rs.rand(b, s) > 0.3)
+    keep = keep.at[:, 0].set(True)  # no fully-masked rows
+    ref = dot_product_attention(q, k, v, mask=keep[:, None, None, :])
+    for m in (keep, keep[:, None, None, :]):
+        out = blockwise_attention(q, k, v, mask=m, block_k=16)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5)
+    g1 = jax.grad(lambda q: blockwise_attention(
+        q, k, v, mask=keep, block_k=16).sum())(q)
+    g2 = jax.grad(lambda q: dot_product_attention(
+        q, k, v, mask=keep[:, None, None, :]).sum())(q)
+    np.testing.assert_allclose(np.asarray(g1), np.asarray(g2), atol=2e-4)
+
+
+def test_flash_routes_key_padding_to_blockwise():
+    """flash_attention with a key-padding mask must agree with dense
+    (routed through the blockwise path, not the dense fallback)."""
+    rs = np.random.RandomState(15)
+    q = jnp.asarray(rs.randn(1, 2, 64, 16), jnp.float32)
+    k = jnp.asarray(rs.randn(1, 2, 64, 16), jnp.float32)
+    v = jnp.asarray(rs.randn(1, 2, 64, 16), jnp.float32)
+    keep = jnp.asarray(rs.rand(1, 64) > 0.4).at[:, 0].set(True)
+    ref = dot_product_attention(q, k, v, mask=keep[:, None, None, :])
+    out = flash_attention(q, k, v, mask=keep[:, None, None, :])
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
