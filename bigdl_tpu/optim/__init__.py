@@ -4,7 +4,7 @@ from bigdl_tpu.optim.method import (
 )
 from bigdl_tpu.optim.schedules import (
     LearningRateSchedule, Default, Poly, Step, EpochDecay, EpochStep,
-    Regime, EpochSchedule,
+    Regime, EpochSchedule, CosineAnnealing, Warmup,
 )
 from bigdl_tpu.optim.triggers import Trigger
 from bigdl_tpu.optim.validation import (

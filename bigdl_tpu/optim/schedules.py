@@ -104,3 +104,39 @@ class EpochSchedule(LearningRateSchedule):
             hit = (epoch >= r.start_epoch) & (epoch <= r.end_epoch)
             lr = jnp.where(hit, r.lr, lr)
         return lr
+
+
+@dataclass
+class CosineAnnealing(LearningRateSchedule):
+    """base_lr * (min_frac + (1-min_frac) * 0.5*(1+cos(pi*step/total)))
+    — the standard TPU LLM/large-batch schedule (beyond the reference;
+    pairs with Warmup and LARS for the b512+ regime)."""
+
+    total_steps: int
+    min_frac: float = 0.0
+
+    def __call__(self, base_lr, step, epoch):
+        frac = jnp.clip(step / self.total_steps, 0.0, 1.0)
+        cos = 0.5 * (1.0 + jnp.cos(jnp.pi * frac))
+        return base_lr * (self.min_frac + (1.0 - self.min_frac) * cos)
+
+
+@dataclass
+class Warmup(LearningRateSchedule):
+    """Linear warmup over ``warmup_steps`` then hand off to ``after``
+    (counted from the end of warmup). Large-batch recipes (LARS, b>=512)
+    are unstable without it."""
+
+    warmup_steps: int
+    after: LearningRateSchedule = None  # None -> constant base_lr
+
+    def __call__(self, base_lr, step, epoch):
+        warm = base_lr * jnp.minimum(
+            1.0, (step + 1.0) / jnp.maximum(1.0, self.warmup_steps))
+        if self.after is None:
+            rest = base_lr
+        else:
+            rest = self.after(base_lr, jnp.maximum(0.0,
+                                                   step - self.warmup_steps),
+                              epoch)
+        return jnp.where(step < self.warmup_steps, warm, rest)

@@ -240,3 +240,25 @@ def test_ema_wrapper_tracks_weights():
     p, st = opt.update(g, st, p)          # w: 0.5 -> 0.0
     np.testing.assert_allclose(np.asarray(opt.ema_params(st)["w"]),
                                [0.375])  # 0.5*0.75 + 0.5*0.0
+
+
+def test_cosine_and_warmup_schedules():
+    import jax.numpy as jnp
+
+    from bigdl_tpu.optim import CosineAnnealing, Warmup
+
+    cos = CosineAnnealing(total_steps=100, min_frac=0.1)
+    np.testing.assert_allclose(float(cos(1.0, 0, 0)), 1.0, rtol=1e-6)
+    np.testing.assert_allclose(float(cos(1.0, 100, 0)), 0.1, rtol=1e-5)
+    np.testing.assert_allclose(float(cos(1.0, 50, 0)), 0.55, rtol=1e-5)
+    assert float(cos(1.0, 1000, 0)) == float(cos(1.0, 100, 0))  # clamped
+
+    w = Warmup(10, CosineAnnealing(total_steps=100))
+    np.testing.assert_allclose(float(w(1.0, 0, 0)), 0.1, rtol=1e-6)
+    np.testing.assert_allclose(float(w(1.0, 4, 0)), 0.5, rtol=1e-6)
+    np.testing.assert_allclose(float(w(1.0, 10, 0)), 1.0, rtol=1e-6)
+    np.testing.assert_allclose(float(w(1.0, 60, 0)),
+                               float(CosineAnnealing(100)(1.0, 50, 0)),
+                               rtol=1e-6)
+    w2 = Warmup(5)  # constant after warmup
+    assert float(w2(2.0, 100, 0)) == 2.0
