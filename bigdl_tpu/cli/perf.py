@@ -65,11 +65,18 @@ def build_model(name: str, class_num: int = 1000):
             _LM_VOCAB, d_model=512, num_layers=8, num_heads=8, max_len=512,
             attn_impl=("flash" if jax.default_backend() == "tpu"
                        else None)),
+        # modern-config A/B: RoPE + grouped-query (2 kv heads)
+        "transformer_lm_rope": lambda: models.transformer_lm(
+            _LM_VOCAB, d_model=512, num_layers=8, num_heads=8, max_len=512,
+            pos_encoding="rope", num_kv_heads=2,
+            attn_impl=("flash" if jax.default_backend() == "tpu"
+                       else None)),
     }
     if name not in table:
         raise SystemExit(f"unknown model {name}; choose from {list(table)}")
     size = {"lenet5": (28, 28, 1),
-            "transformer_lm": (512,)}.get(name, (224, 224, 3))
+            "transformer_lm": (512,),
+            "transformer_lm_rope": (512,)}.get(name, (224, 224, 3))
     return table[name](), size
 
 
@@ -104,7 +111,7 @@ def run(model_name: str, batch: int, iterations: int, data_type: str,
     from bigdl_tpu.optim import SGD
 
     model, in_shape = build_model(model_name)
-    is_lm = model_name == "transformer_lm"
+    is_lm = model_name.startswith("transformer_lm")
     crit = (nn.TimeDistributedCriterion(nn.ClassNLLCriterion()) if is_lm
             else nn.ClassNLLCriterion())
     opt = SGD(learning_rate=0.01, momentum=0.9)

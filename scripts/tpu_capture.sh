@@ -20,6 +20,7 @@ done
 step "perf_resnet50_s2d_b128" 900 python -m bigdl_tpu.cli.perf -m resnet50_s2d -b 128 -i 20 --dataType random
 step "perf_resnet50_inner10_b128" 900 python -m bigdl_tpu.cli.perf -m resnet50 -b 128 -i 4 --innerSteps 10 --dataType random
 step "perf_transformer_lm_b32" 900 python -m bigdl_tpu.cli.perf -m transformer_lm -b 32 -i 10 --dataType random
+step "perf_transformer_lm_rope_b32" 900 python -m bigdl_tpu.cli.perf -m transformer_lm_rope -b 32 -i 10 --dataType random
 
 # flash kernel: compiled tests + microbench
 step "pytest_tpu_marked" 1200 env BIGDL_TPU_TESTS=1 python -m pytest tests/ -m tpu -q
