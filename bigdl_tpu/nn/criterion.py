@@ -16,7 +16,8 @@ import jax.numpy as jnp
 from bigdl_tpu.core.criterion import Criterion
 
 __all__ = [
-    "ClassNLLCriterion", "MSECriterion", "AbsCriterion", "BCECriterion",
+    "ClassNLLCriterion", "LabelSmoothingNLLCriterion",
+    "MSECriterion", "AbsCriterion", "BCECriterion",
     "CrossEntropyCriterion", "ClassSimplexCriterion", "DistKLDivCriterion",
     "CosineEmbeddingCriterion", "HingeEmbeddingCriterion",
     "L1HingeEmbeddingCriterion", "MarginCriterion", "MarginRankingCriterion",
@@ -52,6 +53,28 @@ class ClassNLLCriterion(Criterion):
                 return jnp.sum(loss) / jnp.maximum(jnp.sum(w), 1e-12)
             return jnp.sum(loss)
         return self._reduce(-ll)
+
+
+class LabelSmoothingNLLCriterion(Criterion):
+    """NLL over log-probs with uniform label smoothing: the target
+    distribution is (1-eps) on the true class + eps/C elsewhere — the
+    standard ImageNet recipe refinement (beyond the reference's
+    ClassNLLCriterion; composes with LogSoftMax the same way)."""
+
+    def __init__(self, smoothing: float = 0.1, size_average: bool = True):
+        super().__init__(size_average)
+        if not 0.0 <= smoothing < 1.0:
+            raise ValueError(f"smoothing {smoothing} not in [0, 1)")
+        self.smoothing = smoothing
+
+    def forward(self, input, target):
+        t = target.astype(jnp.int32)
+        # smoothing mass eps spreads uniformly: eps * mean(logp) term
+        ll_true = jnp.take_along_axis(input, t[:, None], axis=1)[:, 0]
+        ll_mean = jnp.mean(input, axis=-1)
+        eps = self.smoothing
+        loss = -((1.0 - eps) * ll_true + eps * ll_mean)
+        return self._reduce(loss)
 
 
 class MSECriterion(Criterion):

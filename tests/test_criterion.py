@@ -208,3 +208,38 @@ def test_time_distributed_vs_looped_torch():
     theirs = torch.nn.functional.nll_loss(
         logp.reshape(-1, C), torch.from_numpy(labels).reshape(-1))
     np.testing.assert_allclose(ours, float(theirs), rtol=1e-5)
+
+
+def test_label_smoothing_nll():
+    """eps=0 reduces to ClassNLL; eps>0 mixes in the uniform target
+    (checked against the explicit soft-target cross-entropy)."""
+    import jax
+    import jax.numpy as jnp
+
+    from bigdl_tpu import nn
+
+    rs = np.random.RandomState(0)
+    logp = jax.nn.log_softmax(jnp.asarray(rs.randn(6, 5), jnp.float32))
+    y = jnp.asarray(rs.randint(0, 5, 6), jnp.int32)
+
+    c0 = nn.LabelSmoothingNLLCriterion(0.0)(logp, y)
+    np.testing.assert_allclose(float(c0),
+                               float(nn.ClassNLLCriterion()(logp, y)),
+                               rtol=1e-6)
+
+    eps = 0.2
+    soft = (jnp.full((6, 5), eps / 5)
+            .at[jnp.arange(6), y].add(1.0 - eps))
+    # soft-target CE with uniform-eps smoothing == (1-eps)*nll_true
+    # + eps*mean only when the eps mass includes the true class; our
+    # definition spreads eps uniformly over ALL classes:
+    ref = float(jnp.mean(-jnp.sum(soft * logp, axis=-1)))
+    mine = float(nn.LabelSmoothingNLLCriterion(eps)(logp, y))
+    # relate: mine = (1-eps)*nll + eps*mean; ref = (1-eps)*nll + eps/5*sum
+    # = (1-eps)*nll + eps*mean  (since mean = sum/5) -> identical
+    np.testing.assert_allclose(mine, ref, rtol=1e-5)
+
+    import pytest
+
+    with pytest.raises(ValueError):
+        nn.LabelSmoothingNLLCriterion(1.5)
