@@ -1,0 +1,58 @@
+"""Mixup batch augmentation (Zhang et al.) — beyond the reference's
+augment stages (dataset/image/*.scala are per-image; mixup is per-batch):
+each batch is convexly combined with a shuffled copy of itself,
+x' = lam*x + (1-lam)*x[perm], and the loss becomes the same convex
+combination of the two labels' losses. Ships as a Transformer stage
+(composes with ``>>`` like every other pipeline stage) plus the paired
+criterion wrapper.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator
+
+import numpy as np
+
+from bigdl_tpu.core.criterion import Criterion
+from bigdl_tpu.dataset.dataset import MiniBatch
+from bigdl_tpu.dataset.transformer import Transformer
+
+__all__ = ["Mixup", "MixupCriterion"]
+
+
+class Mixup(Transformer):
+    """MiniBatch -> MiniBatch with ``target = (y_a, y_b, lam)``.
+
+    ``lam ~ Beta(alpha, alpha)`` per batch (one scalar — the standard
+    formulation keeps XLA shapes static). Train-time only; feed the
+    resulting batches with :class:`MixupCriterion` wrapping the usual
+    loss.
+    """
+
+    def __init__(self, alpha: float = 0.2, seed: int = 0):
+        if alpha <= 0:
+            raise ValueError(f"alpha must be > 0, got {alpha}")
+        self.alpha = alpha
+        self._rng = np.random.RandomState(seed)
+
+    def __call__(self, it: Iterator) -> Iterator:
+        for mb in it:
+            x, y = np.asarray(mb.input), np.asarray(mb.target)
+            lam = float(self._rng.beta(self.alpha, self.alpha))
+            perm = self._rng.permutation(len(x))
+            x_mixed = (lam * x + (1.0 - lam) * x[perm]).astype(x.dtype)
+            yield MiniBatch(x_mixed,
+                            (y, y[perm], np.float32(lam)))
+
+
+class MixupCriterion(Criterion):
+    """loss = lam * inner(out, y_a) + (1-lam) * inner(out, y_b)."""
+
+    def __init__(self, inner: Criterion):
+        super().__init__(size_average=getattr(inner, "size_average", True))
+        self.inner = inner
+
+    def forward(self, input, target):
+        y_a, y_b, lam = target
+        return (lam * self.inner(input, y_a)
+                + (1.0 - lam) * self.inner(input, y_b))

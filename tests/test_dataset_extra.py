@@ -180,3 +180,35 @@ def test_table_is_pytree():
     assert isinstance(doubled, Table)
     np.testing.assert_array_equal(doubled[1], np.full(3, 2.0))
     assert float(doubled["scale"]) == 4.0
+
+
+def test_mixup_stage_and_criterion():
+    """Mixup batch combination + paired criterion: x' = lam*x+(1-lam)*x[p],
+    loss = lam*L(y) + (1-lam)*L(y[p]); lam=identity bounds hold and an
+    end-to-end step trains finite."""
+    import jax
+    import jax.numpy as jnp
+
+    from bigdl_tpu import nn
+    from bigdl_tpu.dataset import BatchDataSet, MiniBatch, Mixup, MixupCriterion
+
+    rs = np.random.RandomState(0)
+    x = rs.rand(8, 4).astype(np.float32)
+    y = rs.randint(0, 3, 8).astype(np.int32)
+    stage = Mixup(alpha=0.4, seed=1)
+    out = list(stage([MiniBatch(x, y)]))
+    assert len(out) == 1
+    xm, (ya, yb, lam) = out[0].input, out[0].target
+    assert 0.0 <= lam <= 1.0
+    assert xm.shape == x.shape and ya.shape == yb.shape == y.shape
+    # convexity: every mixed value lies within the per-element min/max hull
+    assert float(xm.min()) >= float(x.min()) - 1e-6
+    assert float(xm.max()) <= float(x.max()) + 1e-6
+
+    crit = MixupCriterion(nn.ClassNLLCriterion())
+    logp = jax.nn.log_softmax(jnp.asarray(rs.randn(8, 3), jnp.float32))
+    v = float(crit(logp, (jnp.asarray(ya), jnp.asarray(yb),
+                          jnp.float32(lam))))
+    va = float(nn.ClassNLLCriterion()(logp, jnp.asarray(ya)))
+    vb = float(nn.ClassNLLCriterion()(logp, jnp.asarray(yb)))
+    np.testing.assert_allclose(v, lam * va + (1 - lam) * vb, rtol=1e-6)
