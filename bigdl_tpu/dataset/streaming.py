@@ -40,7 +40,55 @@ from bigdl_tpu.dataset.dataset import DataSet, MiniBatch
 from bigdl_tpu.dataset import recordfile as rf
 
 __all__ = ["StreamingImageFolder", "RecordImageDataSet",
-           "decode_resize", "augment_sample"]
+           "decode_resize", "augment_sample", "random_resized_crop"]
+
+
+def random_resized_crop(target: tuple[int, int],
+                        scale: tuple[float, float] = (0.08, 1.0),
+                        ratio: tuple[float, float] = (3 / 4, 4 / 3),
+                        attempts: int = 10):
+    """Inception-style train augmentation: sample a crop covering a
+    random area fraction at a random aspect ratio, resized to ``target``
+    (reference-era pipelines use fixed-scale random crops; this is the
+    modern ImageNet recipe). Returns an ``augment`` callable for the
+    streaming datasets — pair with ``short_side=None`` disabled cropping
+    by setting the dataset ``crop=target`` (the final center/random crop
+    then becomes a no-op on an exactly-target-sized image).
+
+    Usage::
+
+        ds = RecordImageDataSet(shards, batch, crop=(224, 224),
+                                train=True, short_side=256,
+                                augment=random_resized_crop((224, 224)))
+    """
+    th, tw = target
+
+    def aug(img: np.ndarray, rng: np.random.RandomState) -> np.ndarray:
+        from PIL import Image
+
+        h, w = img.shape[:2]
+        area = h * w
+        for _ in range(attempts):
+            a = rng.uniform(*scale) * area
+            log_r = rng.uniform(np.log(ratio[0]), np.log(ratio[1]))
+            r = float(np.exp(log_r))
+            cw = int(round(np.sqrt(a * r)))
+            ch = int(round(np.sqrt(a / r)))
+            if cw <= w and ch <= h:
+                y0 = rng.randint(0, h - ch + 1)
+                x0 = rng.randint(0, w - cw + 1)
+                crop = img[y0:y0 + ch, x0:x0 + cw]
+                break
+        else:  # fallback: center crop of the largest fitting window
+            cw = ch = min(h, w)
+            y0, x0 = (h - ch) // 2, (w - cw) // 2
+            crop = img[y0:y0 + ch, x0:x0 + cw]
+        if crop.shape[:2] != (th, tw):
+            crop = np.asarray(
+                Image.fromarray(crop).resize((tw, th), Image.BILINEAR))
+        return crop
+
+    return aug
 
 
 def decode_resize(raw: bytes, short_side: Optional[int],

@@ -260,3 +260,26 @@ def test_streaming_throughput_smoke(tmp_path):
     rate = n / dt
     assert n == 96
     assert rate > 300, f"streaming pipeline too slow: {rate:.0f} img/s"
+
+
+def test_random_resized_crop_augment(gradient_root):
+    """RRC plugs into the streaming augment hook: output is exactly the
+    target size, per-sample randomized, deterministic per seed."""
+    from bigdl_tpu.dataset.streaming import random_resized_crop
+
+    rrc = random_resized_crop((16, 16), scale=(0.3, 1.0))
+    rs = np.random.RandomState(0)
+    img = rs.randint(0, 256, (40, 48, 3), np.uint8)
+    out1 = rrc(img, np.random.RandomState(1))
+    out2 = rrc(img, np.random.RandomState(1))
+    out3 = rrc(img, np.random.RandomState(2))
+    assert out1.shape == (16, 16, 3)
+    np.testing.assert_array_equal(out1, out2)  # seed-deterministic
+    assert not np.array_equal(out1, out3)      # varies across samples
+
+    ds = StreamingImageFolder(gradient_root, batch_size=4, crop=(16, 16),
+                              train=True, short_side=20, n_threads=2,
+                              augment=random_resized_crop((16, 16)),
+                              seed=3)
+    batch = next(iter(ds))
+    assert batch.input.shape == (4, 16, 16, 3)
