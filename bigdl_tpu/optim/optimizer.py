@@ -306,7 +306,9 @@ class Optimizer:
                 if self.strategy is not None:
                     x, y = self.strategy.shard_batch(x, y)
                 else:
-                    x, y = jnp.asarray(x), jnp.asarray(y)
+                    # target may be a pytree (e.g. Mixup's (y_a, y_b, lam))
+                    x = jnp.asarray(x)
+                    y = jax.tree_util.tree_map(jnp.asarray, y)
                 rng, k_step = jax.random.split(rng)
                 params, mod_state, opt_state, loss = step_fn(
                     params, mod_state, opt_state, x, y, k_step)
