@@ -241,3 +241,14 @@ class FullyShardedDataParallel(DataParallel):
         return jax.jit(eval_step,
                        in_shardings=(self._param_shardings, self._repl,
                                      self._batch, self._batch))
+
+    def gather(self, params, mod_state, opt_state):
+        """FSDP leaves span every process's devices; on multi-host,
+        device_get would throw on non-addressable shards — allgather the
+        global values instead (single-host device_get stays cheap)."""
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            ag = lambda t: multihost_utils.process_allgather(t, tiled=True)
+            return ag(params), ag(mod_state), ag(opt_state)
+        return super().gather(params, mod_state, opt_state)
