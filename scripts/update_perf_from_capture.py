@@ -1,0 +1,76 @@
+"""Summarize a tpu_capture.sh log into markdown for PERF.md.
+
+    python scripts/update_perf_from_capture.py /tmp/tpu_capture.log
+
+Parses every JSON line in the capture log (perf runs, flash bench rows,
+pipeline bench, bench.py line) and prints ready-to-paste markdown
+tables; leaves PERF.md itself untouched (human merges the story).
+"""
+
+import json
+import re
+import sys
+
+
+def parse(path: str):
+    rows = []
+    section = None
+    for line in open(path, errors="replace"):
+        m = re.match(r"^=== (\S+)", line)
+        if m and not line.startswith("=== end"):
+            section = m.group(1)
+        line = line.strip()
+        if line.startswith("{") and line.endswith("}"):
+            try:
+                rows.append((section, json.loads(line)))
+            except json.JSONDecodeError:
+                pass
+        if "passed" in line and ("failed" in line or "skipped" in line
+                                 or "error" in line or " in " in line):
+            rows.append((section, {"pytest": line}))
+    return rows
+
+
+def main(path: str) -> None:
+    rows = parse(path)
+    perf = [(s, r) for s, r in rows if "images_per_second_per_chip" in r]
+    flash = [(s, r) for s, r in rows if "impl" in r and "seq" in r]
+    pipe = [(s, r) for s, r in rows if r.get("metric") ==
+            "input_pipeline_imagenet_shape"]
+    tests = [(s, r) for s, r in rows if "pytest" in r]
+
+    if perf:
+        print("### Training throughput / MFU\n")
+        print("| run | model | batch | img/s/chip | MFU % | basis | "
+              "device |")
+        print("|---|---|---|---|---|---|---|")
+        for s, r in perf:
+            print(f"| {s} | {r.get('model')} | {r.get('batch')} "
+                  f"| {r.get('images_per_second_per_chip')} "
+                  f"| {r.get('mfu_pct')} | {r.get('mfu_basis')} "
+                  f"| {r.get('device')} |")
+        print()
+    if flash:
+        print("### Flash vs dense attention (causal bf16)\n")
+        print("| seq | impl | fwd ms | fwd+bwd ms | fwd TF/s | "
+              "fwd+bwd TF/s |")
+        print("|---|---|---|---|---|---|")
+        for _, r in flash:
+            print(f"| {r.get('seq')} | {r.get('impl')} "
+                  f"| {r.get('fwd_ms', r.get('error', '-'))} "
+                  f"| {r.get('fwdbwd_ms', '-')} | {r.get('fwd_tflops', '-')} "
+                  f"| {r.get('fwdbwd_tflops', '-')} |")
+        print()
+    if pipe:
+        print("### Input pipeline\n")
+        for _, r in pipe:
+            print(f"- {r}")
+        print()
+    if tests:
+        print("### Test runs\n")
+        for s, r in tests:
+            print(f"- {s}: {r['pytest']}")
+
+
+if __name__ == "__main__":
+    main(sys.argv[1] if len(sys.argv) > 1 else "/tmp/tpu_capture.log")
