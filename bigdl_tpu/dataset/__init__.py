@@ -17,4 +17,4 @@ from bigdl_tpu.dataset.recordfile import (
 from bigdl_tpu.dataset.streaming import (
     StreamingImageFolder, RecordImageDataSet,
 )
-from bigdl_tpu.dataset.mixup import Mixup, MixupCriterion
+from bigdl_tpu.dataset.mixup import CutMix, Mixup, MixupCriterion

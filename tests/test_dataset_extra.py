@@ -212,3 +212,25 @@ def test_mixup_stage_and_criterion():
     va = float(nn.ClassNLLCriterion()(logp, jnp.asarray(ya)))
     vb = float(nn.ClassNLLCriterion()(logp, jnp.asarray(yb)))
     np.testing.assert_allclose(v, lam * va + (1 - lam) * vb, rtol=1e-6)
+
+
+def test_cutmix_stage():
+    """CutMix: pixels outside the box untouched, inside from the permuted
+    batch; lam equals the kept-area fraction."""
+    from bigdl_tpu.dataset import CutMix, MiniBatch
+
+    rs = np.random.RandomState(0)
+    x = rs.rand(6, 16, 16, 3).astype(np.float32)
+    y = np.arange(6, dtype=np.int32)
+    out = next(iter(CutMix(alpha=1.0, seed=4)([MiniBatch(x, y)])))
+    xm, (ya, yb, lam) = out.input, out.target
+    assert xm.shape == x.shape
+    np.testing.assert_array_equal(ya, y)
+    # every pixel comes from x[i] or x[perm[i]]
+    perm = np.asarray([np.where(y == l)[0][0] for l in yb])
+    from_self = np.isclose(xm, x).all(-1)
+    from_other = np.isclose(xm, x[perm]).all(-1)
+    assert np.all(from_self | from_other)
+    # lam matches the actually-kept fraction (up to ties where both match)
+    frac_other = from_other[~from_self].size / from_self[0].size / 6
+    assert abs((1.0 - lam) - frac_other) < 0.05 or np.all(from_self)
