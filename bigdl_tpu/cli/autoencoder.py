@@ -17,6 +17,7 @@ def main(argv=None):
     common.add_train_args(tr)
     tr.add_argument("--adagrad", action="store_true")
     args = p.parse_args(argv)
+    common.apply_platform(args)
 
     import numpy as np
 

@@ -13,9 +13,30 @@ from typing import Optional, Sequence
 import numpy as np
 
 
+def _add_platform_arg(p: argparse.ArgumentParser) -> None:
+    p.add_argument("--platform", default=None,
+                   choices=["cpu", "tpu"],
+                   help="force the jax backend (observed failure mode: a "
+                        "down TPU tunnel hangs backend init forever — "
+                        "--platform cpu keeps the CLI usable; must take "
+                        "effect before first jax device use)")
+
+
+def apply_platform(args) -> None:
+    """Honor --platform BEFORE any jax backend init. Uses the config API,
+    not JAX_PLATFORMS (the env-var spelling hangs the axon plugin at
+    import in this environment)."""
+    platform = getattr(args, "platform", None)
+    if platform:
+        import jax
+
+        jax.config.update("jax_platforms", platform)
+
+
 def add_train_args(p: argparse.ArgumentParser) -> None:
     """The reference's common knobs (-f, -b, --learningRate, --maxEpoch,
     --checkpoint, --model/--state resume; models/lenet/Utils.scala flags)."""
+    _add_platform_arg(p)
     p.add_argument("-f", "--folder", default="./", help="data folder")
     p.add_argument("-b", "--batchSize", type=int, default=128)
     p.add_argument("--learningRate", type=float, default=0.05)
@@ -35,6 +56,7 @@ def add_train_args(p: argparse.ArgumentParser) -> None:
 
 
 def add_test_args(p: argparse.ArgumentParser) -> None:
+    _add_platform_arg(p)
     p.add_argument("-f", "--folder", default="./")
     p.add_argument("-b", "--batchSize", type=int, default=128)
     p.add_argument("--model", required=True, help="checkpoint dir or file")

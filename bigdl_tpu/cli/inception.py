@@ -50,6 +50,7 @@ def main(argv=None):
                     default="inception_v1")
     te.add_argument("--classNum", type=int, default=1000)
     args = p.parse_args(argv)
+    common.apply_platform(args)
 
     from bigdl_tpu import nn
     from bigdl_tpu.models import inception_v1_no_aux, inception_v2

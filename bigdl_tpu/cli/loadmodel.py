@@ -61,6 +61,7 @@ def main(argv=None):
     p.add_argument("-b", "--batchSize", type=int, default=32)
     p.add_argument("--classNum", type=int, default=1000)
     args = p.parse_args(argv)
+    common.apply_platform(args)
 
     from bigdl_tpu import nn  # noqa: F401  (models import side effects)
     from bigdl_tpu.dataset.folder import ImageFolderDataSet

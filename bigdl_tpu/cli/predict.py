@@ -28,6 +28,7 @@ def main(argv=None):
     p.add_argument("--classNum", type=int, default=1000)
     p.add_argument("--topN", type=int, default=1)
     args = p.parse_args(argv)
+    common.apply_platform(args)
 
     import numpy as np
 

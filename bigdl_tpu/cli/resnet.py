@@ -24,6 +24,7 @@ def main(argv=None):
     common.add_test_args(te)
     te.add_argument("--depth", type=int, default=20)
     args = p.parse_args(argv)
+    common.apply_platform(args)
 
     from bigdl_tpu import nn
     from bigdl_tpu.models import resnet_cifar

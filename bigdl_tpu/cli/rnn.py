@@ -31,6 +31,7 @@ def main(argv=None):
     tr.add_argument("--seqLength", type=int, default=20)
     tr.add_argument("--hiddenSize", type=int, default=40)
     args = p.parse_args(argv)
+    common.apply_platform(args)
 
     import numpy as np
 

@@ -140,6 +140,7 @@ def main(argv=None):
     p.add_argument("--maxWordsNum", type=int, default=5000)
     p.add_argument("--trainingSplit", type=float, default=0.8)
     args = p.parse_args(argv)
+    common.apply_platform(args)
 
     import numpy as np
 

@@ -48,6 +48,7 @@ def main(argv=None):
     ge.add_argument("--topK", type=int, default=40)
     ge.add_argument("--seed", type=int, default=0)
     args = p.parse_args(argv)
+    common.apply_platform(args)
 
     if args.cmd == "generate":
         return _generate(args)

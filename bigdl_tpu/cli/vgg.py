@@ -41,6 +41,7 @@ def main(argv=None):
     te = sub.add_parser("test")
     common.add_test_args(te)
     args = p.parse_args(argv)
+    common.apply_platform(args)
 
     from bigdl_tpu import nn
     from bigdl_tpu.models import vgg_for_cifar10
