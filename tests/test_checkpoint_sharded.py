@@ -153,3 +153,32 @@ def test_remote_overwrite_refused(rng):
            .set_checkpoint(Trigger.several_iteration(1), base))
     with pytest.raises(FileExistsError, match="model.2"):
         opt.optimize()
+
+
+def test_fsdp_sharded_checkpoint_roundtrip(tmp_path, rng):
+    """ZeRO-3 state (params sharded over the data axis) must save via the
+    orbax sharded path and restore directly onto the same shardings —
+    the pod resume path for models that never fit replicated."""
+    from bigdl_tpu import nn
+    from bigdl_tpu.core import Sequential
+    from bigdl_tpu.optim import SGD
+    from bigdl_tpu.parallel import FullyShardedDataParallel, local_mesh
+    from bigdl_tpu.utils.orbax_ckpt import restore_sharded, save_sharded
+
+    model = Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 8))
+    params = model.init(rng)
+    strat = FullyShardedDataParallel(local_mesh())
+    params, ms, opt_state = strat.place(params, model.init_state(),
+                                        SGD(momentum=0.9).init(params))
+    p = str(tmp_path / "fsdp_ck")
+    save_sharded({"params": params, "opt": opt_state}, p)
+
+    like = {"params": params, "opt": opt_state}
+    back = restore_sharded(p, like=like)
+    for a, b in zip(jax.tree_util.tree_leaves(back["params"]),
+                    jax.tree_util.tree_leaves(params)):
+        assert a.sharding == b.sharding  # restored onto FSDP shardings
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    for a, b in zip(jax.tree_util.tree_leaves(back["opt"]),
+                    jax.tree_util.tree_leaves(opt_state)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
