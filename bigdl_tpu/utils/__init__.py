@@ -3,3 +3,4 @@ from bigdl_tpu.utils.table import Table, T
 from bigdl_tpu.utils.classifier import Classifier
 from bigdl_tpu.utils.file import save_pytree, load_pytree, latest_checkpoint
 from bigdl_tpu.utils.profiling import time_modules, trace, format_times
+from bigdl_tpu.utils.summary import param_bytes, param_count, summary

@@ -1,0 +1,66 @@
+"""Model summary: layer tree with parameter counts and sizes (the
+reference prints module graphs via Module.toString trees,
+nn/Container.scala; this adds the param accounting a TPU user needs to
+reason about HBM)."""
+
+from __future__ import annotations
+
+import jax
+
+__all__ = ["param_count", "param_bytes", "summary"]
+
+
+def param_count(params) -> int:
+    return sum(int(x.size) for x in jax.tree_util.tree_leaves(params))
+
+
+def param_bytes(params) -> int:
+    return sum(int(x.size) * x.dtype.itemsize
+               for x in jax.tree_util.tree_leaves(params))
+
+
+def _fmt(n: float) -> str:
+    for unit in ("", "K", "M", "B"):
+        if abs(n) < 1000 or unit == "B":
+            return f"{n:.1f}{unit}" if unit else f"{int(n)}"
+        n /= 1000.0
+    return f"{n:.1f}B"
+
+
+def summary(module, params) -> str:
+    """Render an indented layer tree with per-subtree parameter counts.
+
+    ``params`` is the tree from ``module.init(rng)``; container children
+    are looked up by their positional keys (the same convention init
+    uses), so the printed counts always sum to the total.
+    """
+    lines = []
+
+    def walk(mod, p, indent):
+        n = param_count(p) if p is not None else 0
+        lines.append(f"{'  ' * indent}{mod.name} "
+                     f"[{type(mod).__name__}] params={_fmt(n)}")
+        children = mod.children() if hasattr(mod, "children") else ()
+        if isinstance(p, dict):
+            for i, c in enumerate(children):
+                # containers key children "0".."n-1"; composite modules
+                # (TransformerLM etc.) key by attribute-style names —
+                # try both
+                sub = p.get(str(i))
+                if sub is None:
+                    for k, v in p.items():
+                        if isinstance(v, dict) and k not in map(
+                                str, range(len(children))):
+                            if getattr(mod, k, None) is c:
+                                sub = v
+                                break
+                walk(c, sub, indent + 1)
+        elif children:
+            for c in children:
+                walk(c, None, indent + 1)
+
+    walk(module, params, 0)
+    total = param_count(params)
+    mb = param_bytes(params) / 1e6
+    lines.append(f"total params: {_fmt(total)} ({mb:.1f} MB)")
+    return "\n".join(lines)

@@ -85,3 +85,20 @@ def test_grad_flows_through_sequential(rng):
     g = jax.grad(loss)(params)
     assert any(float(jnp.abs(x).sum()) > 0
                for x in jax.tree_util.tree_leaves(g))
+
+
+def test_model_summary_counts():
+    """summary(): per-layer counts sum to the total; renders every child."""
+    from bigdl_tpu.models import lenet5
+    from bigdl_tpu.utils.summary import param_bytes, param_count, summary
+
+    m = lenet5(10)
+    p = m.init(jax.random.PRNGKey(0))
+    s = summary(m, p)
+    total = param_count(p)
+    assert f"total params:" in s and "Linear" in s
+    assert total == sum(int(x.size) for x in jax.tree_util.tree_leaves(p))
+    assert param_bytes(p) == 4 * total  # fp32 params
+    # the root line reports the full total
+    assert s.splitlines()[0].endswith(
+        s.splitlines()[-1].split(":")[1].split("(")[0].strip())
