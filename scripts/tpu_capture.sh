@@ -5,12 +5,16 @@
 set -uo pipefail
 cd "$(dirname "$0")/.."
 OUT="${OUT:-/tmp/tpu_capture.log}"
+# mirror into the repo on every step so a capture that fires after the
+# builder's last turn still gets committed by the round driver
+trap 'cp -f "$OUT" TPU_CAPTURE_r03.log 2>/dev/null || true' EXIT
 
 step() {
   local name="$1" tmo="$2"; shift 2
   echo "=== $name ($(date -u +%H:%M:%SZ))" | tee -a "$OUT"
   timeout "$tmo" "$@" 2>&1 | tail -30 | tee -a "$OUT"
   echo "=== end $name rc=$?" | tee -a "$OUT"
+  cp -f "$OUT" TPU_CAPTURE_r03.log 2>/dev/null || true
 }
 
 # MFU trajectory (b64..b512) + variants
