@@ -40,6 +40,13 @@ __all__ = ["flash_attention", "blockwise_attention",
 
 _NEG_INF = -1e30
 
+# lse/delta per-query vectors carry a replicated trailing lane dim inside
+# the Pallas calls so their blocks satisfy the TPU tiling rules. 8 is legal
+# only via the block-dim-equals-array-dim escape (the lane rule is
+# otherwise %128 — see _fwd_kernel._emit); it is the cheapest layout that
+# escape admits.
+_LSE_LANES = 8
+
 
 def online_softmax_update(q, kb, vb, m, l, acc, scale, valid=None):
     """One block step of the streaming softmax shared by
@@ -208,8 +215,15 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
     def _emit():
         l_safe = jnp.maximum(l_scr[...], 1e-30)
         o_ref[0] = (acc_scr[...] / l_safe).astype(o_ref.dtype)
-        # per-query logsumexp, saved for the backward kernels' recompute
-        lse_ref[0] = (m_scr[...] + jnp.log(l_safe))[:, 0]
+        # per-query logsumexp, saved for the backward kernels' recompute.
+        # Replicated across a trailing 8-lane dim: Mosaic requires the last
+        # two block dims to be (8k, 128k) or equal to the array dims, so a
+        # per-(bh,q) 2-D layout with block (1, bq) cannot lower — same
+        # reason jax's own TPU flash kernel stores lse as (..., seq, 128);
+        # 8 lanes is the cheapest legal layout (last block dim == array
+        # dim escape).
+        lse_ref[0] = jnp.broadcast_to(
+            m_scr[...] + jnp.log(l_safe), lse_ref.shape[1:])
 
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
@@ -233,8 +247,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     def _step():
         q = q_ref[0]
         do = do_ref[0]
-        lse = lse_ref[0][:, None]      # (BQ, 1) f32
-        delta = delta_ref[0][:, None]  # (BQ, 1) f32
+        lse = lse_ref[0][:, :1]      # (BQ, 1) f32 (lanes replicated)
+        delta = delta_ref[0][:, :1]  # (BQ, 1) f32
         kblk = k_ref[0]
         vblk = v_ref[0]
         s = jax.lax.dot_general(
@@ -286,8 +300,8 @@ def _dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref, dk_ref,
         v = v_ref[0]
         qblk = q_ref[0]
         doblk = do_ref[0]
-        lse = lse_ref[0][:, None]
-        delta = delta_ref[0][:, None]
+        lse = lse_ref[0][:, :1]
+        delta = delta_ref[0][:, :1]
         s = jax.lax.dot_general(  # Q @ K^T  (BQ, BK)
             qblk, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
@@ -348,7 +362,10 @@ def _tileable(s_q, s_k, block_k) -> bool:
 
 
 def _flash_fwd(q, k, v, causal: bool, block_q: int, block_k: int):
-    """Pallas forward; returns (out, lse) with lse in (b*h, padded_sq)."""
+    """Pallas forward; returns (out, lse) with lse in (b*h, padded_sq).
+    The kernel emits lse lane-replicated (see _LSE_LANES); the replica dim
+    is squeezed off here so the custom_vjp residual stores 4 B/query, not
+    32 B — the backward re-broadcasts next to its delta broadcast."""
     from jax.experimental import pallas as pl
 
     b, h, s_q, d = q.shape
@@ -377,11 +394,11 @@ def _flash_fwd(q, k, v, causal: bool, block_q: int, block_k: int):
         ],
         out_specs=[
             pl.BlockSpec((1, bq, d), lambda i, j, kk: (i, j, 0)),
-            pl.BlockSpec((1, bq), lambda i, j, kk: (i, j)),
+            pl.BlockSpec((1, bq, _LSE_LANES), lambda i, j, kk: (i, j, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
-            jax.ShapeDtypeStruct((b * h, sq), jnp.float32),
+            jax.ShapeDtypeStruct((b * h, sq, _LSE_LANES), jnp.float32),
         ],
         scratch_shapes=[
             pltpu_scratch((bq, 1)), pltpu_scratch((bq, 1)),
@@ -390,7 +407,7 @@ def _flash_fwd(q, k, v, causal: bool, block_q: int, block_k: int):
         interpret=_interpret(),
     )(qf, kf, vf)
     o = out[:, :s_q] if pad_q else out
-    return o.reshape(b, h, s_q, d), lse
+    return o.reshape(b, h, s_q, d), lse[..., 0]
 
 
 def _flash_bwd(q, k, v, o, lse, g, causal: bool, block_q: int,
@@ -410,12 +427,16 @@ def _flash_bwd(q, k, v, o, lse, g, causal: bool, block_q: int,
 
     bq = min(block_q, max(8, s_q))
     bk = min(block_k, max(8, s_k))
-    # delta_i = sum_d dO_i * O_i — one cheap fused pass in plain XLA
+    # delta_i = sum_d dO_i * O_i — one cheap fused pass in plain XLA;
+    # replicated over _LSE_LANES to match lse's TPU-tileable layout
     delta = jnp.sum(dof.astype(jnp.float32) * of.astype(jnp.float32),
                     axis=-1)
     qf, pad_q = _pad_to(qf, bq, 1)
     dof, _ = _pad_to(dof, bq, 1)
     delta, _ = _pad_to(delta, bq, 1)
+    delta = jnp.broadcast_to(delta[..., None],
+                             delta.shape + (_LSE_LANES,))
+    lse = jnp.broadcast_to(lse[..., None], lse.shape + (_LSE_LANES,))
     sq, sk = qf.shape[1], kf.shape[1]
     q_offset = s_k - s_q
     interpret = _interpret()
@@ -429,8 +450,8 @@ def _flash_bwd(q, k, v, o, lse, g, causal: bool, block_q: int,
             pl.BlockSpec((1, bk, d), lambda i, j, kk: (i, kk, 0)),
             pl.BlockSpec((1, bk, d), lambda i, j, kk: (i, kk, 0)),
             pl.BlockSpec((1, bq, d), lambda i, j, kk: (i, j, 0)),
-            pl.BlockSpec((1, bq), lambda i, j, kk: (i, j)),
-            pl.BlockSpec((1, bq), lambda i, j, kk: (i, j)),
+            pl.BlockSpec((1, bq, _LSE_LANES), lambda i, j, kk: (i, j, 0)),
+            pl.BlockSpec((1, bq, _LSE_LANES), lambda i, j, kk: (i, j, 0)),
         ],
         out_specs=pl.BlockSpec((1, bq, d), lambda i, j, kk: (i, j, 0)),
         out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
@@ -447,8 +468,8 @@ def _flash_bwd(q, k, v, o, lse, g, causal: bool, block_q: int,
             pl.BlockSpec((1, bk, d), lambda i, j, qq: (i, j, 0)),
             pl.BlockSpec((1, bq, d), lambda i, j, qq: (i, qq, 0)),
             pl.BlockSpec((1, bq, d), lambda i, j, qq: (i, qq, 0)),
-            pl.BlockSpec((1, bq), lambda i, j, qq: (i, qq)),
-            pl.BlockSpec((1, bq), lambda i, j, qq: (i, qq)),
+            pl.BlockSpec((1, bq, _LSE_LANES), lambda i, j, qq: (i, qq, 0)),
+            pl.BlockSpec((1, bq, _LSE_LANES), lambda i, j, qq: (i, qq, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, bk, d), lambda i, j, qq: (i, j, 0)),

@@ -1,0 +1,18 @@
+#!/usr/bin/env bash
+# Poll the axon TPU tunnel; the moment a backend probe succeeds, run the
+# capture sweep given as $1 (default scripts/tpu_capture2.sh). The probe is
+# a subprocess with a hard timeout because backend init HANGS (not errors)
+# while the tunnel is down.
+set -u
+cd "$(dirname "$0")/.."
+SWEEP="${1:-scripts/tpu_capture2.sh}"
+while true; do
+  if timeout 120 python -c "
+import jax
+assert jax.default_backend() == 'tpu', jax.default_backend()
+print('tpu up:', jax.devices()[0].device_kind)
+" 2>/dev/null; then
+    exec bash "$SWEEP"
+  fi
+  sleep 180
+done
