@@ -1,5 +1,6 @@
 from bigdl_tpu.parallel.mesh import (
-    init_distributed, make_mesh, local_mesh, P, NamedSharding,
+    init_distributed, make_mesh, make_hybrid_mesh, local_mesh, P,
+    NamedSharding,
 )
 from bigdl_tpu.parallel.data_parallel import (
     DataParallel, FullyShardedDataParallel,

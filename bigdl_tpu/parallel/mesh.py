@@ -24,7 +24,8 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-__all__ = ["init_distributed", "make_mesh", "local_mesh", "P", "NamedSharding"]
+__all__ = ["init_distributed", "make_mesh", "make_hybrid_mesh",
+           "local_mesh", "P", "NamedSharding"]
 
 
 def init_distributed(coordinator_address: Optional[str] = None,
@@ -57,6 +58,63 @@ def make_mesh(axes: dict[str, int], devices: Optional[Sequence] = None) -> Mesh:
                          f"devices, have {len(devices)}")
     arr = np.asarray(devices).reshape(sizes)
     return Mesh(arr, tuple(names))
+
+
+def make_hybrid_mesh(dcn_axes: dict[str, int], ici_axes: dict[str, int],
+                     devices: Optional[Sequence] = None,
+                     num_slices: Optional[int] = None) -> Mesh:
+    """Mesh spanning multiple ICI domains (TPU slices / pods) joined by
+    DCN — the multi-slice topology the reference reaches with one Spark
+    cluster over many Xeon hosts (its only inter-node axis is data,
+    DistriOptimizer.scala; here any named axis can be placed on either
+    fabric). ``dcn_axes`` are laid out across slices (outermost, so their
+    collectives ride the data-center network), ``ici_axes`` within a slice
+    (inner, riding the chip interconnect) — the standard
+    dp-over-DCN x tp/sp-over-ICI recipe.
+
+    Slice membership comes from ``device.slice_index`` (real multi-slice
+    TPU), falling back to ``process_index`` (multi-host CPU/test
+    environments). When the runtime reports a single slice (e.g. the
+    8-device virtual CPU mesh) pass ``num_slices`` to partition the
+    device list into that many equal contiguous virtual slices.
+
+    Axis sizes: the product of ``dcn_axes`` must equal the slice count;
+    the product of ``ici_axes`` must equal the per-slice device count
+    (one -1 wildcard allowed in each, as in :func:`make_mesh`).
+    """
+    devices = list(devices if devices is not None else jax.devices())
+
+    groups: dict[int, list] = {}
+    for d in devices:
+        groups.setdefault(
+            getattr(d, "slice_index", d.process_index), []).append(d)
+    if len(groups) == 1 and num_slices and num_slices > 1:
+        if len(devices) % num_slices:
+            raise ValueError(f"{len(devices)} devices do not split into "
+                             f"{num_slices} equal virtual slices")
+        per = len(devices) // num_slices
+        groups = {i: devices[i * per:(i + 1) * per]
+                  for i in range(num_slices)}
+    slices = [groups[k] for k in sorted(groups)]
+    per_slice = len(slices[0])
+    if any(len(s) != per_slice for s in slices):
+        raise ValueError("slices are unequal: "
+                         f"{[len(s) for s in slices]} devices per slice")
+
+    def _resolve(axes: dict[str, int], total: int, what: str):
+        names, sizes = list(axes.keys()), list(axes.values())
+        if -1 in sizes:
+            known = int(np.prod([s for s in sizes if s != -1]))
+            sizes[sizes.index(-1)] = total // max(known, 1)
+        if int(np.prod(sizes)) != total:
+            raise ValueError(f"{what} axes {dict(zip(names, sizes))} "
+                             f"must multiply to {total}")
+        return names, sizes
+
+    dcn_names, dcn_sizes = _resolve(dcn_axes, len(slices), "dcn")
+    ici_names, ici_sizes = _resolve(ici_axes, per_slice, "ici")
+    arr = np.asarray([s for s in slices]).reshape(dcn_sizes + ici_sizes)
+    return Mesh(arr, tuple(dcn_names + ici_names))
 
 
 def local_mesh(data_axis: str = "data") -> Mesh:

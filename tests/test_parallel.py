@@ -31,6 +31,78 @@ def test_mesh_construction():
         make_mesh({"data": 3})
 
 
+def test_hybrid_mesh_construction():
+    """make_hybrid_mesh: dcn axes outermost across (virtual) slices, ici
+    axes filling each slice; slice membership must be contiguous so ici
+    collectives never cross a slice boundary."""
+    from bigdl_tpu.parallel import make_hybrid_mesh
+
+    devs = jax.devices()
+    m = make_hybrid_mesh({"data": 2}, {"seq": 2, "model": 2},
+                         num_slices=2)
+    assert tuple(m.axis_names) == ("data", "seq", "model")
+    assert m.shape["data"] == 2 and m.shape["seq"] == 2
+    # every device in the data=0 plane comes from the first virtual slice
+    assert set(m.devices[0].ravel()) == set(devs[:4])
+    assert set(m.devices[1].ravel()) == set(devs[4:])
+    # -1 wildcard in the ici axes
+    m2 = make_hybrid_mesh({"data": 2}, {"model": -1}, num_slices=2)
+    assert m2.shape["model"] == 4
+    with pytest.raises(ValueError):  # dcn product != slice count
+        make_hybrid_mesh({"data": 4}, {"model": 2}, num_slices=2)
+    with pytest.raises(ValueError):  # devices don't split evenly
+        make_hybrid_mesh({"data": 3}, {"model": 2}, num_slices=3)
+
+
+def test_hybrid_mesh_matches_flat_mesh(rng):
+    """A TP transformer step over dcn(data) x ici(seq, model) computes the
+    same loss as over the flat make_mesh with identical axis sizes — the
+    hybrid layout changes device placement, not math."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from bigdl_tpu.parallel import (TensorParallel, make_hybrid_mesh,
+                                    make_ring_attention)
+    from bigdl_tpu.optim import SGD
+
+    rs = np.random.RandomState(11)
+    x_h = rs.randn(4, 8, 16).astype(np.float32)
+    y_h = rs.randn(4, 8, 16).astype(np.float32)
+
+    def run(mesh):
+        attn = make_ring_attention(mesh, "seq", batch_axis="data")
+        enc = nn.TransformerEncoder(num_layers=1, d_model=16, num_heads=4,
+                                    d_ff=32, causal=True, attn_impl=attn)
+        crit = nn.MSECriterion()
+        opt = SGD(learning_rate=0.1)
+        strat = TensorParallel(mesh, enc)
+        params = enc.init(jax.random.PRNGKey(0))
+        params, ms, os_ = strat.place(params, enc.init_state(),
+                                      opt.init(params))
+
+        def train_step(params, ms, os_, x, y, r):
+            def loss_fn(p):
+                out, ms2 = enc.apply(p, ms, x, training=True, rng=r)
+                return crit(out, y), ms2
+
+            (loss, ms2), g = jax.value_and_grad(loss_fn, has_aux=True)(
+                params)
+            np_, no_ = opt.update(g, os_, params)
+            return np_, ms2, no_, loss
+
+        spec = P("data", "seq", None)
+        step = strat.compile_step(train_step, batch_spec=spec)
+        sh = NamedSharding(mesh, spec)
+        x = jax.device_put(jnp.asarray(x_h), sh)
+        y = jax.device_put(jnp.asarray(y_h), sh)
+        out = step(params, ms, os_, x, y, jax.random.PRNGKey(1))
+        return float(out[-1])
+
+    flat = run(make_mesh({"data": 2, "seq": 2, "model": 2}))
+    hybrid = run(make_hybrid_mesh({"data": 2}, {"seq": 2, "model": 2},
+                                  num_slices=2))
+    np.testing.assert_allclose(hybrid, flat, rtol=1e-5)
+
+
 def test_data_parallel_step_matches_single_device(rng):
     """Same data, same init => DP-8 must produce the same params as 1-device
     training (the reference asserts Distri == Ref optimizer,
