@@ -1,0 +1,127 @@
+"""Attention golden tests against the torch oracle (the reference checks
+every layer against torch, dl/src/test/.../th/*Spec.scala; attention is new
+capability, so the oracle is torch.nn.MultiheadAttention itself — identical
+weights in both frameworks, outputs and input-gradients compared).
+
+Covers the wiring bugs self-consistency tests can't see: q/k/v projection
+packing order (torch packs in_proj as [q;k;v] rows), pre- vs post-transpose
+weight layout (torch computes x @ W.T), mask polarity (torch
+key_padding_mask marks PADS True; ours marks ATTEND True), and causal-mask
+alignment.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import torch
+from torch import nn as tnn
+
+from bigdl_tpu import nn
+
+ATOL = 1e-5
+
+
+def _pair(d_model=32, num_heads=4, seed=0):
+    """Build (ours, torch) MHA with identical weights; return
+    (module, params, torch_module)."""
+    ours = nn.MultiHeadAttention(d_model, num_heads)
+    params = ours.init(jax.random.PRNGKey(seed))
+    ref = tnn.MultiheadAttention(d_model, num_heads, batch_first=True)
+    with torch.no_grad():
+        # torch packs q,k,v projection rows into in_proj_weight (3d, d)
+        # and applies x @ W.T; ours stores (d_in, d_out) applied x @ W
+        w = np.concatenate([np.asarray(params[k]).T
+                            for k in ("wq", "wk", "wv")], axis=0)
+        b = np.concatenate([np.asarray(params[k])
+                            for k in ("bq", "bk", "bv")], axis=0)
+        ref.in_proj_weight.copy_(torch.from_numpy(w))
+        ref.in_proj_bias.copy_(torch.from_numpy(b))
+        ref.out_proj.weight.copy_(
+            torch.from_numpy(np.asarray(params["wo"]).T))
+        ref.out_proj.bias.copy_(torch.from_numpy(np.asarray(params["bo"])))
+    return ours, params, ref
+
+
+def test_mha_matches_torch_self_attention():
+    ours, params, ref = _pair()
+    x = np.random.RandomState(0).randn(2, 10, 32).astype(np.float32)
+    got = ours.forward(params, jnp.asarray(x))
+    want, _ = ref(torch.from_numpy(x), torch.from_numpy(x),
+                  torch.from_numpy(x), need_weights=False)
+    np.testing.assert_allclose(np.asarray(got), want.detach().numpy(),
+                               atol=ATOL)
+
+
+def test_mha_matches_torch_causal():
+    d, h, s = 32, 4, 12
+    ours = nn.MultiHeadAttention(d, h, causal=True)
+    params = ours.init(jax.random.PRNGKey(1))
+    _, _, ref = _pair(d, h)
+    # re-copy weights from the causal module's params
+    with torch.no_grad():
+        w = np.concatenate([np.asarray(params[k]).T
+                            for k in ("wq", "wk", "wv")], axis=0)
+        b = np.concatenate([np.asarray(params[k])
+                            for k in ("bq", "bk", "bv")], axis=0)
+        ref.in_proj_weight.copy_(torch.from_numpy(w))
+        ref.in_proj_bias.copy_(torch.from_numpy(b))
+        ref.out_proj.weight.copy_(
+            torch.from_numpy(np.asarray(params["wo"]).T))
+        ref.out_proj.bias.copy_(torch.from_numpy(np.asarray(params["bo"])))
+    x = np.random.RandomState(2).randn(2, s, d).astype(np.float32)
+    got = ours.forward(params, jnp.asarray(x))
+    causal = torch.triu(torch.ones(s, s, dtype=torch.bool), diagonal=1)
+    want, _ = ref(torch.from_numpy(x), torch.from_numpy(x),
+                  torch.from_numpy(x), attn_mask=causal, need_weights=False)
+    np.testing.assert_allclose(np.asarray(got), want.detach().numpy(),
+                               atol=ATOL)
+
+
+def test_mha_matches_torch_cross_attention():
+    ours, params, ref = _pair(seed=3)
+    rs = np.random.RandomState(3)
+    q = rs.randn(2, 7, 32).astype(np.float32)
+    kv = rs.randn(2, 13, 32).astype(np.float32)
+    got = ours.forward(params, (jnp.asarray(q), jnp.asarray(kv)))
+    want, _ = ref(torch.from_numpy(q), torch.from_numpy(kv),
+                  torch.from_numpy(kv), need_weights=False)
+    np.testing.assert_allclose(np.asarray(got), want.detach().numpy(),
+                               atol=ATOL)
+
+
+def test_mha_matches_torch_key_padding():
+    """Mask polarity: ours is True=attend, torch's key_padding_mask is
+    True=PAD — an inverted copy must produce identical outputs on the
+    un-padded queries."""
+    ours, params, ref = _pair(seed=4)
+    rs = np.random.RandomState(4)
+    s = 9
+    x = rs.randn(2, s, 32).astype(np.float32)
+    attend = np.ones((2, s), bool)
+    attend[0, 6:] = False
+    attend[1, 4:] = False
+    got = ours.forward(params, (jnp.asarray(x), jnp.asarray(x),
+                                jnp.asarray(attend)))
+    want, _ = ref(torch.from_numpy(x), torch.from_numpy(x),
+                  torch.from_numpy(x),
+                  key_padding_mask=torch.from_numpy(~attend),
+                  need_weights=False)
+    got, want = np.asarray(got), want.detach().numpy()
+    # padded key positions are still valid queries in both, but torch
+    # defines them via softmax over an all--inf row differently across
+    # versions; compare only rows attending to something real
+    np.testing.assert_allclose(got[0, :], want[0, :], atol=ATOL)
+    np.testing.assert_allclose(got[1, :], want[1, :], atol=ATOL)
+
+
+def test_mha_gradient_matches_torch():
+    ours, params, ref = _pair(seed=5)
+    x = np.random.RandomState(5).randn(2, 8, 32).astype(np.float32)
+
+    gx = jax.grad(
+        lambda xx: jnp.sum(ours.forward(params, xx) ** 2))(jnp.asarray(x))
+
+    xt = torch.from_numpy(x).requires_grad_(True)
+    out, _ = ref(xt, xt, xt, need_weights=False)
+    (out ** 2).sum().backward()
+    np.testing.assert_allclose(np.asarray(gx), xt.grad.numpy(), atol=1e-4)
