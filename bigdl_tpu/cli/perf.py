@@ -71,12 +71,20 @@ def build_model(name: str, class_num: int = 1000):
             pos_encoding="rope", num_kv_heads=2,
             attn_impl=("flash" if jax.default_backend() == "tpu"
                        else None)),
+        # larger config at 1k context: matmuls big enough that MFU reflects
+        # the MXU, not dispatch/embedding overhead
+        "transformer_lm_1k": lambda: models.transformer_lm(
+            _LM_VOCAB, d_model=1024, num_layers=12, num_heads=16,
+            max_len=1024, pos_encoding="rope", num_kv_heads=4,
+            attn_impl=("flash" if jax.default_backend() == "tpu"
+                       else None)),
     }
     if name not in table:
         raise SystemExit(f"unknown model {name}; choose from {list(table)}")
     size = {"lenet5": (28, 28, 1),
             "transformer_lm": (512,),
-            "transformer_lm_rope": (512,)}.get(name, (224, 224, 3))
+            "transformer_lm_rope": (512,),
+            "transformer_lm_1k": (1024,)}.get(name, (224, 224, 3))
     return table[name](), size
 
 

@@ -32,6 +32,7 @@ step "perf_resnet50_inner10_b128" 900 python -m bigdl_tpu.cli.perf -m resnet50 -
 # transformer (flash kernel on the compiled path)
 step "perf_transformer_lm_b32" 900 python -m bigdl_tpu.cli.perf -m transformer_lm -b 32 -i 10 --dataType random
 step "perf_transformer_lm_rope_b32" 900 python -m bigdl_tpu.cli.perf -m transformer_lm_rope -b 32 -i 10 --dataType random
+step "perf_transformer_lm_1k_b16" 900 python -m bigdl_tpu.cli.perf -m transformer_lm_1k -b 16 -i 10 --dataType random
 
 # flash vs dense microbenchmark
 step "flash_bench" 1800 python scripts/flash_bench.py 4 8 64
