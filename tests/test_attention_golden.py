@@ -114,6 +114,49 @@ def test_mha_matches_torch_key_padding():
     np.testing.assert_allclose(got[1, :], want[1, :], atol=ATOL)
 
 
+def test_encoder_layer_matches_torch():
+    """Full pre-LN block vs torch.nn.TransformerEncoderLayer(
+    norm_first=True): LN placement, residual wiring, and the GELU flavor
+    (jax.nn.gelu defaults to the tanh approximation — torch must be told)."""
+    import torch.nn.functional as F
+
+    d, h, ff = 32, 4, 64
+    ours = nn.TransformerEncoderLayer(d_model=d, num_heads=h, d_ff=ff)
+    params = ours.init(jax.random.PRNGKey(6))
+    ref = tnn.TransformerEncoderLayer(
+        d, h, dim_feedforward=ff, batch_first=True, norm_first=True,
+        activation=lambda t: F.gelu(t, approximate="tanh"), dropout=0.0)
+    mp = params["mha"]
+    with torch.no_grad():
+        w = np.concatenate([np.asarray(mp[k]).T
+                            for k in ("wq", "wk", "wv")], axis=0)
+        b = np.concatenate([np.asarray(mp[k])
+                            for k in ("bq", "bk", "bv")], axis=0)
+        ref.self_attn.in_proj_weight.copy_(torch.from_numpy(w))
+        ref.self_attn.in_proj_bias.copy_(torch.from_numpy(b))
+        ref.self_attn.out_proj.weight.copy_(
+            torch.from_numpy(np.asarray(mp["wo"]).T))
+        ref.self_attn.out_proj.bias.copy_(
+            torch.from_numpy(np.asarray(mp["bo"])))
+        ref.linear1.weight.copy_(torch.from_numpy(np.asarray(params["w1"]).T))
+        ref.linear1.bias.copy_(torch.from_numpy(np.asarray(params["b1"])))
+        ref.linear2.weight.copy_(torch.from_numpy(np.asarray(params["w2"]).T))
+        ref.linear2.bias.copy_(torch.from_numpy(np.asarray(params["b2"])))
+        ref.norm1.weight.copy_(
+            torch.from_numpy(np.asarray(params["ln1"]["weight"])))
+        ref.norm1.bias.copy_(
+            torch.from_numpy(np.asarray(params["ln1"]["bias"])))
+        ref.norm2.weight.copy_(
+            torch.from_numpy(np.asarray(params["ln2"]["weight"])))
+        ref.norm2.bias.copy_(
+            torch.from_numpy(np.asarray(params["ln2"]["bias"])))
+    x = np.random.RandomState(6).randn(2, 10, d).astype(np.float32)
+    got, _ = ours.apply(params, {}, jnp.asarray(x))
+    want = ref(torch.from_numpy(x))
+    np.testing.assert_allclose(np.asarray(got), want.detach().numpy(),
+                               atol=ATOL)
+
+
 def test_mha_gradient_matches_torch():
     ours, params, ref = _pair(seed=5)
     x = np.random.RandomState(5).randn(2, 8, 32).astype(np.float32)
