@@ -119,16 +119,27 @@ class Optimizer:
 
     def set_checkpoint(self, trigger: Trigger, path: str,
                        overwrite: bool = False,
-                       sharded: bool = False) -> "Optimizer":
+                       sharded: bool = False,
+                       async_save: bool = False) -> "Optimizer":
         """(reference Optimizer.setCheckpoint :87-94 +
         overWriteCheckpoint flag: refuse to clobber an existing snapshot
         unless ``overwrite``). ``sharded=True`` writes orbax shards
         directly from each host instead of gathering to one blob —
-        the pod-scale path (utils/orbax_ckpt.py)."""
+        the pod-scale path (utils/orbax_ckpt.py). ``async_save=True``
+        snapshots the pytrees to host memory and serializes on a
+        background thread, so the step loop only pays the device->host
+        copy, not the disk/remote write (single-blob path only; a prior
+        in-flight write is joined — and its errors re-raised — before
+        the next snapshot starts and at the end of optimize())."""
+        if async_save and sharded:
+            raise ValueError("async_save supports the single-blob path; "
+                             "orbax sharded writes are per-host streaming "
+                             "already")
         self._ckpt_trigger = trigger
         self._ckpt_path = path
         self._ckpt_overwrite = overwrite
         self._ckpt_sharded = sharded
+        self._ckpt_async = async_save
         return self
 
     def set_gradient_clipping_by_l2_norm(self, max_norm: float
@@ -359,6 +370,7 @@ class Optimizer:
             self._maybe_validate(eval_fn, params, mod_state, driver)
             self._maybe_checkpoint(params, mod_state, opt_state, driver)
 
+        self._join_ckpt_writer()  # drain any in-flight async write
         logger.info("Training finished after %d iterations in %.1fs",
                     driver["iteration"], time.time() - wall_start)
         return TrainedModel(self.model, params, mod_state)
@@ -404,8 +416,44 @@ class Optimizer:
             if self.strategy is not None:
                 params, mod_state, opt_state = self.strategy.gather(
                     params, mod_state, opt_state)
+            state_target = os.path.join(self._ckpt_path, f"state.{n}")
+            if getattr(self, "_ckpt_async", False):
+                self._join_ckpt_writer()  # one in-flight write at a time
+                # device->host snapshot on the loop thread (cheap, and the
+                # arrays must be frozen before the next step mutates them);
+                # serialization + IO move to the worker
+                snap_model = jax.device_get(
+                    {"params": params, "mod_state": mod_state})
+                snap_opt = jax.device_get(opt_state)
+
+                def _write():
+                    save_pytree(snap_model, target)
+                    save_pytree(snap_opt, state_target)
+                    logger.info("Checkpoint written at iteration %d to %s "
+                                "(async)", n, self._ckpt_path)
+
+                import threading
+                self._ckpt_thread = threading.Thread(
+                    target=self._ckpt_worker, args=(_write,), daemon=True)
+                self._ckpt_thread.start()
+                return
             save_pytree({"params": params, "mod_state": mod_state}, target)
-            save_pytree(opt_state,
-                        os.path.join(self._ckpt_path, f"state.{n}"))
+            save_pytree(opt_state, state_target)
         logger.info("Checkpoint written at iteration %d to %s", n,
                     self._ckpt_path)
+
+    def _ckpt_worker(self, write_fn):
+        try:
+            write_fn()
+        except BaseException as e:  # surfaced at the next join
+            self._ckpt_error = e
+
+    def _join_ckpt_writer(self):
+        t = getattr(self, "_ckpt_thread", None)
+        if t is not None:
+            t.join()
+            self._ckpt_thread = None
+        err = getattr(self, "_ckpt_error", None)
+        if err is not None:
+            self._ckpt_error = None
+            raise RuntimeError("async checkpoint write failed") from err

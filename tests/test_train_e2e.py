@@ -108,3 +108,43 @@ def test_classnll_training_reduces_loss():
     trained = opt.optimize()
     # loss recorded in driver state via metrics
     assert opt.metrics.mean("computing time") > 0
+
+
+def test_async_checkpoint_matches_sync(tmp_path):
+    """async_save=True must produce byte-identical checkpoint content to
+    the synchronous path (same seeds => same training trajectory), drain
+    the in-flight write before optimize() returns, and refuse the
+    sharded combination."""
+    import pytest
+
+    x, y = _xor_data(128)
+
+    def train(ckpt, async_save):
+        ds = BatchDataSet(x, y, batch_size=32, shuffle=True)
+        model = Sequential(nn.Linear(2, 8), nn.Tanh(), nn.Linear(8, 2),
+                           nn.LogSoftMax())
+        opt = Optimizer(model, ds, nn.ClassNLLCriterion(),
+                        optim_method=SGD(learning_rate=0.2, momentum=0.9),
+                        end_when=Trigger.max_epoch(3))
+        opt.set_checkpoint(Trigger.every_epoch(), ckpt,
+                           async_save=async_save)
+        opt.optimize()
+
+    sync_dir, async_dir = str(tmp_path / "s"), str(tmp_path / "a")
+    train(sync_dir, False)
+    train(async_dir, True)
+
+    mp_s = latest_checkpoint(sync_dir, "model.")
+    mp_a = latest_checkpoint(async_dir, "model.")
+    assert os.path.basename(mp_s) == os.path.basename(mp_a)
+    a, b = load_pytree(mp_s), load_pytree(mp_a)
+    jax.tree.map(np.testing.assert_array_equal, a, b)
+    sa = load_pytree(latest_checkpoint(sync_dir, "state."))
+    sb = load_pytree(latest_checkpoint(async_dir, "state."))
+    jax.tree.map(np.testing.assert_array_equal, sa, sb)
+
+    with pytest.raises(ValueError):
+        Optimizer(Sequential(nn.Linear(2, 2)),
+                  BatchDataSet(x, y, 32), nn.ClassNLLCriterion()
+                  ).set_checkpoint(Trigger.every_epoch(), str(tmp_path),
+                                   sharded=True, async_save=True)
