@@ -310,7 +310,10 @@ def main(argv=None):
     p.add_argument("--innerSteps", type=int, default=1,
                    help="steps chained inside one compiled program "
                         "(amortizes dispatch overhead)")
+    from bigdl_tpu.cli.common import _add_platform_arg, apply_platform
+    _add_platform_arg(p)
     args = p.parse_args(argv)
+    apply_platform(args)
     run(args.model, args.batchSize, args.iteration, args.dataType,
         use_bf16=not args.f32, data_parallel=args.dataParallel,
         data_source=args.data, inner_steps=args.innerSteps)

@@ -1,5 +1,5 @@
 from bigdl_tpu.optim.method import (
-    OptimMethod, SGD, Adagrad, Adam, AdamW, EMA, LARS, RMSprop,
+    OptimMethod, SGD, Adagrad, Adam, AdamW, EMA, LAMB, LARS, RMSprop,
     clip_by_global_norm, clip_by_value,
 )
 from bigdl_tpu.optim.schedules import (

@@ -18,7 +18,8 @@ import jax.numpy as jnp
 
 from bigdl_tpu.optim.schedules import Default, LearningRateSchedule
 
-__all__ = ["OptimMethod", "SGD", "Adagrad", "Adam", "RMSprop"]
+__all__ = ["OptimMethod", "SGD", "Adagrad", "Adam", "AdamW", "EMA",
+           "LAMB", "LARS", "RMSprop"]
 
 
 class OptimMethod:
@@ -244,6 +245,43 @@ class AdamW(Adam):
             v_new = b2 * v + (1 - b2) * jnp.square(g)
             upd = (m_new / bc1) / (jnp.sqrt(v_new / bc2) + self.eps)
             return w - lr * (upd + self.weight_decay * w), m_new, v_new
+
+        out = jax.tree_util.tree_map(one, grads, params,
+                                     opt_state["m"], opt_state["v"])
+        is_t = lambda t_: isinstance(t_, tuple)
+        pick = lambda i: jax.tree_util.tree_map(lambda t_: t_[i], out,
+                                                is_leaf=is_t)
+        return pick(0), {"step": t, "epoch": opt_state["epoch"],
+                         "m": pick(1), "v": pick(2)}
+
+
+class LAMB(Adam):
+    """Layer-wise adaptive large-batch Adam (You et al., the optimizer
+    behind 76-minute BERT): the AdamW update direction is rescaled per
+    layer by ||w|| / ||update||, so every layer moves a comparable
+    relative distance regardless of its gradient scale. The transformer
+    counterpart of LARS for the b512+ regime; bias/LN leaves (ndim <= 1)
+    skip the trust-ratio and weight decay as in LARS."""
+
+    def update(self, grads, opt_state, params):
+        t = opt_state["step"] + 1
+        lr = self.schedule(self.base_lr, opt_state["step"],
+                           opt_state["epoch"])
+        b1, b2 = self.beta1, self.beta2
+        bc1 = 1.0 - jnp.power(b1, t)
+        bc2 = 1.0 - jnp.power(b2, t)
+
+        def one(g, w, m, v):
+            m_new = b1 * m + (1 - b1) * g
+            v_new = b2 * v + (1 - b2) * jnp.square(g)
+            upd = (m_new / bc1) / (jnp.sqrt(v_new / bc2) + self.eps)
+            if w.ndim <= 1:
+                return w - lr * upd, m_new, v_new
+            upd = upd + self.weight_decay * w
+            wn = jnp.sqrt(jnp.sum(jnp.square(w)))
+            un = jnp.sqrt(jnp.sum(jnp.square(upd)))
+            trust = jnp.where((wn > 0) & (un > 0), wn / un, 1.0)
+            return w - lr * trust * upd, m_new, v_new
 
         out = jax.tree_util.tree_map(one, grads, params,
                                      opt_state["m"], opt_state["v"])

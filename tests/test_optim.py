@@ -262,3 +262,43 @@ def test_cosine_and_warmup_schedules():
                                rtol=1e-6)
     w2 = Warmup(5)  # constant after warmup
     assert float(w2(2.0, 100, 0)) == 2.0
+
+
+def test_lamb_trust_ratio_and_bias_exclusion():
+    """LAMB rescales each matrix layer's AdamW direction by
+    ||w||/||update||; 1-D leaves get plain bias-corrected Adam. On the
+    first step Adam's corrected update is sign(g), so the trust ratio is
+    computable in closed form."""
+    import jax.numpy as jnp
+
+    from bigdl_tpu.optim import LAMB
+
+    opt = LAMB(learning_rate=0.1, weight_decay=0.0, eps=0.0)
+    w = jnp.full((2, 2), 3.0)   # ||w|| = 6
+    b = jnp.full((2,), 3.0)
+    g = jnp.full((2, 2), 0.5)
+    gb = jnp.full((2,), 0.5)
+    p = {"w": w, "b": b}
+    st = opt.init(p)
+    p2, st2 = opt.update({"w": g, "b": gb}, st, p)
+    # step-1 update = sign(g) = 1 everywhere -> ||upd|| = 2, trust = 6/2
+    np.testing.assert_allclose(np.asarray(p2["w"]), 3.0 - 0.1 * 3.0,
+                               rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(p2["b"]), 3.0 - 0.1, rtol=1e-5)
+    assert float(st2["step"]) == 1
+
+
+def test_lamb_converges_quadratic():
+    import jax
+    import jax.numpy as jnp
+
+    from bigdl_tpu.optim import LAMB
+
+    opt = LAMB(learning_rate=0.05, weight_decay=0.01)
+    p = {"w": jnp.asarray([[2.0, -3.0], [1.0, 4.0]])}
+    st = opt.init(p)
+    loss = lambda p_: jnp.sum(jnp.square(p_["w"] - 1.0))
+    for _ in range(200):
+        g = jax.grad(loss)(p)
+        p, st = opt.update(g, st, p)
+    assert float(loss(p)) < 1e-2
