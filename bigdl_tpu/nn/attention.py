@@ -36,6 +36,7 @@ from bigdl_tpu.core.module import (
 
 __all__ = [
     "dot_product_attention",
+    "make_segment_mask",
     "LayerNorm",
     "MultiHeadAttention",
     "PositionalEncoding",
@@ -86,6 +87,27 @@ def dot_product_attention(
         # zero out fully-masked rows rather than leaving uniform noise
         weights = jnp.where(valid, weights, 0.0)
     return jnp.einsum("...qk,...kd->...qd", weights.astype(q.dtype), v)
+
+
+def make_segment_mask(segments_q, segments_k=None):
+    """Block-diagonal attention mask for packed sequences: several short
+    documents concatenated into one training row attend only within
+    their own segment (the XLA/TPU-friendly alternative to ragged
+    batching — static shapes, no padding waste). ``segments``: (b, s)
+    int ids, equal id = same document; id 0 marks padding and attends to
+    nothing. Returns a (b, 1, s_q, s_k) bool mask (True = attend) that
+    threads through ``MultiHeadAttention``/``TransformerEncoder`` as the
+    mask input; combine with ``causal=True`` for packed causal LM
+    training. Positions restart per document only if the model's
+    position encoding is relative (RoPE applies per absolute offset —
+    exact packing equivalence holds for unpositioned encoders and
+    approximately for long-context relative schemes).
+    """
+    if segments_k is None:
+        segments_k = segments_q
+    same = segments_q[:, :, None] == segments_k[:, None, :]
+    live = (segments_q != 0)[:, :, None] & (segments_k != 0)[:, None, :]
+    return (same & live)[:, None, :, :]
 
 
 class LayerNorm(SimpleModule):
