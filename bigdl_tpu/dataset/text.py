@@ -16,8 +16,8 @@ import numpy as np
 
 from bigdl_tpu.dataset.transformer import Transformer
 
-__all__ = ["tokenize", "Dictionary", "pad_sequences", "LabeledSentence",
-           "sentences_to_ids", "LabeledSentenceToSample"]
+__all__ = ["tokenize", "Dictionary", "pad_sequences", "pack_sequences",
+           "LabeledSentence", "sentences_to_ids", "LabeledSentenceToSample"]
 
 PAD, UNK = "<pad>", "<unk>"
 _WORD_RE = re.compile(r"[A-Za-z']+|[.,!?;]")
@@ -74,6 +74,40 @@ def pad_sequences(seqs: Sequence[Sequence[int]], max_len: int,
         s = list(s)[:max_len] if truncate_from_end else list(s)[-max_len:]
         out[i, :len(s)] = s
     return out
+
+
+def pack_sequences(seqs: Sequence[Sequence[int]], max_len: int,
+                   pad_id: int = 0):
+    """Greedy first-fit packing of variable-length token sequences into
+    fixed (N, max_len) rows plus a parallel segment-id array for
+    ``nn.make_segment_mask`` — the static-shape packed-LM recipe (one
+    row holds several documents; attention stays within each). Documents
+    longer than max_len are truncated. Returns (tokens, segments), both
+    int32; segment ids start at 1 per row, 0 marks padding."""
+    rows: list[list[int]] = []     # flattened token ids per row
+    segs: list[list[int]] = []
+    free: list[int] = []           # remaining capacity per row
+    for s in seqs:
+        s = list(s)[:max_len]
+        if not s:
+            continue
+        for i, cap in enumerate(free):
+            if len(s) <= cap:
+                seg_id = segs[i][-1] + 1
+                rows[i].extend(s)
+                segs[i].extend([seg_id] * len(s))
+                free[i] = cap - len(s)
+                break
+        else:
+            rows.append(list(s))
+            segs.append([1] * len(s))
+            free.append(max_len - len(s))
+    tokens = np.full((len(rows), max_len), pad_id, np.int32)
+    segments = np.zeros((len(rows), max_len), np.int32)
+    for i, (r, g) in enumerate(zip(rows, segs)):
+        tokens[i, :len(r)] = r
+        segments[i, :len(g)] = g
+    return tokens, segments
 
 
 def sentences_to_ids(sentences: Sequence[LabeledSentence],

@@ -234,3 +234,30 @@ def test_cutmix_stage():
     # lam matches the actually-kept fraction (up to ties where both match)
     frac_other = from_other[~from_self].size / from_self[0].size / 6
     assert abs((1.0 - lam) - frac_other) < 0.05 or np.all(from_self)
+
+
+def test_pack_sequences_first_fit_and_mask_contract():
+    """Greedy packing fills rows to max_len, assigns per-row segment ids
+    from 1, zero-pads the tail, and its output feeds make_segment_mask
+    (packing equivalence itself is pinned in test_attention)."""
+    from bigdl_tpu.dataset.text import pack_sequences
+
+    docs = [[1, 2, 3, 4, 5], [6, 7], [8, 9, 10], [11]]
+    tokens, segments = pack_sequences(docs, max_len=8)
+    # first-fit: row0 = doc0(5) + doc1(2) + doc3(1); row1 = doc2(3)
+    assert tokens.shape == segments.shape == (2, 8)
+    np.testing.assert_array_equal(tokens[0], [1, 2, 3, 4, 5, 6, 7, 11])
+    np.testing.assert_array_equal(segments[0], [1, 1, 1, 1, 1, 2, 2, 3])
+    np.testing.assert_array_equal(tokens[1], [8, 9, 10, 0, 0, 0, 0, 0])
+    np.testing.assert_array_equal(segments[1], [1, 1, 1, 0, 0, 0, 0, 0])
+    # over-long doc truncates; empty doc dropped
+    t2, s2 = pack_sequences([list(range(1, 20)), []], max_len=4)
+    assert t2.shape == (1, 4) and (s2 == 1).all()
+
+    import jax.numpy as jnp
+
+    from bigdl_tpu import nn
+    m = nn.make_segment_mask(jnp.asarray(segments))
+    assert m.shape == (2, 1, 8, 8)
+    assert not m[0, 0, 0, 5]  # doc0 cannot see doc1
+    assert not m[1, 0, 0, 3]  # real token cannot see padding
