@@ -14,4 +14,6 @@ from bigdl_tpu.models.autoencoder import autoencoder
 from bigdl_tpu.models.rnn import (
     simple_rnn, lstm_classifier, birnn_classifier, text_cnn,
 )
-from bigdl_tpu.models.transformer_lm import TransformerLM, transformer_lm
+from bigdl_tpu.models.transformer_lm import (
+    TransformerLM, transformer_lm, packed_lm_targets, PackedNLLCriterion,
+)

@@ -23,7 +23,36 @@ import jax.numpy as jnp
 from bigdl_tpu import nn
 from bigdl_tpu.core.module import Module
 
-__all__ = ["TransformerLM", "transformer_lm"]
+__all__ = ["TransformerLM", "transformer_lm", "packed_lm_targets",
+           "PackedNLLCriterion"]
+
+
+def packed_lm_targets(tokens, segments):
+    """Next-token targets for a packed row (see
+    ``bigdl_tpu.dataset.text.pack_sequences``): target[i] = tokens[i+1],
+    with weight 0 wherever the next token belongs to a different document
+    (or padding) — the boundary positions a packed causal LM must not be
+    trained on. Returns (targets, weights), shapes (b, s) / (b, s) f32."""
+    targets = jnp.concatenate(
+        [tokens[:, 1:], jnp.zeros_like(tokens[:, :1])], axis=1)
+    nxt = jnp.concatenate(
+        [segments[:, 1:], jnp.zeros_like(segments[:, :1])], axis=1)
+    weights = ((segments == nxt) & (segments != 0)).astype(jnp.float32)
+    return targets, weights
+
+
+class PackedNLLCriterion:
+    """Weighted next-token NLL over (b, s, vocab) log-probs; target is the
+    (targets, weights) pair from :func:`packed_lm_targets`. Mean over the
+    live positions, so the loss scale matches the unpacked
+    TimeDistributed(ClassNLL) path."""
+
+    def __call__(self, logp, target):
+        targets, weights = target
+        nll = -jnp.take_along_axis(logp, targets[..., None],
+                                   axis=-1)[..., 0]
+        w = weights.astype(nll.dtype)
+        return jnp.sum(nll * w) / jnp.maximum(jnp.sum(w), 1.0)
 
 
 class TransformerLM(Module):
@@ -84,7 +113,13 @@ class TransformerLM(Module):
         return p
 
     def apply(self, params, state, x, *, training=False, rng=None):
-        # x: (batch, seq) int token ids -> (batch, seq, vocab) log-probs
+        # x: (batch, seq) int token ids -> (batch, seq, vocab) log-probs;
+        # or (tokens, segments) for packed rows (pack_sequences) — the
+        # block-diagonal segment mask then confines attention per document
+        mask = None
+        if isinstance(x, (tuple, list)):
+            x, segments = x
+            mask = nn.make_segment_mask(segments)
         h = self.emb.forward(params["emb"], x)
         if self.compute_dtype is not None:
             h = h.astype(self.compute_dtype)
@@ -95,8 +130,11 @@ class TransformerLM(Module):
             raise ValueError(f"sequence length {x.shape[-1]} exceeds "
                              f"max_len {self.max_len}")
         h, _ = self.encoder.apply(params["encoder"],
-                                  self.encoder.init_state(), h,
+                                  self.encoder.init_state(),
+                                  h if mask is None else (h, mask),
                                   training=training, rng=rng)
+        if isinstance(h, (tuple, list)):  # encoder returns (y, mask)
+            h = h[0]
         h = self.ln_f.forward(params["ln_f"], h)
         if self.head is not None:
             logits = self.head.forward(params["head"], h)
