@@ -115,11 +115,13 @@ class TransformerLM(Module):
     def apply(self, params, state, x, *, training=False, rng=None):
         # x: (batch, seq) int token ids -> (batch, seq, vocab) log-probs;
         # or (tokens, segments) for packed rows (pack_sequences) — the
-        # block-diagonal segment mask then confines attention per document
+        # integer segment ids thread to every attention layer, which
+        # confines attention per document (in-kernel for the flash impl,
+        # via make_segment_mask elsewhere)
         mask = None
         if isinstance(x, (tuple, list)):
             x, segments = x
-            mask = nn.make_segment_mask(segments)
+            mask = segments
         h = self.emb.forward(params["emb"], x)
         if self.compute_dtype is not None:
             h = h.astype(self.compute_dtype)

@@ -210,6 +210,12 @@ class MultiHeadAttention(SimpleModule):
             from bigdl_tpu.ops import blockwise_attention
             attn_impl = blockwise_attention
         self.attn_fn: AttnFn = attn_impl or dot_product_attention
+        import inspect
+        try:
+            self._attn_takes_segments = "segments" in inspect.signature(
+                self.attn_fn).parameters
+        except (TypeError, ValueError):
+            self._attn_takes_segments = False
 
     def init(self, rng):
         ks = jax.random.split(rng, 4)
@@ -253,14 +259,23 @@ class MultiHeadAttention(SimpleModule):
 
     def _forward(self, params, x, *, training, rng):
         # input forms: tensor (self-attention); (q_in, kv_in) (cross);
-        # (q_in, kv_in, mask) where mask is (b, s_k) key-padding bool or a
-        # broadcastable (b|1, h|1, s_q, s_k) attention mask
+        # (q_in, kv_in, mask) where mask is (b, s_k) key-padding bool, a
+        # broadcastable (b|1, h|1, s_q, s_k) attention mask, or — when
+        # integer-dtyped — (b, s) packed-document segment ids (the flash
+        # kernel applies those in-kernel; other impls get the expanded
+        # block-diagonal mask)
         mask = None
+        segments = None
         if isinstance(x, (tuple, list)):
             q_in, kv_in = x[0], x[1]
             mask = x[2] if len(x) > 2 else None
         else:
             q_in = kv_in = x
+        if mask is not None and jnp.issubdtype(mask.dtype, jnp.integer):
+            segments, mask = mask, None
+            if not self._attn_takes_segments:
+                mask = make_segment_mask(segments)
+                segments = None
         dt = q_in.dtype
         q = q_in @ params["wq"].astype(dt) + params["bq"].astype(dt)
         k = kv_in @ params["wk"].astype(dt) + params["bk"].astype(dt)
@@ -276,7 +291,11 @@ class MultiHeadAttention(SimpleModule):
         k, v = self._expand_kv(k), self._expand_kv(v)
         if mask is not None and mask.ndim == 2:  # (b, s_k) key-padding
             mask = mask[:, None, None, :]
-        o = self.attn_fn(q, k, v, causal=self.causal, mask=mask)
+        if segments is not None:
+            o = self.attn_fn(q, k, v, causal=self.causal,
+                             segments=segments)
+        else:
+            o = self.attn_fn(q, k, v, causal=self.causal, mask=mask)
         o = self._merge_heads(o)
         return o @ params["wo"].astype(dt) + params["bo"].astype(dt)
 

@@ -155,14 +155,42 @@ def blockwise_attention(q, k, v, *, causal: bool = False,
     return (acc / jnp.maximum(l, 1e-30)).astype(q.dtype)
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
-                *, block_k: int, scale: float, causal: bool, block_q: int,
-                q_offset: int):
+def _block_valid(causal, q_ids, k_ids, bq, j, kk, block_q, block_k,
+                 q_offset):
+    """(bq, bk) bool validity tile combining the causal triangle and the
+    segment equality mask; None when nothing is masked. Padded rows
+    (segment 0) still attend segment-0 keys so no row is fully masked —
+    the dense make_segment_mask kills them instead; those outputs are
+    loss-masked garbage either way, but a live softmax row keeps the
+    backward finite."""
+    valid = None
+    if causal:
+        q_pos = (q_offset + j * block_q
+                 + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0))
+        k_pos = kk * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (bq, block_k), 1)
+        valid = q_pos >= k_pos
+    if q_ids is not None:
+        seg = q_ids == k_ids  # (bq, 1) == (1, bk) -> (bq, bk)
+        valid = seg if valid is None else (valid & seg)
+    return valid
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, *rest, block_k: int, scale: float,
+                causal: bool, block_q: int, q_offset: int, has_seg: bool):
     """3-D grid (bh, q_blocks, k_blocks): K/V stream block-by-block from
     HBM (Pallas double-buffers across the innermost grid dim), online
     softmax state lives in VMEM scratch — O(block) VMEM regardless of
-    sequence length, so 128k-token sequences fit."""
+    sequence length, so 128k-token sequences fit. With ``has_seg`` two
+    extra refs carry packed-document segment ids (q ids lane-replicated,
+    kv ids sublane-replicated — the official TPU kernel's layout)."""
     from jax.experimental import pallas as pl
+
+    if has_seg:
+        qs_ref, ks_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr = rest
+    else:
+        o_ref, lse_ref, m_scr, l_scr, acc_scr = rest
+        qs_ref = ks_ref = None
 
     j = pl.program_id(1)
     kk = pl.program_id(2)
@@ -190,18 +218,18 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         s = jax.lax.dot_general(
             q, kblk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale  # (BQ, BK)
-        if causal:
-            q_pos = (q_offset + j * block_q
-                     + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k),
-                                                0))
-            k_pos = kk * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (bq, block_k), 1)
-            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        valid = _block_valid(
+            causal,
+            None if qs_ref is None else qs_ref[0][:, :1],
+            None if ks_ref is None else ks_ref[0][:1, :],
+            bq, j, kk, block_q, block_k, q_offset)
+        if valid is not None:
+            s = jnp.where(valid, s, _NEG_INF)
         blk_max = jnp.max(s, axis=-1, keepdims=True)
         new_m = jnp.maximum(m, blk_max)
         p = jnp.exp(s - new_m)
-        if causal:
-            p = jnp.where(q_pos >= k_pos, p, 0.0)
+        if valid is not None:
+            p = jnp.where(valid, p, 0.0)
         corr = jnp.exp(m - new_m)
         m_scr[...] = new_m
         l_scr[...] = l * corr + jnp.sum(p, axis=-1, keepdims=True)
@@ -226,10 +254,16 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
             m_scr[...] + jnp.log(l_safe), lse_ref.shape[1:])
 
 
-def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-               dq_scr, *, block_k: int, scale: float, causal: bool,
-               block_q: int, q_offset: int):
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
+               block_k: int, scale: float, causal: bool,
+               block_q: int, q_offset: int, has_seg: bool):
     from jax.experimental import pallas as pl
+
+    if has_seg:
+        qs_ref, ks_ref, dq_ref, dq_scr = rest
+    else:
+        dq_ref, dq_scr = rest
+        qs_ref = ks_ref = None
 
     j = pl.program_id(1)
     kk = pl.program_id(2)
@@ -255,13 +289,13 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
             q, kblk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
         p = jnp.exp(s - lse)  # rows already normalized via lse
-        if causal:
-            q_pos = (q_offset + j * block_q
-                     + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k),
-                                                0))
-            k_pos = kk * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (bq, block_k), 1)
-            p = jnp.where(q_pos >= k_pos, p, 0.0)
+        valid = _block_valid(
+            causal,
+            None if qs_ref is None else qs_ref[0][:, :1],
+            None if ks_ref is None else ks_ref[0][:1, :],
+            bq, j, kk, block_q, block_k, q_offset)
+        if valid is not None:
+            p = jnp.where(valid, p, 0.0)
         dp = jax.lax.dot_general(   # dO @ V^T  (BQ, BK)
             do, vblk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -275,10 +309,16 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         dq_ref[0] = dq_scr[...].astype(dq_ref.dtype)
 
 
-def _dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref, dk_ref,
-                dv_ref, dk_scr, dv_scr, *, block_q: int, scale: float,
-                causal: bool, block_k: int, q_offset: int):
+def _dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref, *rest,
+                block_q: int, scale: float, causal: bool, block_k: int,
+                q_offset: int, has_seg: bool):
     from jax.experimental import pallas as pl
+
+    if has_seg:
+        qs_ref, ks_ref, dk_ref, dv_ref, dk_scr, dv_scr = rest
+    else:
+        dk_ref, dv_ref, dk_scr, dv_scr = rest
+        qs_ref = ks_ref = None
 
     j = pl.program_id(1)   # k-block index
     qq = pl.program_id(2)  # q-block index (innermost: Q/dO stream)
@@ -306,14 +346,16 @@ def _dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref, dk_ref,
             qblk, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
         p = jnp.exp(s - lse)
-        if causal:
-            q_pos = (q_offset + qq * block_q
-                     + jax.lax.broadcasted_iota(jnp.int32, (block_q, bk),
-                                                0))
-            k_pos = (j * block_k
-                     + jax.lax.broadcasted_iota(jnp.int32, (block_q, bk),
-                                                1))
-            p = jnp.where(q_pos >= k_pos, p, 0.0)
+        # note the grid transpose: this program's q-block index is qq and
+        # its k-block index is j, so the roles swap vs _block_valid's
+        # forward-grid signature
+        valid = _block_valid(
+            causal,
+            None if qs_ref is None else qs_ref[0][:, :1],
+            None if ks_ref is None else ks_ref[0][:1, :],
+            block_q, qq, j, block_q, block_k, q_offset)
+        if valid is not None:
+            p = jnp.where(valid, p, 0.0)
         dv_scr[...] += jax.lax.dot_general(  # P^T @ dO  (BK, d)
             p.astype(doblk.dtype), doblk, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -361,7 +403,22 @@ def _tileable(s_q, s_k, block_k) -> bool:
     return s_k % bk == 0
 
 
-def _flash_fwd(q, k, v, causal: bool, block_q: int, block_k: int):
+def _seg_arrays(segments, sq, sk, bq):
+    """Segment ids in the kernels' tileable layouts: q ids (b, sq, 8)
+    lane-replicated (padded rows get id 0), kv ids (b, 8, sk)
+    sublane-replicated — mirroring the lse layout trick."""
+    seg = segments.astype(jnp.int32)
+    qs = seg
+    if qs.shape[1] != sq:  # q padded to a block multiple
+        qs = jnp.pad(qs, ((0, 0), (0, sq - qs.shape[1])))
+    qs3 = jnp.broadcast_to(qs[..., None], qs.shape + (_LSE_LANES,))
+    ks3 = jnp.broadcast_to(seg[:, None, :],
+                           (seg.shape[0], _LSE_LANES, sk))
+    return qs3, ks3
+
+
+def _flash_fwd(q, k, v, causal: bool, block_q: int, block_k: int,
+               segments=None):
     """Pallas forward; returns (out, lse) with lse in (b*h, padded_sq).
     The kernel emits lse lane-replicated (see _LSE_LANES); the replica dim
     is squeezed off here so the custom_vjp residual stores 4 B/query, not
@@ -383,15 +440,28 @@ def _flash_fwd(q, k, v, causal: bool, block_q: int, block_k: int):
 
     kernel = functools.partial(_fwd_kernel, block_k=bk, scale=scale,
                                causal=causal, block_q=bq,
-                               q_offset=s_k - s_q)
+                               q_offset=s_k - s_q,
+                               has_seg=segments is not None)
+    in_specs = [
+        pl.BlockSpec((1, bq, d), lambda i, j, kk: (i, j, 0)),
+        pl.BlockSpec((1, bk, d), lambda i, j, kk: (i, kk, 0)),
+        pl.BlockSpec((1, bk, d), lambda i, j, kk: (i, kk, 0)),
+    ]
+    args = [qf, kf, vf]
+    if segments is not None:
+        qs3, ks3 = _seg_arrays(segments, sq, sk, bq)
+        # seg arrays are per-batch; grid dim 0 walks b*h -> divide out h
+        in_specs += [
+            pl.BlockSpec((1, bq, _LSE_LANES),
+                         lambda i, j, kk: (i // h, j, 0)),
+            pl.BlockSpec((1, _LSE_LANES, bk),
+                         lambda i, j, kk: (i // h, 0, kk)),
+        ]
+        args += [qs3, ks3]
     out, lse = pl.pallas_call(
         kernel,
         grid=(b * h, sq // bq, sk // bk),
-        in_specs=[
-            pl.BlockSpec((1, bq, d), lambda i, j, kk: (i, j, 0)),
-            pl.BlockSpec((1, bk, d), lambda i, j, kk: (i, kk, 0)),
-            pl.BlockSpec((1, bk, d), lambda i, j, kk: (i, kk, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, bq, d), lambda i, j, kk: (i, j, 0)),
             pl.BlockSpec((1, bq, _LSE_LANES), lambda i, j, kk: (i, j, 0)),
@@ -405,13 +475,13 @@ def _flash_fwd(q, k, v, causal: bool, block_q: int, block_k: int):
             pltpu_scratch((bq, d)),
         ],
         interpret=_interpret(),
-    )(qf, kf, vf)
+    )(*args)
     o = out[:, :s_q] if pad_q else out
     return o.reshape(b, h, s_q, d), lse[..., 0]
 
 
 def _flash_bwd(q, k, v, o, lse, g, causal: bool, block_q: int,
-               block_k: int):
+               block_k: int, segments=None):
     """Pallas dq + dk/dv kernels over the recomputed probabilities."""
     from jax.experimental import pallas as pl
 
@@ -440,37 +510,62 @@ def _flash_bwd(q, k, v, o, lse, g, causal: bool, block_q: int,
     sq, sk = qf.shape[1], kf.shape[1]
     q_offset = s_k - s_q
     interpret = _interpret()
+    has_seg = segments is not None
+    if has_seg:
+        qs3, ks3 = _seg_arrays(segments, sq, sk, bq)
 
+    dq_specs = [
+        pl.BlockSpec((1, bq, d), lambda i, j, kk: (i, j, 0)),
+        pl.BlockSpec((1, bk, d), lambda i, j, kk: (i, kk, 0)),
+        pl.BlockSpec((1, bk, d), lambda i, j, kk: (i, kk, 0)),
+        pl.BlockSpec((1, bq, d), lambda i, j, kk: (i, j, 0)),
+        pl.BlockSpec((1, bq, _LSE_LANES), lambda i, j, kk: (i, j, 0)),
+        pl.BlockSpec((1, bq, _LSE_LANES), lambda i, j, kk: (i, j, 0)),
+    ]
+    dq_args = [qf, kf, vf, dof, lse, delta]
+    if has_seg:
+        dq_specs += [
+            pl.BlockSpec((1, bq, _LSE_LANES),
+                         lambda i, j, kk: (i // h, j, 0)),
+            pl.BlockSpec((1, _LSE_LANES, bk),
+                         lambda i, j, kk: (i // h, 0, kk)),
+        ]
+        dq_args += [qs3, ks3]
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, block_k=bk, scale=scale,
-                          causal=causal, block_q=bq, q_offset=q_offset),
+                          causal=causal, block_q=bq, q_offset=q_offset,
+                          has_seg=has_seg),
         grid=(b * h, sq // bq, sk // bk),
-        in_specs=[
-            pl.BlockSpec((1, bq, d), lambda i, j, kk: (i, j, 0)),
-            pl.BlockSpec((1, bk, d), lambda i, j, kk: (i, kk, 0)),
-            pl.BlockSpec((1, bk, d), lambda i, j, kk: (i, kk, 0)),
-            pl.BlockSpec((1, bq, d), lambda i, j, kk: (i, j, 0)),
-            pl.BlockSpec((1, bq, _LSE_LANES), lambda i, j, kk: (i, j, 0)),
-            pl.BlockSpec((1, bq, _LSE_LANES), lambda i, j, kk: (i, j, 0)),
-        ],
+        in_specs=dq_specs,
         out_specs=pl.BlockSpec((1, bq, d), lambda i, j, kk: (i, j, 0)),
         out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
         scratch_shapes=[pltpu_scratch((bq, d))],
         interpret=interpret,
-    )(qf, kf, vf, dof, lse, delta)
+    )(*dq_args)
 
+    dkv_specs = [
+        pl.BlockSpec((1, bk, d), lambda i, j, qq: (i, j, 0)),
+        pl.BlockSpec((1, bk, d), lambda i, j, qq: (i, j, 0)),
+        pl.BlockSpec((1, bq, d), lambda i, j, qq: (i, qq, 0)),
+        pl.BlockSpec((1, bq, d), lambda i, j, qq: (i, qq, 0)),
+        pl.BlockSpec((1, bq, _LSE_LANES), lambda i, j, qq: (i, qq, 0)),
+        pl.BlockSpec((1, bq, _LSE_LANES), lambda i, j, qq: (i, qq, 0)),
+    ]
+    dkv_args = [kf, vf, qf, dof, lse, delta]
+    if has_seg:
+        dkv_specs += [
+            pl.BlockSpec((1, bq, _LSE_LANES),
+                         lambda i, j, qq: (i // h, qq, 0)),
+            pl.BlockSpec((1, _LSE_LANES, bk),
+                         lambda i, j, qq: (i // h, 0, j)),
+        ]
+        dkv_args += [qs3, ks3]
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, block_q=bq, scale=scale,
-                          causal=causal, block_k=bk, q_offset=q_offset),
+                          causal=causal, block_k=bk, q_offset=q_offset,
+                          has_seg=has_seg),
         grid=(b * h, sk // bk, sq // bq),
-        in_specs=[
-            pl.BlockSpec((1, bk, d), lambda i, j, qq: (i, j, 0)),
-            pl.BlockSpec((1, bk, d), lambda i, j, qq: (i, j, 0)),
-            pl.BlockSpec((1, bq, d), lambda i, j, qq: (i, qq, 0)),
-            pl.BlockSpec((1, bq, d), lambda i, j, qq: (i, qq, 0)),
-            pl.BlockSpec((1, bq, _LSE_LANES), lambda i, j, qq: (i, qq, 0)),
-            pl.BlockSpec((1, bq, _LSE_LANES), lambda i, j, qq: (i, qq, 0)),
-        ],
+        in_specs=dkv_specs,
         out_specs=[
             pl.BlockSpec((1, bk, d), lambda i, j, qq: (i, j, 0)),
             pl.BlockSpec((1, bk, d), lambda i, j, qq: (i, j, 0)),
@@ -481,7 +576,7 @@ def _flash_bwd(q, k, v, o, lse, g, causal: bool, block_q: int,
         ],
         scratch_shapes=[pltpu_scratch((bk, d)), pltpu_scratch((bk, d))],
         interpret=interpret,
-    )(kf, vf, qf, dof, lse, delta)
+    )(*dkv_args)
 
     dq = (dq[:, :s_q] if pad_q else dq).reshape(b, h, s_q, d)
     return dq, dk.reshape(b, h, s_k, d), dv.reshape(b, h, s_k, d)
@@ -518,15 +613,51 @@ def _flash_vjp_bwd(causal, block_q, block_k, res, g):
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def _flash_seg(q, k, v, segments, causal, block_q, block_k):
+    return _flash_fwd(q, k, v, causal, block_q, block_k,
+                      segments=segments)[0]
+
+
+def _flash_seg_vjp_fwd(q, k, v, segments, causal, block_q, block_k):
+    out, lse = _flash_fwd(q, k, v, causal, block_q, block_k,
+                          segments=segments)
+    return out, (q, k, v, segments, out, lse)
+
+
+def _flash_seg_vjp_bwd(causal, block_q, block_k, res, g):
+    q, k, v, segments, o, lse = res
+    dq, dk, dv = _flash_bwd(q, k, v, o, lse, g, causal, block_q, block_k,
+                            segments=segments)
+    return dq, dk, dv, None  # integer segment ids carry no cotangent
+
+
+_flash_seg.defvjp(_flash_seg_vjp_fwd, _flash_seg_vjp_bwd)
+
+
 def flash_attention(q, k, v, *, causal: bool = False,
                     mask: Optional[jax.Array] = None,
+                    segments: Optional[jax.Array] = None,
                     block_q: int = 128, block_k: int = 128):
     """(b, h, s, d) attention via the Pallas online-softmax kernel.
 
-    Key-padding masks route to :func:`blockwise_attention` (same O(seq)
-    memory, XLA-fused); richer masks fall back to the dense path; ragged
-    key lengths fall back inside the custom_vjp.
+    ``segments``: (b, s) int document ids for packed rows (see
+    dataset.text.pack_sequences) — the block-diagonal mask is applied
+    *inside* the kernel, keeping packed long-context training O(seq)
+    (self-attention shapes only; id 0 = padding). Key-padding masks
+    route to :func:`blockwise_attention` (same O(seq) memory,
+    XLA-fused); richer masks fall back to the dense path; ragged key
+    lengths fall back inside the custom_vjp.
     """
+    if segments is not None:
+        if mask is not None:
+            raise ValueError("segments and mask are mutually exclusive")
+        s_q, s_k = q.shape[-2], k.shape[-2]
+        if s_q != s_k or not _tileable(s_q, s_k, block_k):
+            return _dense.dot_product_attention(
+                q, k, v, causal=causal,
+                mask=_dense.make_segment_mask(segments))
+        return _flash_seg(q, k, v, segments, causal, block_q, block_k)
     if mask is not None:
         if _as_key_padding(mask, q.shape[0], k.shape[-2]) is not None:
             return blockwise_attention(q, k, v, causal=causal, mask=mask,

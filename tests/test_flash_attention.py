@@ -132,6 +132,24 @@ def test_flash_compiled_on_tpu():
         np.testing.assert_allclose(np.asarray(a, np.float32),
                                    np.asarray(b, np.float32), atol=1e-1)
 
+    # packed-segment variant must also lower and agree with the dense
+    # block-diagonal mask (fwd + one grad)
+    from bigdl_tpu.nn.attention import make_segment_mask
+
+    segs = jnp.asarray(np.repeat([[1, 2, 3, 4]], 128, axis=1)
+                       .reshape(1, 512).repeat(2, axis=0))
+    out_s = jax.jit(lambda q, k, v: flash_attention(
+        q, k, v, causal=True, segments=segs))(q, k, v)
+    ref_s = dot_product_attention(q, k, v, causal=True,
+                                  mask=make_segment_mask(segs))
+    np.testing.assert_allclose(np.asarray(out_s, np.float32),
+                               np.asarray(ref_s, np.float32), atol=5e-2)
+    gs = jax.jit(jax.grad(lambda q, k, v: flash_attention(
+        q, k, v, causal=True, segments=segs).astype(jnp.float32).sum(),
+        argnums=(0, 1, 2)))(q, k, v)
+    for a in gs:
+        assert np.isfinite(np.asarray(a, np.float32)).all()
+
 
 @pytest.mark.parametrize("causal", [False, True])
 def test_blockwise_matches_dense(causal):
@@ -222,3 +240,73 @@ def test_flash_routes_key_padding_to_blockwise():
     ref = dot_product_attention(q, k, v, mask=keep[:, None, None, :])
     out = flash_attention(q, k, v, mask=keep[:, None, None, :])
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_segments_matches_dense(causal):
+    """In-kernel segment masking == dense path with make_segment_mask,
+    forward and gradients, on live (non-padding) positions."""
+    from bigdl_tpu.nn.attention import (dot_product_attention,
+                                        make_segment_mask)
+
+    rs = np.random.RandomState(0)
+    b, h, s, d = 2, 3, 128, 32
+    q = jnp.asarray(rs.randn(b, h, s, d), jnp.float32)
+    k = jnp.asarray(rs.randn(b, h, s, d), jnp.float32)
+    v = jnp.asarray(rs.randn(b, h, s, d), jnp.float32)
+    segs = np.zeros((b, s), np.int32)
+    segs[0, :50] = 1
+    segs[0, 50:120] = 2          # row 0: two docs + 8 pad
+    segs[1, :] = 1               # row 1: one full doc
+    segs = jnp.asarray(segs)
+    live = np.asarray(segs) != 0
+
+    out = flash_attention(q, k, v, causal=causal, segments=segs,
+                          block_q=32, block_k=32)
+    want = dot_product_attention(q, k, v, causal=causal,
+                                 mask=make_segment_mask(segs))
+    np.testing.assert_allclose(np.asarray(out)[:, :, live[0], :][0],
+                               np.asarray(want)[:, :, live[0], :][0],
+                               atol=2e-5)
+    np.testing.assert_allclose(np.asarray(out)[1], np.asarray(want)[1],
+                               atol=2e-5)
+
+    # gradients: weight the loss by liveness so padding rows (whose
+    # conventions differ between the two paths) don't contribute
+    w = jnp.asarray(live, jnp.float32)[:, None, :, None]
+
+    def loss_flash(q, k, v):
+        o = flash_attention(q, k, v, causal=causal, segments=segs,
+                            block_q=32, block_k=32)
+        return jnp.sum(jnp.square(o * w))
+
+    def loss_dense(q, k, v):
+        o = dot_product_attention(q, k, v, causal=causal,
+                                  mask=make_segment_mask(segs))
+        return jnp.sum(jnp.square(o * w))
+
+    g1 = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, c, n in zip(g1, g2, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(c),
+                                   atol=3e-5, err_msg=f"d{n}")
+
+
+def test_flash_segments_through_mha_and_lm():
+    """Integer mask input routes segments into the flash kernel via MHA,
+    and the packed TransformerLM path stays isolated across documents."""
+    from bigdl_tpu import nn as bnn
+
+    mha = bnn.MultiHeadAttention(16, 2, causal=True, attn_impl="flash")
+    params = mha.init(jax.random.PRNGKey(0))
+    rs = np.random.RandomState(1)
+    x = jnp.asarray(rs.randn(1, 64, 16), jnp.float32)
+    segs = jnp.asarray(np.repeat([[1, 2]], 32, axis=1).reshape(1, 64))
+    o = mha.forward(params, (x, x, segs))
+    # perturb the second document; first document's outputs must not move
+    x2 = x.at[:, 32:].add(1.0)
+    segs_sorted = jnp.asarray([([1] * 32) + ([2] * 32)])
+    o1 = mha.forward(params, (x, x, segs_sorted))
+    o2 = mha.forward(params, (x2, x2, segs_sorted))
+    np.testing.assert_allclose(np.asarray(o1[:, :32]),
+                               np.asarray(o2[:, :32]), atol=1e-5)
