@@ -84,10 +84,20 @@ def make_hybrid_mesh(dcn_axes: dict[str, int], ici_axes: dict[str, int],
     """
     devices = list(devices if devices is not None else jax.devices())
 
-    groups: dict[int, list] = {}
-    for d in devices:
-        groups.setdefault(
-            getattr(d, "slice_index", d.process_index), []).append(d)
+    def _group(key):
+        g: dict[int, list] = {}
+        for d in devices:
+            g.setdefault(key(d), []).append(d)
+        return g
+
+    groups = _group(lambda d: getattr(d, "slice_index", 0))
+    if len(groups) == 1:
+        # non-TPU backends report one slice (CPU devices carry
+        # slice_index 0 regardless of process) — host boundaries are the
+        # DCN boundaries there
+        by_proc = _group(lambda d: d.process_index)
+        if len(by_proc) > 1:
+            groups = by_proc
     if len(groups) == 1 and num_slices and num_slices > 1:
         if len(devices) % num_slices:
             raise ValueError(f"{len(devices)} devices do not split into "

@@ -91,10 +91,59 @@ def main() -> None:
         np.allclose(np.asarray(a), np.asarray(b), atol=1e-5)
         for a, b in zip(f_leaves, leaves))
 
+    # hybrid ICI/DCN mesh with REAL process-index slice grouping: data
+    # parallelism across the two host "slices", tensor+sequence axes
+    # within each — one TP train step must compile and execute with the
+    # cross-host collectives on gloo
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from bigdl_tpu.optim import SGD as _SGD
+    from bigdl_tpu.parallel import (TensorParallel, make_hybrid_mesh,
+                                    make_ring_attention)
+
+    hmesh = make_hybrid_mesh({"data": nproc},
+                             {"seq": 2, "model": 2})
+    slice_procs = {d.process_index for d in hmesh.devices[0].ravel()}
+    hybrid_grouping_ok = len(slice_procs) == 1
+    attn = make_ring_attention(hmesh, "seq", batch_axis="data")
+    enc = nn.TransformerEncoder(num_layers=1, d_model=16, num_heads=4,
+                                d_ff=32, causal=True, attn_impl=attn)
+    hstrat = TensorParallel(hmesh, enc)
+    hp = enc.init(jax.random.PRNGKey(0))
+    hopt = _SGD(learning_rate=0.1)
+    hp, hms, hos = hstrat.place(hp, enc.init_state(), hopt.init(hp))
+
+    def tp_step(p, ms, os_, xb, yb, r):
+        def loss_fn(pp):
+            out, ms2 = enc.apply(pp, ms, xb, training=True, rng=r)
+            return jnp.mean(jnp.square(out - yb)), ms2
+
+        (loss, ms2), g = jax.value_and_grad(loss_fn, has_aux=True)(p)
+        np_, no_ = hopt.update(g, os_, p)
+        return np_, ms2, no_, loss
+
+    spec = P("data", "seq", None)
+    hstep = hstrat.compile_step(tp_step, batch_spec=spec)
+    hx = np.random.RandomState(3).randn(4, 8, 16).astype(np.float32)
+    hy = np.random.RandomState(4).randn(4, 8, 16).astype(np.float32)
+    from jax.experimental import multihost_utils as mhu
+
+    hx = mhu.host_local_array_to_global_array(
+        hx[host_shard(4)], hmesh, spec)
+    hy = mhu.host_local_array_to_global_array(
+        hy[host_shard(4)], hmesh, spec)
+    hout = hstep(hp, hms, hos, hx, hy, jax.random.PRNGKey(5))
+    # the loss is replicated; read this host's copy (device_get/allgather
+    # reject globally non-addressable arrays)
+    hloss = float(np.asarray(hout[-1].addressable_data(0)))
+    hybrid_ok = bool(np.isfinite(hloss)) and hybrid_grouping_ok
+
     with open(out_path, "w") as f:
         json.dump({"pid": pid, "digest": digest,
                    "restore_ok": bool(restore_ok),
                    "fsdp_matches_dp": bool(fsdp_matches_dp),
+                   "hybrid_ok": hybrid_ok,
                    "devices": jax.device_count()}, f)
 
 

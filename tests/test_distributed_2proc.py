@@ -57,3 +57,6 @@ def test_two_process_dp_training(tmp_path):
     assert abs(results[0]["digest"] - results[1]["digest"]) < 1e-5, results
     # FSDP over the cross-host mesh must reproduce the DP result
     assert all(r["fsdp_matches_dp"] for r in results), results
+    # hybrid ICI/DCN mesh: process_index slice grouping + a cross-host
+    # TP/ring-attention step executed with finite loss
+    assert all(r["hybrid_ok"] for r in results), results
