@@ -108,51 +108,19 @@ def _attempt(backend: str, model: str, batch: int, iters: int,
                   + " | ".join(tail))
 
 
-def main() -> None:
-    model = sys.argv[1] if len(sys.argv) > 1 else "resnet50"
-    batch = int(sys.argv[2]) if len(sys.argv) > 2 else 128
-    iters = int(sys.argv[3]) if len(sys.argv) > 3 else 20
+_line = None      # best JSON line so far (emitted by the SIGTERM guard)
+_printed = False
 
-    errors = []
-    result = None
-    companions = {}
-    probe, perr = _attempt("probe", model, batch, iters, PROBE_TIMEOUT)
-    if probe is None:
-        errors.append(f"backend probe failed ({perr}); skipping to cpu")
-    elif probe.get("probe") != "tpu":
-        # default backend resolved to something slow (cpu) — don't burn
-        # TPU_TIMEOUT running the full-size config on it
-        errors.append(f"default backend is {probe.get('probe')}, not tpu")
-    else:
-        result, err = _attempt("default", model, batch, iters, TPU_TIMEOUT)
-        if err:
-            errors.append(err)
-        if result is not None and os.environ.get(
-                "BENCH_COMPANIONS", "1") != "0":
-            # companion configs ride inside the same JSON line (the
-            # driver records one line; these are the VERDICT-requested
-            # transformer_lm and train-from-storage datapoints)
-            for cname, cmodel, cb, ci in (
-                    ("transformer_lm", "transformer_lm", 32, 10),
-                    ("resnet50_pipe", "resnet50_pipe", batch, iters)):
-                cres, cerr = _attempt("default", cmodel, cb, ci,
-                                      int(os.environ.get(
-                                          "BENCH_COMPANION_TIMEOUT",
-                                          "600")))
-                if cres is not None:
-                    companions[cname] = {
-                        k: cres.get(k) for k in (
-                            "images_per_second_per_chip", "mfu_pct",
-                            "tokens_per_second", "batch", "seconds")
-                        if cres.get(k) is not None}
-                else:
-                    companions[cname] = {"error": cerr}
-    if result is None:
-        # CPU fallback: tiny shapes so the line lands fast; marked as cpu
-        result, err = _attempt("cpu", model, min(batch, 4), 2, CPU_TIMEOUT)
-        if err:
-            errors.append(err)
 
+def _emit():
+    """Print the one JSON line exactly once."""
+    global _printed
+    if not _printed and _line is not None:
+        _printed = True
+        print(json.dumps(_line), flush=True)
+
+
+def _build_line(model, result, companions, errors):
     line = {
         "metric": f"{model}_train_throughput",
         "value": 0.0,
@@ -185,7 +153,73 @@ def main() -> None:
         line["companions"] = companions
     if errors:
         line["error"] = "; ".join(errors)
-    print(json.dumps(line))
+    return line
+
+
+def main() -> None:
+    global _line
+    model = sys.argv[1] if len(sys.argv) > 1 else "resnet50"
+    batch = int(sys.argv[2]) if len(sys.argv) > 2 else 128
+    iters = int(sys.argv[3]) if len(sys.argv) > 3 else 20
+
+    # if the driver kills us mid-companion-run, the headline result must
+    # not be lost: emit the best line built so far on SIGTERM/SIGINT
+    import signal
+
+    _line = _build_line(model, None, {},
+                        ["killed before the first result landed"])
+
+    def _on_term(signum, frame):
+        _emit()
+        os._exit(0)
+
+    signal.signal(signal.SIGTERM, _on_term)
+    signal.signal(signal.SIGINT, _on_term)
+
+    errors = []
+    result = None
+    companions = {}
+    probe, perr = _attempt("probe", model, batch, iters, PROBE_TIMEOUT)
+    if probe is None:
+        errors.append(f"backend probe failed ({perr}); skipping to cpu")
+    elif probe.get("probe") != "tpu":
+        # default backend resolved to something slow (cpu) — don't burn
+        # TPU_TIMEOUT running the full-size config on it
+        errors.append(f"default backend is {probe.get('probe')}, not tpu")
+    else:
+        result, err = _attempt("default", model, batch, iters, TPU_TIMEOUT)
+        if err:
+            errors.append(err)
+        _line = _build_line(model, result, companions, errors)
+        if result is not None and os.environ.get(
+                "BENCH_COMPANIONS", "1") != "0":
+            # companion configs ride inside the same JSON line (the
+            # driver records one line; these are the VERDICT-requested
+            # transformer_lm and train-from-storage datapoints)
+            for cname, cmodel, cb, ci in (
+                    ("transformer_lm", "transformer_lm", 32, 10),
+                    ("resnet50_pipe", "resnet50_pipe", batch, iters)):
+                cres, cerr = _attempt("default", cmodel, cb, ci,
+                                      int(os.environ.get(
+                                          "BENCH_COMPANION_TIMEOUT",
+                                          "600")))
+                if cres is not None:
+                    companions[cname] = {
+                        k: cres.get(k) for k in (
+                            "images_per_second_per_chip", "mfu_pct",
+                            "tokens_per_second", "batch", "seconds")
+                        if cres.get(k) is not None}
+                else:
+                    companions[cname] = {"error": cerr}
+                _line = _build_line(model, result, companions, errors)
+    if result is None:
+        # CPU fallback: tiny shapes so the line lands fast; marked as cpu
+        result, err = _attempt("cpu", model, min(batch, 4), 2, CPU_TIMEOUT)
+        if err:
+            errors.append(err)
+
+    _line = _build_line(model, result, companions, errors)
+    _emit()
 
 
 if __name__ == "__main__":
