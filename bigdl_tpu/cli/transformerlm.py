@@ -33,6 +33,10 @@ def main(argv=None):
                     help="jax.checkpoint each block (HBM for FLOPs)")
     tr.add_argument("--bf16", action="store_true")
     tr.add_argument("--accumSteps", type=int, default=1)
+    tr.add_argument("--packed", action="store_true",
+                    help="sentence-split the corpus and pack documents "
+                         "into rows (segment-masked attention, boundary-"
+                         "masked loss) instead of fixed windows")
     ge = sub.add_parser("generate",
                         help="sample from a trained checkpoint (KV-cache "
                              "decode)")
@@ -66,6 +70,9 @@ def main(argv=None):
         tokens = tokenize(f.read())
     d = Dictionary([tokens], vocab_size=args.vocabSize)
     ids = np.asarray(d.ids(tokens), np.int32)
+
+    if args.packed:
+        return _train_packed(args, d, tokens)
 
     # non-overlapping next-token windows: x = w[:-1], y = w[1:]
     s = args.seqLength + 1
@@ -103,6 +110,93 @@ def main(argv=None):
     lp = np.asarray(logp)
     nll = -np.mean(np.take_along_axis(lp, y_val[..., None], axis=-1))
     print(f"perplexity is {math.exp(nll):.2f}")
+    return trained
+
+
+def _train_packed(args, d, tokens):
+    """Packed-document training: sentences become documents, documents
+    pack into fixed rows (dataset.text.pack_sequences), attention is
+    segment-masked and the loss skips document boundaries. The Optimizer
+    sees plain arrays: features/labels stack (tokens|segments) and
+    (targets|weights) along axis 1, unstacked by thin adapters."""
+    import numpy as np
+    import jax.numpy as jnp
+
+    from bigdl_tpu.core.module import Module
+    from bigdl_tpu.dataset import BatchDataSet
+    from bigdl_tpu.dataset.text import pack_sequences
+    from bigdl_tpu.models import (PackedNLLCriterion, packed_lm_targets,
+                                  transformer_lm)
+
+    # sentence-split on the period token the tokenizer keeps; if the
+    # corpus has no ".", chunk fixed-size pseudo-documents instead
+    ids = d.ids(tokens)
+    period = d.word2id.get(".")
+    docs, cur = [], []
+    if period is not None:
+        for t in ids:
+            cur.append(t)
+            if t == period:
+                docs.append(cur)
+                cur = []
+        if cur:
+            docs.append(cur)
+    else:
+        step = max(args.seqLength // 4, 8)
+        docs = [ids[i:i + step] for i in range(0, len(ids), step)]
+    toks, segs = pack_sequences(docs, args.seqLength)
+    if len(toks) < 2:
+        raise SystemExit(f"corpus too small to pack: {len(docs)} docs "
+                         f"-> {len(toks)} rows")
+    tgt, w = packed_lm_targets(jnp.asarray(toks), jnp.asarray(segs))
+    feats = np.stack([toks, segs], axis=1)                  # (n, 2, s)
+    labels = np.stack([np.asarray(tgt), np.asarray(w)], axis=1)
+    n_held = max(1, len(feats) // 10)
+    f_tr, f_val = feats[:-n_held], feats[-n_held:]
+    l_tr, l_val = labels[:-n_held], labels[-n_held:]
+    if len(f_tr) < args.batchSize:
+        print(f"warning: only {len(f_tr)} packed rows < batchSize "
+              f"{args.batchSize}; clamping")
+        args.batchSize = len(f_tr)
+
+    lm = transformer_lm(
+        len(d), d_model=args.dModel, num_layers=args.numLayers,
+        num_heads=args.numHeads, max_len=args.seqLength,
+        dropout=args.dropout, attn_impl="flash" if args.flash else None,
+        remat=args.remat,
+        compute_dtype=jnp.bfloat16 if args.bf16 else None)
+
+    class _PackedLM(Module):
+        """Unstacks (n, 2, s) -> ((tokens, segments)) for the LM."""
+
+        def children(self):
+            return (lm,)
+
+        def init(self, rng):
+            return lm.init(rng)
+
+        def init_state(self):
+            return lm.init_state()
+
+        def apply(self, params, state, x, *, training=False, rng=None):
+            return lm.apply(params, state, (x[:, 0], x[:, 1]),
+                            training=training, rng=rng)
+
+    base = PackedNLLCriterion()
+    crit = lambda logp, y: base(logp, (y[:, 0].astype(jnp.int32),
+                                       y[:, 1]))
+    train = BatchDataSet(f_tr, l_tr, args.batchSize, shuffle=True)
+    opt = common.build_optimizer(_PackedLM(), train, crit, args)
+    opt.accum_steps = max(1, args.accumSteps)
+    trained = opt.optimize()
+
+    logp = trained.module.forward(trained.params, jnp.asarray(f_val))
+    lp = np.asarray(logp)
+    tv, wv = l_val[:, 0].astype(np.int64), l_val[:, 1]
+    nll = -(np.take_along_axis(lp, tv[..., None], axis=-1)[..., 0] * wv
+            ).sum() / max(wv.sum(), 1.0)
+    print(f"packed perplexity is {math.exp(nll):.2f} "
+          f"({int(wv.sum())} live targets)")
     return trained
 
 
