@@ -92,6 +92,7 @@ def _as_key_padding(mask, b, s_k):
 
 def blockwise_attention(q, k, v, *, causal: bool = False,
                         mask: Optional[jax.Array] = None,
+                        segments: Optional[jax.Array] = None,
                         block_k: int = 128):
     """O(seq) memory attention in pure JAX: ``lax.scan`` over K/V blocks
     with an online softmax, the scan body wrapped in ``jax.checkpoint`` so
@@ -100,16 +101,24 @@ def blockwise_attention(q, k, v, *, causal: bool = False,
     Differentiable end-to-end; serves as the flash kernel's backward path
     and as a standalone ``attn_impl``. q,k,v: (b, h, s, d).
 
-    Key-padding masks ((b, s_k) or (b|1,1,1,s_k) bool, True=attend) tile
-    along the scan and stay on this path; richer (s_q, s_k) masks fall
-    back to dense.
+    Key-padding masks ((b, s_k) or (b|1,1,1,s_k) bool, True=attend) and
+    packed-document ``segments`` ((b, s) int ids, self-attention shapes)
+    tile along the scan and stay on this path; richer (s_q, s_k) masks
+    fall back to dense.
     """
     s_k = k.shape[-2]
     bk = min(block_k, s_k)
+    if segments is not None and mask is not None:
+        raise ValueError("segments and mask are mutually exclusive")
+    if segments is not None and q.shape[-2] != s_k:
+        raise ValueError("segments requires self-attention shapes "
+                         f"(s_q={q.shape[-2]} != s_k={s_k})")
     kv_mask = _as_key_padding(mask, q.shape[0], s_k)
     if (mask is not None and kv_mask is None) or s_k % bk:
         # arbitrary masks don't tile; ragged tails aren't worth the
         # complexity — correctness over memory for those cases
+        if segments is not None:
+            mask = _dense.make_segment_mask(segments)
         return _dense.dot_product_attention(q, k, v, causal=causal,
                                             mask=mask)
     n_blk = s_k // bk
@@ -130,14 +139,30 @@ def blockwise_attention(q, k, v, *, causal: bool = False,
         mb = jnp.moveaxis(kv_mask.reshape(kv_mask.shape[0], n_blk, bk),
                           1, 0)[:, :, None, None, :]
         scan_in = (kb, vb, mb)
+    elif segments is not None:
+        # per-block k-segment slices scan alongside K/V; the (b, 1, s_q,
+        # bk) equality tile is built inside the (remat'd) body, so only
+        # O(s) ids are resident — same packing semantics as the Pallas
+        # kernel (segment-0 padding attends itself, keeping rows live)
+        sb = jnp.moveaxis(
+            segments.astype(jnp.int32).reshape(
+                segments.shape[0], n_blk, bk), 1, 0)
+        scan_in = (kb, vb, sb)
+
+    seg_q = None if segments is None else segments.astype(jnp.int32)
 
     @jax.checkpoint
     def body(carry, blk):
         m, l, acc, j = carry
+        mj = None
         if kv_mask is not None:
             kj, vj, mj = blk
+        elif seg_q is not None:
+            kj, vj, sj = blk
+            # (b, 1, s_q, 1) == (b, 1, 1, bk) -> (b, 1, s_q, bk)
+            mj = (seg_q[:, None, :, None] == sj[:, None, None, :])
         else:
-            (kj, vj), mj = blk, None
+            kj, vj = blk
         valid = None
         if causal:
             k_pos = j * bk + jnp.arange(bk)

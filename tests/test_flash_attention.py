@@ -310,3 +310,43 @@ def test_flash_segments_through_mha_and_lm():
     o2 = mha.forward(params, (x2, x2, segs_sorted))
     np.testing.assert_allclose(np.asarray(o1[:, :32]),
                                np.asarray(o2[:, :32]), atol=1e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_blockwise_segments_matches_dense(causal):
+    """O(seq) blockwise path with segments == dense block-diagonal mask
+    on live positions (fwd + grads)."""
+    from bigdl_tpu.nn.attention import (dot_product_attention,
+                                        make_segment_mask)
+    from bigdl_tpu.ops import blockwise_attention
+
+    rs = np.random.RandomState(7)
+    b, h, s, d = 2, 2, 64, 16
+    q = jnp.asarray(rs.randn(b, h, s, d), jnp.float32)
+    k = jnp.asarray(rs.randn(b, h, s, d), jnp.float32)
+    v = jnp.asarray(rs.randn(b, h, s, d), jnp.float32)
+    segs = np.zeros((b, s), np.int32)
+    segs[0, :20] = 1
+    segs[0, 20:60] = 2
+    segs[1, :] = 1
+    segs = jnp.asarray(segs)
+    live = np.asarray(segs) != 0
+    w = jnp.asarray(live, jnp.float32)[:, None, :, None]
+
+    out = blockwise_attention(q, k, v, causal=causal, segments=segs,
+                              block_k=16)
+    want = dot_product_attention(q, k, v, causal=causal,
+                                 mask=make_segment_mask(segs))
+    np.testing.assert_allclose(np.asarray(out * w), np.asarray(want * w),
+                               atol=2e-5)
+
+    g1 = jax.grad(lambda q, k, v: jnp.sum(jnp.square(
+        blockwise_attention(q, k, v, causal=causal, segments=segs,
+                            block_k=16) * w)), argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(lambda q, k, v: jnp.sum(jnp.square(
+        dot_product_attention(q, k, v, causal=causal,
+                              mask=make_segment_mask(segs)) * w)),
+        argnums=(0, 1, 2))(q, k, v)
+    for a, c in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(c),
+                                   atol=3e-5)
