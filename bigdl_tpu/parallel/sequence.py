@@ -32,8 +32,8 @@ _NEG_INF = -1e30  # finite mask value: keeps exp() well-defined in blocks
                   # that are entirely masked out (true -inf would NaN)
 
 
-def ring_attention(q, k, v, *, axis_name: str, causal: bool = False,
-                   block_k: int = 512):
+def ring_attention(q, k, v, segments=None, *, axis_name: str,
+                   causal: bool = False, block_k: int = 512):
     """Blockwise ring attention. Must run inside shard_map with the seq
     dimension of q/k/v (shape ...,(b,h,s_local,d)) sharded on ``axis_name``.
 
@@ -43,6 +43,11 @@ def ring_attention(q, k, v, *, axis_name: str, causal: bool = False,
     instead of O(s_local^2) — at 8-way sequence parallel over a 128k
     context the local chunk is 16k and a dense per-hop tile would be
     16k x 16k per head.
+
+    ``segments``: (b, s_local) packed-document ids (same seq sharding as
+    q) — the id chunk rides the ring next to K/V, so packed training and
+    sequence parallelism compose; semantics match the flash kernel
+    (equal id attends, including the 0 padding id with itself).
     """
     n = jax.lax.psum(1, axis_name)
     my = jax.lax.axis_index(axis_name)
@@ -52,49 +57,72 @@ def ring_attention(q, k, v, *, axis_name: str, causal: bool = False,
 
     # global positions of my q rows
     q_pos = my * s_q + jnp.arange(s_q)
+    seg_q = None if segments is None else segments.astype(jnp.int32)
 
     bk = min(block_k, s_k)
     n_sub = s_k // bk if s_k % bk == 0 else 1
     if n_sub == 1:
         bk = s_k
 
-    def fold_chunk(src, kb, vb, m, l, o):
+    def fold_chunk(src, kb, vb, sb, m, l, o):
         """Fold one arriving (s_local, d) K/V chunk, sub-block by
         sub-block, into the streaming softmax state."""
         kbs = kb.reshape(kb.shape[:-2] + (n_sub, bk, kb.shape[-1]))
         vbs = vb.reshape(vb.shape[:-2] + (n_sub, bk, vb.shape[-1]))
         kbs = jnp.moveaxis(kbs, -3, 0)
         vbs = jnp.moveaxis(vbs, -3, 0)
+        scan_in = (kbs, vbs)
+        if sb is not None:
+            sbs = jnp.moveaxis(
+                sb.reshape(sb.shape[0], n_sub, bk), 1, 0)
+            scan_in = (kbs, vbs, sbs)
 
         @jax.checkpoint
         def sub(carry, blk):
             m, l, o, j = carry
-            kj, vj = blk
+            if sb is not None:
+                kj, vj, sj = blk
+            else:
+                (kj, vj), sj = blk, None
             valid = None
             if causal:
                 k_pos = src * s_k + j * bk + jnp.arange(bk)
                 valid = q_pos[:, None] >= k_pos[None, :]
+            if sj is not None:
+                # (b, 1, s_q, bk); broadcasts against (b, h, s_q, bk)
+                sv = (seg_q[:, None, :, None] == sj[:, None, None, :])
+                valid = sv if valid is None else (valid & sv)
             m, l, o = online_softmax_update(q, kj, vj, m, l, o, scale,
                                             valid)
             return (m, l, o, j + 1), None
 
-        (m, l, o, _), _ = jax.lax.scan(sub, (m, l, o, 0), (kbs, vbs))
+        (m, l, o, _), _ = jax.lax.scan(sub, (m, l, o, 0), scan_in)
         return m, l, o
 
     def step(carry, t):
-        kb, vb, m, l, o = carry
+        if seg_q is not None:
+            kb, vb, sb, m, l, o = carry
+        else:
+            (kb, vb, m, l, o), sb = carry, None
         # after t hops of "send to next", I hold the block born on (my - t)
         src = (my - t) % n
-        m, l, o = fold_chunk(src, kb, vb, m, l, o)
+        m, l, o = fold_chunk(src, kb, vb, sb, m, l, o)
         perm = [(i, (i + 1) % n) for i in range(n)]
         kb = jax.lax.ppermute(kb, axis_name, perm)
         vb = jax.lax.ppermute(vb, axis_name, perm)
+        if sb is not None:
+            sb = jax.lax.ppermute(sb, axis_name, perm)
+            return (kb, vb, sb, m, l, o), None
         return (kb, vb, m, l, o), None
 
     m0 = jnp.full(q.shape[:-1] + (1,), _NEG_INF, jnp.float32)
     l0 = jnp.zeros(q.shape[:-1] + (1,), jnp.float32)
     o0 = jnp.zeros(q.shape, jnp.float32)
-    (_, _, _, l, o), _ = _scan_steps(step, (k, v, m0, l0, o0), n)
+    if seg_q is not None:
+        carry0 = (k, v, seg_q, m0, l0, o0)
+        (_, _, _, _, l, o), _ = _scan_steps(step, carry0, n)
+    else:
+        (_, _, _, l, o), _ = _scan_steps(step, (k, v, m0, l0, o0), n)
     return (o / jnp.maximum(l, 1e-30)).astype(q.dtype)
 
 
@@ -110,12 +138,17 @@ def make_ring_attention(mesh: Mesh, seq_axis: str = "seq",
     sharded on ``seq_axis`` (and b on ``batch_axis`` when given)."""
     spec = P(batch_axis, None, seq_axis, None)
 
-    def attn(q, k, v, *, causal: bool = False, mask=None):
+    def attn(q, k, v, *, causal: bool = False, mask=None, segments=None):
         if mask is not None:
             raise NotImplementedError(
                 "ring attention supports causal masking only")
         fn = functools.partial(ring_attention, axis_name=seq_axis,
                                causal=causal, block_k=block_k)
+        if segments is not None:
+            seg_spec = P(batch_axis, seq_axis)
+            return jax.shard_map(
+                fn, mesh=mesh, in_specs=(spec, spec, spec, seg_spec),
+                out_specs=spec, check_vma=False)(q, k, v, segments)
         return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
                              out_specs=spec, check_vma=False)(q, k, v)
 
