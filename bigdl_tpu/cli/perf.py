@@ -110,7 +110,8 @@ def _record_batches(source: str, batch: int, n_threads: int = 0):
 
 def run(model_name: str, batch: int, iterations: int, data_type: str,
         use_bf16: bool = True, data_parallel: bool = False,
-        data_source: str | None = None, inner_steps: int = 1):
+        data_source: str | None = None, inner_steps: int = 1,
+        profile_dir: str | None = None):
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -244,15 +245,23 @@ def run(model_name: str, batch: int, iterations: int, data_type: str,
             raise SystemExit(f"unknown --data source {data_source!r}")
         next(feed)  # warm the decode pool outside the timed region
 
+    import contextlib
+    trace_cm = contextlib.nullcontext()
+    if profile_dir:
+        # xplane trace of the timed region (feeds scripts/mfu_experiment
+        # style analysis; view with tensorboard or xprof tooling)
+        trace_cm = jax.profiler.trace(profile_dir)
+
     t0 = time.perf_counter()
-    for _ in range(iterations):
-        if feed is not None:
-            mb = next(feed)
-            x = jnp.asarray(mb.input)   # host->device each step, like a
-            y = jnp.asarray(mb.target)  # real training epoch
-        params, mod_state, opt_state, loss = step(params, mod_state,
-                                                  opt_state, x, y, k)
-    float(loss)  # scalar host read = true device sync (see note above)
+    with trace_cm:
+        for _ in range(iterations):
+            if feed is not None:
+                mb = next(feed)
+                x = jnp.asarray(mb.input)   # host->device each step, as
+                y = jnp.asarray(mb.target)  # in a real training epoch
+            params, mod_state, opt_state, loss = step(params, mod_state,
+                                                      opt_state, x, y, k)
+        float(loss)  # scalar host read = true device sync (note above)
     dt = time.perf_counter() - t0
 
     total_steps = iterations * inner_steps
@@ -310,13 +319,17 @@ def main(argv=None):
     p.add_argument("--innerSteps", type=int, default=1,
                    help="steps chained inside one compiled program "
                         "(amortizes dispatch overhead)")
+    p.add_argument("--profile", default=None, metavar="DIR",
+                   help="write a jax.profiler xplane trace of the timed "
+                        "loop to DIR")
     from bigdl_tpu.cli.common import _add_platform_arg, apply_platform
     _add_platform_arg(p)
     args = p.parse_args(argv)
     apply_platform(args)
     run(args.model, args.batchSize, args.iteration, args.dataType,
         use_bf16=not args.f32, data_parallel=args.dataParallel,
-        data_source=args.data, inner_steps=args.innerSteps)
+        data_source=args.data, inner_steps=args.innerSteps,
+        profile_dir=args.profile)
 
 
 if __name__ == "__main__":

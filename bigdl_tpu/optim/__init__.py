@@ -9,7 +9,7 @@ from bigdl_tpu.optim.schedules import (
 from bigdl_tpu.optim.triggers import Trigger
 from bigdl_tpu.optim.validation import (
     ValidationMethod, ValidationResult, AccuracyResult, LossResult,
-    Top1Accuracy, Top5Accuracy, Loss,
+    PerplexityResult, Top1Accuracy, Top5Accuracy, Loss, Perplexity,
 )
 from bigdl_tpu.optim.lbfgs import LBFGS, line_search_wolfe
 from bigdl_tpu.optim.metrics import Metrics

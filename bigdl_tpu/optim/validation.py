@@ -13,7 +13,8 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 __all__ = ["ValidationResult", "AccuracyResult", "LossResult",
-           "ValidationMethod", "Top1Accuracy", "Top5Accuracy", "Loss"]
+           "PerplexityResult", "ValidationMethod", "Top1Accuracy",
+           "Top5Accuracy", "Loss", "Perplexity"]
 
 
 class ValidationResult:
@@ -124,3 +125,46 @@ class Loss(ValidationMethod):
 
     def to_result(self, value, count):
         return LossResult(float(value), int(count))
+
+
+class PerplexityResult(ValidationResult):
+    """exp(mean token NLL) — the LM counterpart of LossResult."""
+
+    def __init__(self, nll_sum: float, count: int):
+        self.nll_sum, self.count = float(nll_sum), int(count)
+
+    def __add__(self, other):
+        return PerplexityResult(self.nll_sum + other.nll_sum,
+                                self.count + other.count)
+
+    def result(self):
+        import math
+        ppl = math.exp(self.nll_sum / self.count) if self.count else 0.0
+        return ppl, self.count
+
+    def __repr__(self):
+        ppl, _ = self.result()
+        return f"PerplexityResult({ppl:.3f}, n={self.count})"
+
+
+class Perplexity(ValidationMethod):
+    """Token-level perplexity over (B, S, V) log-probs with (B, S) int
+    targets (the language-model validation the reference's Loss can't
+    express). Optional packed form: target = (targets, weights) from
+    ``models.packed_lm_targets`` — boundary/padding tokens carry weight 0
+    and drop out of both the sum and the count."""
+
+    name = "perplexity"
+
+    def stats(self, output, target):
+        if isinstance(target, (tuple, list)):
+            target, weights = target
+        else:
+            weights = jnp.ones(target.shape, output.dtype)
+        nll = -jnp.take_along_axis(
+            output, target[..., None].astype(jnp.int32), axis=-1)[..., 0]
+        w = weights.astype(nll.dtype)
+        return jnp.sum(nll * w), jnp.sum(w)
+
+    def to_result(self, value, count):
+        return PerplexityResult(float(value), int(count))

@@ -302,3 +302,31 @@ def test_lamb_converges_quadratic():
         g = jax.grad(loss)(p)
         p, st = opt.update(g, st, p)
     assert float(loss(p)) < 1e-2
+
+
+def test_perplexity_validation_method():
+    """Perplexity over (B,S,V) log-probs; the packed (targets, weights)
+    form drops weight-0 tokens from sum and count."""
+    import jax.numpy as jnp
+    import math
+
+    from bigdl_tpu.optim import Perplexity
+
+    m = Perplexity()
+    logp = jnp.log(jnp.full((1, 4, 2), 0.5))   # every token nll = ln 2
+    tgt = jnp.zeros((1, 4), jnp.int32)
+    v, c = m.stats(logp, tgt)
+    res = m.to_result(v, c)
+    ppl, n = res.result()
+    assert n == 4 and abs(ppl - 2.0) < 1e-6
+    # packed: half the tokens masked out
+    w = jnp.asarray([[1.0, 0.0, 1.0, 0.0]])
+    v2, c2 = m.stats(logp, (tgt, w))
+    ppl2, n2 = m.to_result(v2, c2).result()
+    assert n2 == 2 and abs(ppl2 - 2.0) < 1e-6
+    # results accumulate across batches like the other monoids
+    total = m.to_result(v, c) + m.to_result(v2, c2)
+    pplt, nt = total.result()
+    assert nt == 6 and abs(pplt - 2.0) < 1e-6
+    assert "PerplexityResult" in repr(total)
+    del math
