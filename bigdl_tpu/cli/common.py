@@ -53,6 +53,11 @@ def add_train_args(p: argparse.ArgumentParser) -> None:
                    help="shard the batch over all visible devices")
     p.add_argument("--seed", type=int, default=42)
     p.add_argument("--logEvery", type=int, default=10)
+    p.add_argument("--optimMethod", default="sgd",
+                   choices=["sgd", "adam", "adamw", "adagrad", "rmsprop",
+                            "lars", "lamb"],
+                   help="optimizer (sgd keeps the reference defaults; "
+                        "weightDecay/momentum apply where meaningful)")
 
 
 def add_test_args(p: argparse.ArgumentParser) -> None:
@@ -89,13 +94,35 @@ def build_optimizer(model, dataset, criterion, args, schedule=None,
     from bigdl_tpu.optim.schedules import Default
 
     if optim_method is None:
-        optim_method = SGD(
-            learning_rate=args.learningRate,
-            weight_decay=args.weightDecay,
-            momentum=args.momentum,
-            schedule=schedule if schedule is not None
-            else Default(args.learningRateDecay),
-        )
+        sched = (schedule if schedule is not None
+                 else Default(args.learningRateDecay))
+        name = getattr(args, "optimMethod", "sgd")
+        if name == "sgd":
+            optim_method = SGD(
+                learning_rate=args.learningRate,
+                weight_decay=args.weightDecay,
+                momentum=args.momentum, schedule=sched)
+        else:
+            from bigdl_tpu.optim import (Adagrad, Adam, AdamW, LAMB, LARS,
+                                         RMSprop)
+            lr = args.learningRate
+            wd = args.weightDecay
+            optim_method = {
+                "adam": lambda: Adam(learning_rate=lr, schedule=sched),
+                "adamw": lambda: AdamW(learning_rate=lr, weight_decay=wd,
+                                       schedule=sched),
+                # Adagrad/RMSprop carry their own decay knobs, no
+                # schedule parameter (matching the reference's surface)
+                "adagrad": lambda: Adagrad(
+                    learning_rate=lr, weight_decay=wd,
+                    lr_decay=args.learningRateDecay),
+                "rmsprop": lambda: RMSprop(learning_rate=lr),
+                "lars": lambda: LARS(learning_rate=lr, weight_decay=wd,
+                                     momentum=args.momentum,
+                                     schedule=sched),
+                "lamb": lambda: LAMB(learning_rate=lr, weight_decay=wd,
+                                     schedule=sched),
+            }[name]()
     opt = Optimizer(model, dataset, criterion,
                     optim_method=optim_method,
                     end_when=Trigger.max_epoch(args.maxEpoch),
