@@ -44,6 +44,11 @@ def _peak_flops(device):
 _LM_VOCAB = 32000  # shared by the model head and the synthetic token data
 
 
+def _bn_subset(m, k: int = 32):
+    from bigdl_tpu.nn import set_bn_stat_sample
+    return set_bn_stat_sample(m, k)
+
+
 def build_model(name: str, class_num: int = 1000):
     import jax
 
@@ -57,6 +62,9 @@ def build_model(name: str, class_num: int = 1000):
         "alexnet": lambda: models.alexnet(class_num),
         "resnet50": lambda: models.resnet50(class_num),
         "resnet50_s2d": lambda: models.resnet50(class_num, s2d_stem=True),
+        # BN stats from 32 batch rows: cuts the stats-pass HBM re-read of
+        # every activation (the dominant BN cost, PERF.md §2) by b/32
+        "resnet50_bnss": lambda: _bn_subset(models.resnet50(class_num)),
         "lenet5": lambda: models.lenet5(10),
         # long-context flagship: 32k vocab, 512-token causal LM. The Pallas
         # kernel only off-interpret on TPU; elsewhere the dense path keeps

@@ -18,6 +18,7 @@ from bigdl_tpu.core.module import Module, SimpleModule
 
 __all__ = [
     "BatchNormalization",
+    "set_bn_stat_sample",
     "SpatialBatchNormalization",
     "SpatialCrossMapLRN",
     "SpatialSubtractiveNormalization",
@@ -49,12 +50,21 @@ class BatchNormalization(Module):
 
     def __init__(self, n_output: int, eps: float = 1e-5, momentum: float = 0.1,
                  affine: bool = True, axis_name: Optional[str] = None,
-                 gamma_init: float = 1.0, name: Optional[str] = None):
+                 gamma_init: float = 1.0, stat_sample: Optional[int] = None,
+                 name: Optional[str] = None):
         super().__init__(name)
         self.n_output = n_output
         self.eps, self.momentum, self.affine = eps, momentum, affine
         self.axis_name = axis_name
         self.gamma_init = gamma_init
+        # stat_sample=k: training statistics from the first k batch rows
+        # only. The stats pass re-reads every activation from HBM (the
+        # dominant BN cost on TPU — PERF.md §2); a subset cuts that read
+        # by batch/k while the normalize stays exact. Statistically this
+        # is the reference's per-executor local-stats BN (each clone
+        # normalized by a batch fraction). Throughput lever — leave None
+        # for exact full-batch stats.
+        self.stat_sample = stat_sample
 
     def init(self, rng):
         if not self.affine:
@@ -74,15 +84,17 @@ class BatchNormalization(Module):
         axes = tuple(range(x.ndim - 1))  # all but features
         xf = x.astype(jnp.float32)
         if training:
-            mean = jnp.mean(xf, axis=axes)
-            mean_sq = jnp.mean(jnp.square(xf), axis=axes)
+            k = self.stat_sample
+            xs = xf if (not k or k >= xf.shape[0]) else xf[:k]
+            mean = jnp.mean(xs, axis=axes)
+            mean_sq = jnp.mean(jnp.square(xs), axis=axes)
             if self.axis_name is not None:
                 # cross-replica moments (not per-shard variances!) — sync-BN
                 mean = lax.pmean(mean, self.axis_name)
                 mean_sq = lax.pmean(mean_sq, self.axis_name)
             var = mean_sq - jnp.square(mean)
             m = self.momentum
-            n = xf.size // xf.shape[-1]
+            n = xs.size // xs.shape[-1]
             if self.axis_name is not None:
                 n = n * lax.psum(1, self.axis_name)  # global sample count
             unbiased = var * n / jnp.maximum(1, n - 1)
@@ -102,6 +114,17 @@ class BatchNormalization(Module):
             shift = -mean * scale
         y = xf * scale + shift
         return y.astype(x.dtype), new_state
+
+
+def set_bn_stat_sample(module, k: Optional[int]):
+    """Set ``stat_sample`` on every BatchNormalization in a module tree
+    (post-construction — saves threading the knob through every model
+    builder). Returns the module."""
+    if isinstance(module, BatchNormalization):
+        module.stat_sample = k
+    for ch in getattr(module, "children", lambda: ())() or ():
+        set_bn_stat_sample(ch, k)
+    return module
 
 
 class SpatialBatchNormalization(BatchNormalization):

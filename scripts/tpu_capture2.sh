@@ -28,6 +28,7 @@ for B in 64 128 256 512; do
 done
 step "perf_resnet50_s2d_b128" 900 python -m bigdl_tpu.cli.perf -m resnet50_s2d -b 128 -i 20 --dataType random
 step "perf_resnet50_inner10_b128" 900 python -m bigdl_tpu.cli.perf -m resnet50 -b 128 -i 4 --innerSteps 10 --dataType random
+step "perf_resnet50_bnss_b128" 900 python -m bigdl_tpu.cli.perf -m resnet50_bnss -b 128 -i 20 --dataType random
 
 # transformer (flash kernel on the compiled path)
 step "perf_transformer_lm_b32" 900 python -m bigdl_tpu.cli.perf -m transformer_lm -b 32 -i 10 --dataType random

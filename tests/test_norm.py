@@ -107,3 +107,53 @@ def test_batchnorm_grad_flows(rng):
 
     g = jax.grad(loss)(p)
     assert float(jnp.abs(g["weight"]).sum()) > 0
+
+
+def test_bn_stat_sample_subset_semantics():
+    """stat_sample=k: training stats come from the first k rows only;
+    k >= batch (or None) is exactly the default; set_bn_stat_sample walks
+    a container tree."""
+    import jax
+
+    from bigdl_tpu.nn import (SpatialBatchNormalization,
+                              set_bn_stat_sample)
+
+    rs = np.random.RandomState(0)
+    x = jnp.asarray(rs.randn(4, 3, 3, 5), jnp.float32)
+    bn = SpatialBatchNormalization(5)
+    p, st = bn.init(jax.random.PRNGKey(0)), bn.init_state()
+
+    full, _ = bn.apply(p, st, x, training=True)
+    bn.stat_sample = 8  # >= batch: unchanged
+    same, _ = bn.apply(p, st, x, training=True)
+    np.testing.assert_allclose(np.asarray(full), np.asarray(same),
+                               atol=1e-6)
+
+    bn.stat_sample = 2
+    sub, st2 = bn.apply(p, st, x, training=True)
+    xs = np.asarray(x[:2], np.float64)
+    mean = xs.mean(axis=(0, 1, 2))
+    var = (xs ** 2).mean(axis=(0, 1, 2)) - mean ** 2
+    want = (np.asarray(x, np.float64) - mean) / np.sqrt(var + bn.eps)
+    np.testing.assert_allclose(np.asarray(sub), want, atol=1e-4)
+    # running stats update from the subset too
+    n = xs.size // xs.shape[-1]
+    np.testing.assert_allclose(np.asarray(st2["running_mean"]),
+                               0.1 * mean, atol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(st2["running_var"]),
+        0.9 + 0.1 * var * n / (n - 1), atol=1e-4)
+
+    from bigdl_tpu.models import resnet_cifar
+    m = resnet_cifar(20)
+    set_bn_stat_sample(m, 16)
+    found = []
+
+    def walk(mod):
+        if isinstance(mod, SpatialBatchNormalization):
+            found.append(mod.stat_sample)
+        for ch in getattr(mod, "children", lambda: ())() or ():
+            walk(ch)
+
+    walk(m)
+    assert found and all(k == 16 for k in found), len(found)
