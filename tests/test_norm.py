@@ -157,3 +157,34 @@ def test_bn_stat_sample_subset_semantics():
 
     walk(m)
     assert found and all(k == 16 for k in found), len(found)
+
+
+def test_bn_stat_sample_still_trains():
+    """The subset-stats lever must not break optimization: a tiny CIFAR
+    ResNet with stat_sample=8 separates two synthetic classes."""
+    import jax
+
+    from bigdl_tpu import nn as bnn
+    from bigdl_tpu.dataset import BatchDataSet
+    from bigdl_tpu.models import resnet_cifar
+    from bigdl_tpu.nn import set_bn_stat_sample
+    from bigdl_tpu.optim import (Optimizer, SGD, Top1Accuracy, Trigger,
+                                 Validator)
+
+    rs = np.random.RandomState(2)
+    n = 128
+    y = rs.randint(0, 2, n).astype(np.int32)
+    x = rs.randn(n, 32, 32, 3).astype(np.float32) * 0.1
+    x[y == 0, :16] += 1.0
+    x[y == 1, 16:] += 1.0
+
+    m = set_bn_stat_sample(resnet_cifar(8, class_num=10), 8)
+    opt = Optimizer(m, BatchDataSet(x, y, 32, shuffle=True),
+                    bnn.ClassNLLCriterion(),
+                    optim_method=SGD(learning_rate=0.1, momentum=0.9),
+                    end_when=Trigger.max_epoch(8))
+    trained = opt.optimize()
+    (res,) = Validator(m, BatchDataSet(x, y, 64)).test(
+        trained.params, trained.mod_state, [Top1Accuracy()])
+    acc, _ = res.result()
+    assert acc > 0.9, f"subset-stat BN failed to train: {acc}"
