@@ -350,3 +350,41 @@ def test_blockwise_segments_matches_dense(causal):
     for a, c in zip(g1, g2):
         np.testing.assert_allclose(np.asarray(a), np.asarray(c),
                                    atol=3e-5)
+
+
+def test_flash_segments_with_q_padding():
+    """s=160 with block_q=128 pads queries to 256 inside the kernel; a
+    row whose segments are all nonzero then has fully-masked padded query
+    rows — gradients must stay finite and match dense on live positions
+    (the explicit p-re-zeroing after exp() is what keeps inf*0 out)."""
+    from bigdl_tpu.nn.attention import (dot_product_attention,
+                                        make_segment_mask)
+
+    rs = np.random.RandomState(3)
+    b, h, s, d = 2, 2, 160, 32
+    q = jnp.asarray(rs.randn(b, h, s, d), jnp.float32)
+    k = jnp.asarray(rs.randn(b, h, s, d), jnp.float32)
+    v = jnp.asarray(rs.randn(b, h, s, d), jnp.float32)
+    segs = np.ones((b, s), np.int32)     # row 0: one full doc, no padding
+    segs[1, :80] = 1
+    segs[1, 80:] = 2
+    segs = jnp.asarray(segs)
+
+    out = flash_attention(q, k, v, causal=True, segments=segs,
+                          block_q=128, block_k=32)
+    want = dot_product_attention(q, k, v, causal=True,
+                                 mask=make_segment_mask(segs))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                               atol=2e-5)
+
+    g = jax.grad(lambda q, k, v: jnp.sum(jnp.square(flash_attention(
+        q, k, v, causal=True, segments=segs, block_q=128, block_k=32))),
+        argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(lambda q, k, v: jnp.sum(jnp.square(
+        dot_product_attention(q, k, v, causal=True,
+                              mask=make_segment_mask(segs)))),
+        argnums=(0, 1, 2))(q, k, v)
+    for a, c in zip(g, gd):
+        assert np.isfinite(np.asarray(a)).all()
+        np.testing.assert_allclose(np.asarray(a), np.asarray(c),
+                                   atol=5e-5)
