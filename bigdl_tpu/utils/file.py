@@ -99,6 +99,29 @@ def load_pytree(path: str) -> Any:
     return jax.tree_util.tree_unflatten(treedef, leaves)
 
 
+def save_module(module, params, mod_state, path: str) -> None:
+    """Whole-model file: the module DEFINITION (pickled — modules are
+    plain Python descriptions with no arrays inside) plus its
+    params/mod_state pytrees, in one artifact — the analog of the
+    reference's ``model.save(path)`` (Java-serialized Module,
+    nn/Module.scala:28-42), so a Test/predict program needs no builder
+    code. Weights-only interchange stays on ``save_pytree``.
+    """
+    blob = {"params": params, "mod_state": mod_state,
+            "__module__": np.frombuffer(pickle.dumps(module),
+                                        dtype=np.uint8)}
+    save_pytree(blob, path)
+
+
+def load_module(path: str):
+    """-> (module, params, mod_state). Trust note: like the reference's
+    Java deserialization, the module definition is a pickle — load only
+    files you produced."""
+    blob = load_pytree(path)
+    module = pickle.loads(blob.pop("__module__").tobytes())
+    return module, blob["params"], blob["mod_state"]
+
+
 def latest_checkpoint(directory: str, prefix: str = "model.") -> str | None:
     """Find the highest-numbered ``<prefix><n>`` entry (resume helper,
     reference models/lenet/Train.scala:55-67 --model/--state flags).
