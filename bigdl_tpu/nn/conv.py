@@ -136,6 +136,7 @@ class SpatialFullConvolution(SimpleModule):
         n_group: int = 1,
         with_bias: bool = True,
         param_dtype=jnp.float32,
+        init: str = "default",
         name: Optional[str] = None,
     ):
         super().__init__(name)
@@ -147,16 +148,41 @@ class SpatialFullConvolution(SimpleModule):
         self.n_group = n_group
         self.with_bias = with_bias
         self.param_dtype = param_dtype
+        if init not in ("default", "bilinear"):
+            raise ValueError(f"init {init!r} not in ('default','bilinear')")
+        self.init_method = init
 
     def init(self, rng):
         k_w, k_b = jax.random.split(rng)
         fan_in = self.kernel_w * self.kernel_h * (self.n_output_plane // self.n_group)
         shape = (self.kernel_h, self.kernel_w,
                  self.n_input_plane // self.n_group, self.n_output_plane)
-        p = {"weight": uniform_fan_in(k_w, shape, fan_in, self.param_dtype)}
+        if self.init_method == "bilinear":
+            # BilinearFiller (reference SpatialFullConvolution.scala:121 +
+            # InitializationMethod.scala:48): the deconv starts as exact
+            # bilinear upsampling — FCN-style segmentation heads. Each
+            # input channel maps to the matching output channel with the
+            # separable triangle kernel; cross-channel taps start at 0.
+            f_h = (self.kernel_h + 1) // 2
+            c_h = (2 * f_h - 1 - f_h % 2) / (2.0 * f_h)
+            wh = 1 - np.abs(np.arange(self.kernel_h) / f_h - c_h)
+            f_w = (self.kernel_w + 1) // 2
+            c_w = (2 * f_w - 1 - f_w % 2) / (2.0 * f_w)
+            ww = 1 - np.abs(np.arange(self.kernel_w) / f_w - c_w)
+            tri = wh[:, None] * ww[None, :]
+            w = np.zeros(shape, np.float64)
+            cin = self.n_input_plane // self.n_group
+            for i in range(min(cin, self.n_output_plane)):
+                w[:, :, i, i] = tri
+            p = {"weight": jnp.asarray(w, self.param_dtype)}
+        else:
+            p = {"weight": uniform_fan_in(k_w, shape, fan_in,
+                                          self.param_dtype)}
         if self.with_bias:
-            p["bias"] = uniform_fan_in(k_b, (self.n_output_plane,), fan_in,
-                                       self.param_dtype)
+            p["bias"] = (jnp.zeros((self.n_output_plane,), self.param_dtype)
+                         if self.init_method == "bilinear" else
+                         uniform_fan_in(k_b, (self.n_output_plane,), fan_in,
+                                        self.param_dtype))
         return p
 
     def _forward(self, params, x, *, training, rng):

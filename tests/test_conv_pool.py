@@ -135,3 +135,31 @@ def test_conv_gradcheck(rng):
         return jnp.sum(jnp.square(mod.forward(params, x)))
 
     check_gradients(loss, p)
+
+
+def test_full_conv_bilinear_filler_upsamples():
+    """init="bilinear" (reference BilinearFiller,
+    SpatialFullConvolution.scala:121): a stride-2 4x4 deconv initialized
+    bilinear reproduces torch's bilinear-upsample on the interior, and a
+    constant image maps to the same constant."""
+    import torch.nn.functional as F
+
+    from bigdl_tpu.nn import SpatialFullConvolution
+
+    m = SpatialFullConvolution(1, 1, 4, 4, 2, 2, 1, 1, with_bias=False,
+                               init="bilinear")
+    p = m.init(jax.random.PRNGKey(0))
+
+    ones = jnp.ones((1, 5, 5, 1), jnp.float32)
+    out = np.asarray(m.forward(p, ones))[0, :, :, 0]
+    np.testing.assert_allclose(out[1:-1, 1:-1], 1.0, atol=1e-6)
+
+    rs = np.random.RandomState(0)
+    x = rs.randn(1, 6, 6, 1).astype(np.float32)
+    got = np.asarray(m.forward(p, jnp.asarray(x)))[0, :, :, 0]
+    want = F.interpolate(torch.from_numpy(x.transpose(0, 3, 1, 2)),
+                         scale_factor=2, mode="bilinear",
+                         align_corners=False).numpy()[0, 0]
+    # interiors agree exactly; borders differ by the padding convention
+    np.testing.assert_allclose(got[2:-2, 2:-2], want[2:-2, 2:-2],
+                               atol=1e-5)
