@@ -351,6 +351,11 @@ class Optimizer:
                         "Train %d in %.4fs. Throughput is %.1f "
                         "records/second. Loss is %.4f",
                         n, dt, n / max(dt, 1e-9), loss_f)
+                    self._summary_write("train", {
+                        "iteration": driver["iteration"],
+                        "epoch": driver["epoch"],
+                        "loss": loss_f,
+                        "records_per_second": n / max(dt, 1e-9)})
                     # reference logs metrics.summary() at debug each
                     # iteration (DistriOptimizer.scala:245); guard so the
                     # string is only built when it will be emitted
@@ -388,8 +393,31 @@ class Optimizer:
                                  self.strategy)
         for m, r in zip(self._val_methods, results):
             logger.info("%s is %r", m.name, r)
+        self._summary_write("val", {
+            "iteration": driver["iteration"],
+            "epoch": driver["epoch"],
+            **{m.name.replace(" ", "_"): r.result()[0]
+               for m, r in zip(self._val_methods, results)}})
         driver["val_results"] = results
         return results
+
+    # -------------------------------------------------------- summaries
+    def set_summary(self, directory: str) -> "Optimizer":
+        """Append per-log-point train scalars and per-validation metric
+        values as JSON lines to <dir>/train.jsonl and <dir>/val.jsonl —
+        the plottable training-curve record (the observability the
+        reference left to log scraping)."""
+        os.makedirs(directory, exist_ok=True)
+        self._summary_dir = directory
+        return self
+
+    def _summary_write(self, which: str, row: dict) -> None:
+        d = getattr(self, "_summary_dir", None)
+        if d is None:
+            return
+        import json
+        with open(os.path.join(d, f"{which}.jsonl"), "a") as f:
+            f.write(json.dumps(row) + "\n")
 
     def _maybe_checkpoint(self, params, mod_state, opt_state, driver):
         if (self._ckpt_path is None or self._ckpt_trigger is None

@@ -181,3 +181,29 @@ def test_save_load_module_whole_model(tmp_path):
     la, _ = lm.apply(lp, {}, tok)
     lb, _ = lm2.apply(lp2, {}, tok)
     np.testing.assert_allclose(np.asarray(la), np.asarray(lb), atol=1e-6)
+
+
+def test_summary_jsonl(tmp_path):
+    """set_summary writes plottable train/val curves as JSON lines."""
+    import json
+
+    x, y = _xor_data(128)
+    ds = BatchDataSet(x, y, batch_size=32, shuffle=True)
+    model = Sequential(nn.Linear(2, 8), nn.Tanh(), nn.Linear(8, 2),
+                       nn.LogSoftMax())
+    sdir = str(tmp_path / "summ")
+    opt = (Optimizer(model, ds, nn.ClassNLLCriterion(),
+                     optim_method=SGD(learning_rate=0.5, momentum=0.9),
+                     end_when=Trigger.max_epoch(2), log_every=2)
+           .set_validation(Trigger.every_epoch(),
+                           BatchDataSet(x, y, 64), [Top1Accuracy()])
+           .set_summary(sdir))
+    opt.optimize()
+
+    train = [json.loads(l) for l in open(os.path.join(sdir, "train.jsonl"))]
+    val = [json.loads(l) for l in open(os.path.join(sdir, "val.jsonl"))]
+    assert train and all({"iteration", "epoch", "loss",
+                          "records_per_second"} <= set(r) for r in train)
+    assert len(val) == 2 and all("top1_accuracy" in r for r in val)
+    its = [r["iteration"] for r in train]
+    assert its == sorted(its)
