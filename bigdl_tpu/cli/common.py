@@ -53,6 +53,8 @@ def add_train_args(p: argparse.ArgumentParser) -> None:
                    help="shard the batch over all visible devices")
     p.add_argument("--seed", type=int, default=42)
     p.add_argument("--logEvery", type=int, default=10)
+    p.add_argument("--summary", default=None, metavar="DIR",
+                   help="append train/val JSONL curves to DIR")
     p.add_argument("--optimMethod", default="sgd",
                    choices=["sgd", "adam", "adamw", "adagrad", "rmsprop",
                             "lars", "lamb"],
@@ -135,6 +137,8 @@ def build_optimizer(model, dataset, criterion, args, schedule=None,
                                              False))
     if args.model:
         opt.resume(args.model)
+    if getattr(args, "summary", None):
+        opt.set_summary(args.summary)
     return opt
 
 
