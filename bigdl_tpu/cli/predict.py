@@ -27,6 +27,9 @@ def main(argv=None):
     p.add_argument("-b", "--batchSize", type=int, default=32)
     p.add_argument("--classNum", type=int, default=1000)
     p.add_argument("--topN", type=int, default=1)
+    p.add_argument("--imageSize", type=int, default=None,
+                   help="input side for whole-model files (defaults per "
+                        "--modelName otherwise)")
     args = p.parse_args(argv)
     common.apply_platform(args)
 
@@ -36,17 +39,30 @@ def main(argv=None):
     from bigdl_tpu.dataset.folder import _decode, list_image_folder
     from bigdl_tpu.utils import Classifier
 
-    if args.modelName == "lenet":
-        model, size = models.lenet5(max(args.classNum, 10)), (28, 28)
-    else:
-        build = {"alexnet": models.alexnet,
-                 "inception_v1": models.inception_v1_no_aux,
-                 "resnet50": models.resnet50,
-                 "vgg16": models.vgg16}[args.modelName]
-        model, size = build(args.classNum), (
-            (227, 227) if args.modelName == "alexnet" else (224, 224))
-
-    params, mod_state = common.load_trained(model, args.model)
+    model = None
+    if os.path.isfile(args.model):
+        # a save_module artifact carries its own definition — no
+        # --modelName rebuild needed (reference Module.load semantics)
+        try:
+            from bigdl_tpu.utils.file import load_module
+            model, params, mod_state = load_module(args.model)
+            side = args.imageSize or 224
+            size = (side, side)
+        except Exception:
+            model = None
+    if model is None:
+        if args.modelName == "lenet":
+            model, size = models.lenet5(max(args.classNum, 10)), (28, 28)
+        else:
+            build = {"alexnet": models.alexnet,
+                     "inception_v1": models.inception_v1_no_aux,
+                     "resnet50": models.resnet50,
+                     "vgg16": models.vgg16}[args.modelName]
+            model, size = build(args.classNum), (
+                (227, 227) if args.modelName == "alexnet" else (224, 224))
+        if args.imageSize:
+            size = (args.imageSize, args.imageSize)
+        params, mod_state = common.load_trained(model, args.model)
     clf = Classifier(model, params, mod_state, batch_size=args.batchSize)
 
     # accept both a class-subdir tree and a flat folder of images

@@ -105,3 +105,29 @@ def test_loadmodel_bigdl_checkpoint_roundtrip(tmp_path, rng):
                               "-f", str(val), "-b", "4", "--classNum", "10"])
     acc, count = results[0].result()
     assert count == 4 and 0.0 <= acc <= 1.0
+
+
+def test_predict_whole_model_file(tmp_path, capsys, rng):
+    """predict accepts a save_module artifact directly — the embedded
+    definition replaces --modelName."""
+    from PIL import Image
+
+    from bigdl_tpu.cli import predict
+    from bigdl_tpu.models import lenet5
+    from bigdl_tpu.utils.file import save_module
+
+    model = lenet5(10)
+    path = str(tmp_path / "whole.model")
+    save_module(model, model.init(rng), model.init_state(), path)
+
+    imgs = tmp_path / "imgs"
+    imgs.mkdir()
+    rs = np.random.RandomState(1)
+    for i in range(2):
+        Image.fromarray(rs.randint(0, 255, (28, 28), np.uint8), "L").save(
+            imgs / f"im{i}.png")
+
+    predict.main(["--model", path, "-f", str(imgs), "-b", "2",
+                  "--imageSize", "28"])
+    lines = [l for l in capsys.readouterr().out.splitlines() if "\t" in l]
+    assert len(lines) == 2
