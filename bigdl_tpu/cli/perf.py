@@ -120,9 +120,20 @@ def run(model_name: str, batch: int, iterations: int, data_type: str,
         use_bf16: bool = True, data_parallel: bool = False,
         data_source: str | None = None, inner_steps: int = 1,
         profile_dir: str | None = None):
+    import os
+
     import jax
     import jax.numpy as jnp
     import numpy as np
+
+    try:
+        # persistent compile cache: repeat benchmark runs (the capture
+        # sweeps re-measure the same configs) skip the 20-40s TPU compile
+        jax.config.update(
+            "jax_compilation_cache_dir",
+            os.environ.get("BIGDL_JAX_CACHE", "/tmp/bigdl_jax_cache"))
+    except Exception:
+        pass  # older jax or read-only fs: compile as usual
 
     from bigdl_tpu import nn
     from bigdl_tpu.optim import SGD
