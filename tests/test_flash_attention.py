@@ -388,3 +388,25 @@ def test_flash_segments_with_q_padding():
         assert np.isfinite(np.asarray(a)).all()
         np.testing.assert_allclose(np.asarray(a), np.asarray(c),
                                    atol=5e-5)
+
+
+def test_flash_segments_bf16():
+    """Segments path in bf16 (the production dtype) stays close to the
+    f32 dense reference."""
+    from bigdl_tpu.nn.attention import (dot_product_attention,
+                                        make_segment_mask)
+
+    rs = np.random.RandomState(9)
+    b, h, s, d = 1, 2, 128, 64
+    q = jnp.asarray(rs.randn(b, h, s, d), jnp.bfloat16)
+    k = jnp.asarray(rs.randn(b, h, s, d), jnp.bfloat16)
+    v = jnp.asarray(rs.randn(b, h, s, d), jnp.bfloat16)
+    segs = jnp.asarray(np.repeat([[1, 2]], 64, axis=1).reshape(1, 128))
+    out = flash_attention(q, k, v, causal=True, segments=segs,
+                          block_q=32, block_k=32)
+    want = dot_product_attention(
+        q.astype(jnp.float32), k.astype(jnp.float32),
+        v.astype(jnp.float32), causal=True,
+        mask=make_segment_mask(segs))
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(want), atol=5e-2)
