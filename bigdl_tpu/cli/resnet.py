@@ -17,6 +17,9 @@ def main(argv=None):
     tr = sub.add_parser("train")
     common.add_train_args(tr)
     tr.add_argument("--depth", type=int, default=20)
+    tr.add_argument("--bnStatSample", type=int, default=None,
+                    help="BN training stats from this many batch rows "
+                         "(throughput lever; see nn.set_bn_stat_sample)")
     # reference resnet recipe defaults (an explicit --weightDecay 0 still
     # disables decay; only the *default* changes here)
     tr.set_defaults(weightDecay=1e-4)
@@ -32,6 +35,9 @@ def main(argv=None):
     from bigdl_tpu.optim.schedules import EpochSchedule, Regime
 
     model = resnet_cifar(args.depth, 10)
+    if getattr(args, "bnStatSample", None):
+        from bigdl_tpu.nn import set_bn_stat_sample
+        set_bn_stat_sample(model, args.bnStatSample)
     if args.cmd == "train":
         train, test = _datasets(args.folder, args.batchSize, train_aug=True)
         # reference resnet training regime: lr drops at epochs 81/122
