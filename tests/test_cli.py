@@ -113,3 +113,22 @@ def test_perf_harness_lenet(capsys):
     parsed = json.loads(printed)
     assert parsed["model"] == "lenet5"
     assert parsed["images_per_second_per_chip"] > 0
+
+
+def test_capture_scripts_reference_valid_perf_models():
+    """A typo'd -m in the capture sweeps would waste a tunnel window; pin
+    every referenced model to the perf build table."""
+    import re
+
+    from bigdl_tpu.cli.perf import build_model
+
+    names = set()
+    for script in ("scripts/tpu_capture.sh", "scripts/tpu_capture2.sh"):
+        for line in open(os.path.join(os.path.dirname(__file__), "..",
+                                      script)):
+            m = re.search(r"cli\.perf -m (\S+)", line)
+            if m:
+                names.add(m.group(1))
+    assert names, "no perf invocations found in capture scripts"
+    for n in names:
+        build_model(n, 10)  # raises SystemExit on unknown names
