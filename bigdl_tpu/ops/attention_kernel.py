@@ -678,7 +678,15 @@ def flash_attention(q, k, v, *, causal: bool = False,
         if mask is not None:
             raise ValueError("segments and mask are mutually exclusive")
         s_q, s_k = q.shape[-2], k.shape[-2]
-        if s_q != s_k or not _tileable(s_q, s_k, block_k):
+        # the kv-segment block is (1, 8, bk), so Mosaic additionally
+        # needs bk lane-aligned: a multiple of 128 or the whole s_k.
+        # Clamp small block_k up to 128 when that still tiles; otherwise
+        # fall back to the dense block-diagonal mask.
+        bk = min(block_k, max(8, s_k))
+        legal = s_k % bk == 0 and (bk == s_k or bk % 128 == 0)
+        if not legal and s_k % 128 == 0:
+            block_k, legal = 128, True
+        if s_q != s_k or not legal:
             return _dense.dot_product_attention(
                 q, k, v, causal=causal,
                 mask=_dense.make_segment_mask(segments))

@@ -410,3 +410,63 @@ def test_flash_segments_bf16():
         mask=make_segment_mask(segs))
     np.testing.assert_allclose(np.asarray(out, np.float32),
                                np.asarray(want), atol=5e-2)
+
+
+def test_block_specs_satisfy_mosaic_tiling():
+    """Static Mosaic tiling lint, no TPU needed: intercept every
+    pallas_call the flash kernels make and check each block's last two
+    dims are (8k, 128k)-aligned or equal to the array dims — the exact
+    rule the first on-chip run failed (interpret mode never checks it)."""
+    from unittest import mock
+
+    from jax.experimental import pallas as real_pl
+
+    captured = []
+    real_call = real_pl.pallas_call
+
+    def spy(kernel, **kw):
+        specs = []
+        in_specs = kw.get("in_specs") or []
+        out_specs = kw.get("out_specs")
+        out_shape = kw.get("out_shape")
+        outs = out_specs if isinstance(out_specs, (list, tuple)) \
+            else [out_specs]
+        shapes = out_shape if isinstance(out_shape, (list, tuple)) \
+            else [out_shape]
+        inner = real_call(kernel, **kw)
+
+        def wrapped(*args):
+            for spec, arr in list(zip(in_specs, args)) + [
+                    (s, sh) for s, sh in zip(outs, shapes)]:
+                if spec is None:
+                    continue
+                captured.append((tuple(spec.block_shape),
+                                 tuple(arr.shape)))
+            return inner(*args)
+
+        return wrapped
+
+    with mock.patch.object(real_pl, "pallas_call", side_effect=spy):
+        rs = np.random.RandomState(0)
+        q = jnp.asarray(rs.randn(1, 2, 256, 32), jnp.float32)
+        segs = jnp.asarray(np.r_[[1] * 100, [2] * 156][None].repeat(1, 0))
+        # block_k=32 gets clamped to the lane-legal 128 for the
+        # kv-segment layout; the specs captured here are the clamped ones
+        jax.grad(lambda q: flash_attention(
+            q, q, q, causal=True, segments=segs, block_q=128,
+            block_k=32).sum())(q)
+        # small-seq padded-q kernel case (bk == s_k escape, bq pads)
+        q2 = jnp.asarray(rs.randn(1, 2, 60, 32), jnp.float32)
+        segs2 = jnp.asarray(np.r_[[1] * 40, [2] * 20][None])
+        jax.grad(lambda q: flash_attention(
+            q, q, q, causal=True, segments=segs2, block_q=32,
+            block_k=64).sum())(q2)
+        jax.grad(lambda q: flash_attention(
+            q, q, q, causal=True, block_q=128, block_k=32).sum())(q)
+
+    assert len(captured) >= 15, f"spy captured too little: {len(captured)}"
+    for bs, ashape in captured:
+        b0, b1 = bs[-2], bs[-1]
+        a0, a1 = ashape[-2], ashape[-1]
+        assert b1 == a1 or b1 % 128 == 0, (bs, ashape)
+        assert b0 == a0 or b0 % 8 == 0, (bs, ashape)
