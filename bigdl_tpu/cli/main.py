@@ -1,0 +1,69 @@
+"""`bigdl-tpu` console entry point — one launcher for every example and
+tool, the analog of the reference's dispatch script
+(/root/reference/scripts/run.example.sh:21-47, which maps a model name to
+its Spark-submit class) and its per-model `...models.<name>.Train` mains.
+
+    bigdl-tpu lenet train -f /data/mnist -b 128
+    bigdl-tpu perf -m resnet50 -b 128 -i 20
+    bigdl-tpu predict --model model.bin -f images/
+
+Each subcommand forwards to the matching ``bigdl_tpu.cli.<module>.main``,
+so `bigdl-tpu lenet ...` and `python -m bigdl_tpu.cli.lenet ...` are the
+same surface.
+"""
+
+from __future__ import annotations
+
+import importlib
+import sys
+from typing import List, Optional
+
+# subcommand -> cli module name (all expose main(argv))
+_COMMANDS = {
+    "lenet": "lenet",
+    "vgg": "vgg",
+    "resnet": "resnet",
+    "inception": "inception",
+    "rnn": "rnn",
+    "autoencoder": "autoencoder",
+    "transformerlm": "transformerlm",
+    "textclassification": "textclassification",
+    "perf": "perf",
+    "predict": "predict",
+    "loadmodel": "loadmodel",
+    "record-gen": "record_gen",
+}
+
+
+def _usage() -> str:
+    from bigdl_tpu import __version__
+
+    cmds = "\n".join(f"  {name}" for name in _COMMANDS)
+    return (f"bigdl-tpu {__version__} — TPU-native deep-learning "
+            f"framework\n\nusage: bigdl-tpu <command> [args...]\n\n"
+            f"commands:\n{cmds}\n\n"
+            f"run `bigdl-tpu <command> --help` for per-command flags\n")
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv or argv[0] in ("-h", "--help"):
+        print(_usage())
+        return 0
+    if argv[0] == "--version":
+        from bigdl_tpu import __version__
+
+        print(__version__)
+        return 0
+    cmd, rest = argv[0], argv[1:]
+    if cmd not in _COMMANDS:
+        print(f"bigdl-tpu: unknown command {cmd!r}\n\n{_usage()}",
+              file=sys.stderr)
+        return 2
+    mod = importlib.import_module(f"bigdl_tpu.cli.{_COMMANDS[cmd]}")
+    rc = mod.main(rest)
+    return 0 if rc is None else int(rc) if isinstance(rc, int) else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

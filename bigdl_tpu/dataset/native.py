@@ -28,9 +28,42 @@ from bigdl_tpu.dataset.dataset import DataSet, MiniBatch
 
 __all__ = ["available", "NativePrefetchDataSet", "read_idx", "read_cifar10"]
 
-_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
-    os.path.dirname(os.path.abspath(__file__)))), "native")
-_LIB_PATH = os.path.join(_NATIVE_DIR, "libbigdl_native.so")
+# Native sources ship as package data (bigdl_tpu/native/); when the install
+# is read-only (system site-packages) the build happens in a per-user cache
+# dir instead, so `pip install bigdl-tpu` degrades gracefully rather than
+# failing at first import.
+_PKG_NATIVE_DIR = os.path.join(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))), "native")
+
+
+def _build_dir() -> str:
+    if (os.access(_PKG_NATIVE_DIR, os.W_OK)
+            or os.path.exists(os.path.join(_PKG_NATIVE_DIR,
+                                           "libbigdl_native.so"))):
+        # writable (dev checkout / user install) or a wheel shipped a
+        # prebuilt .so — build/load in place
+        return _PKG_NATIVE_DIR
+    cache = os.path.join(
+        os.environ.get("XDG_CACHE_HOME",
+                       os.path.join(os.path.expanduser("~"), ".cache")),
+        "bigdl_tpu", "native")
+    os.makedirs(cache, exist_ok=True)
+    import shutil
+    for fname in ("bigdl_native.cpp", "Makefile"):
+        src = os.path.join(_PKG_NATIVE_DIR, fname)
+        if os.path.exists(src):
+            # unconditional copy: mtime comparison misfires on
+            # SOURCE_DATE_EPOCH wheels / downgrades, leaving a stale cpp
+            # that silently disables the native path after an upgrade
+            shutil.copy2(src, os.path.join(cache, fname))
+    return cache
+
+
+# resolved lazily in _load_impl(): computing the cache dir at import can
+# raise (read-only install + unwritable HOME) and would break the
+# graceful-degrade contract for every `import bigdl_tpu.dataset`
+_NATIVE_DIR: Optional[str] = None
+_LIB_PATH: Optional[str] = None
 
 _lib: Optional[ctypes.CDLL] = None
 _load_attempted = False
@@ -48,6 +81,12 @@ def _load() -> Optional[ctypes.CDLL]:
 
 
 def _load_impl() -> Optional[ctypes.CDLL]:
+    global _NATIVE_DIR, _LIB_PATH
+    try:
+        _NATIVE_DIR = _build_dir()
+    except OSError:
+        return None
+    _LIB_PATH = os.path.join(_NATIVE_DIR, "libbigdl_native.so")
     try:  # always run make: incremental, and rebuilds a stale .so whose
         # symbols predate the current bindings (g++ is in the toolchain).
         # flock serializes concurrent builds across PROCESSES sharing the

@@ -1,0 +1,5 @@
+"""Native C++ host runtime sources (threaded prefetch pipeline, libjpeg
+decode, raw dataset readers) — shipped as package data and built lazily by
+``bigdl_tpu.dataset.native`` at first use. This ``__init__`` exists only so
+setuptools includes the directory as a package (see pyproject.toml
+``[tool.setuptools.package-data]``)."""

@@ -1,0 +1,58 @@
+#!/usr/bin/env bash
+# Round-3 clean re-measurement: the first capture's resnet50 trajectory ran
+# while a pytest process shared the single host core (dispatch-side
+# contention), and the transformer/flash steps hit the lse block-spec
+# lowering bug since fixed in ops/attention_kernel.py. This sweep re-records
+# everything with the host idle. Appends to $OUT (default
+# /tmp/tpu_capture_r04.log), mirrored into the repo per step.
+set -uo pipefail
+cd "$(dirname "$0")/.."
+OUT="${OUT:-/tmp/tpu_capture_r04.log}"
+REPO_LOG="${REPO_LOG:-TPU_CAPTURE_r04.log}"
+trap 'cp -f "$OUT" "$REPO_LOG" 2>/dev/null || true' EXIT
+
+step() {
+  local name="$1" tmo="$2"; shift 2
+  echo "=== $name ($(date -u +%H:%M:%SZ))" | tee -a "$OUT"
+  timeout "$tmo" "$@" 2>&1 | tail -30 | tee -a "$OUT"
+  echo "=== end $name rc=$?" | tee -a "$OUT"
+  cp -f "$OUT" "$REPO_LOG" 2>/dev/null || true
+}
+
+# Ordered by evidentiary value so a short tunnel window still captures
+# the essentials (every step mirrors the log into the repo).
+
+# 1. compiled flash kernel: proves the lse-layout fix lowers on Mosaic
+step "pytest_tpu_marked" 1200 env BIGDL_TPU_TESTS=1 python -m pytest tests/ -m tpu -q
+
+# 2. clean headline number + the transformer datapoints
+step "perf_resnet50_b128" 900 python -m bigdl_tpu.cli.perf -m resnet50 -b 128 -i 20 --dataType random
+step "perf_transformer_lm_b32" 900 python -m bigdl_tpu.cli.perf -m transformer_lm -b 32 -i 10 --dataType random
+step "perf_transformer_lm_1k_b16" 900 python -m bigdl_tpu.cli.perf -m transformer_lm_1k -b 16 -i 10 --dataType random
+
+# 3. flash vs dense microbenchmark (incl. 16k/32k flash-only rows)
+step "flash_bench" 1800 python scripts/flash_bench.py 4 8 64
+
+# 4. lever A/Bs + the rest of the trajectory
+step "perf_resnet50_inner10_b128" 900 python -m bigdl_tpu.cli.perf -m resnet50 -b 128 -i 4 --innerSteps 10 --dataType random
+step "perf_resnet50_bnss_b128" 900 python -m bigdl_tpu.cli.perf -m resnet50_bnss -b 128 -i 20 --dataType random
+step "perf_resnet50_s2d_b128" 900 python -m bigdl_tpu.cli.perf -m resnet50_s2d -b 128 -i 20 --dataType random
+for B in 64 256 512; do
+  step "perf_resnet50_b$B" 900 python -m bigdl_tpu.cli.perf -m resnet50 -b "$B" -i 20 --dataType random
+done
+step "perf_transformer_lm_rope_b32" 900 python -m bigdl_tpu.cli.perf -m transformer_lm_rope -b 32 -i 10 --dataType random
+
+# train-from-storage: first capture's TPU attempt breached the default 900s
+# (JPEG generation shared the core with a pytest run); give it headroom
+step "bench_pipe" 2400 env BENCH_TPU_TIMEOUT=2000 BENCH_COMPANIONS=0 python bench.py resnet50_pipe 128 20
+
+# convergence on the chip (first capture lost it to the tunnel dropping)
+if [ ! -f /tmp/synth_mnist_full/train-images-idx3-ubyte ]; then
+  step "make_synth_mnist" 1200 python scripts/make_synth_mnist.py /tmp/synth_mnist_full 20000 4000
+fi
+step "lenet_convergence" 1800 ./scripts/run_example.sh lenet /tmp/synth_mnist_full -b 128 --maxEpoch 20 --learningRate 0.1
+
+# the official bench line last
+step "bench_main" 2400 python bench.py
+
+echo "capture2 complete -> $OUT"
