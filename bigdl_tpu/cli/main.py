@@ -62,7 +62,9 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 2
     mod = importlib.import_module(f"bigdl_tpu.cli.{_COMMANDS[cmd]}")
     rc = mod.main(rest)
-    return 0 if rc is None else int(rc) if isinstance(rc, int) else 0
+    # subcommand mains return rich values (optimize() results, arrays) —
+    # only a genuine int is an exit code (bool True must not become 1)
+    return rc if isinstance(rc, int) and not isinstance(rc, bool) else 0
 
 
 if __name__ == "__main__":

@@ -52,10 +52,10 @@ def _build_dir() -> str:
     for fname in ("bigdl_native.cpp", "Makefile"):
         src = os.path.join(_PKG_NATIVE_DIR, fname)
         if os.path.exists(src):
-            # unconditional copy: mtime comparison misfires on
-            # SOURCE_DATE_EPOCH wheels / downgrades, leaving a stale cpp
-            # that silently disables the native path after an upgrade
-            shutil.copy2(src, os.path.join(cache, fname))
+            # copyfile (not copy2): the dst must get a FRESH mtime so make
+            # rebuilds the cached .so — preserving a SOURCE_DATE_EPOCH
+            # wheel mtime would leave a stale .so after a package upgrade
+            shutil.copyfile(src, os.path.join(cache, fname))
     return cache
 
 

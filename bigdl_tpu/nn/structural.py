@@ -207,7 +207,12 @@ class Reshape(SimpleModule):
         self.size = tuple(size)
 
     def _forward(self, params, x, *, training, rng):
-        return x.reshape((x.shape[0],) + self.size)
+        # pin batch sharding across the dim-collapse so GSPMD doesn't pick
+        # a spatial layout for the backward's cotangent (parallel/hints.py)
+        from bigdl_tpu.parallel.hints import constrain_batch
+
+        return constrain_batch(
+            constrain_batch(x).reshape((x.shape[0],) + self.size))
 
 
 class View(Reshape):

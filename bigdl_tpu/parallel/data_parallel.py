@@ -148,6 +148,23 @@ class DataParallel:
         explicit shard_map strategies can psum here."""
         return grads, loss
 
+    def _hinted(self, train_step, batch_spec: Optional[P]):
+        """Trace ``train_step`` under the batch-sharding hint so modules at
+        reshape boundaries pin activations (and, via the constraint's
+        transpose, cotangents) to the data axis — kills GSPMD's
+        "Involuntary full rematerialization" on the conv→linear flatten
+        backward. Only for pure dim-0 batch sharding: a composed spec
+        (dp×sp etc.) must not have its seq/model layout clobbered."""
+        if batch_spec is not None:
+            return train_step
+        from bigdl_tpu.parallel.hints import batch_sharding_hint
+
+        def hinted(*args):
+            with batch_sharding_hint(self.mesh, self.axis):
+                return train_step(*args)
+
+        return hinted
+
     def compile_step(self, train_step, batch_spec: Optional[P] = None):
         """``batch_spec`` overrides the x/y input sharding (e.g.
         P('data', 'seq', None) when composing with sequence parallelism)."""
@@ -161,7 +178,8 @@ class DataParallel:
         out_shardings = (self._repl, self._repl, self._opt_shardings,
                          self._repl)
         donate = (0, 1, 2) if self.donate else ()
-        return jax.jit(train_step, in_shardings=in_shardings,
+        return jax.jit(self._hinted(train_step, batch_spec),
+                       in_shardings=in_shardings,
                        out_shardings=out_shardings, donate_argnums=donate)
 
     def compile_eval(self, eval_step):
@@ -231,7 +249,8 @@ class FullyShardedDataParallel(DataParallel):
         out_shardings = (self._param_shardings, self._repl,
                          self._opt_shardings, self._repl)
         donate = (0, 1, 2) if self.donate else ()
-        return jax.jit(train_step, in_shardings=in_shardings,
+        return jax.jit(self._hinted(train_step, batch_spec),
+                       in_shardings=in_shardings,
                        out_shardings=out_shardings, donate_argnums=donate)
 
     def compile_eval(self, eval_step):
