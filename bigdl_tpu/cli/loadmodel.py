@@ -38,8 +38,10 @@ def load_into(model, model_type: str, model_path: str, weights: str | None):
         from bigdl_tpu.interop import load_caffe
         params = load_caffe(model, params, weights, prototxt_path=model_path)
     elif model_type == "torch":
-        from bigdl_tpu.interop import load_torch_params
-        params = load_torch_params(model, params, model_path)
+        # whole-model import: the .t7 carries the graph; the module built
+        # from --modelName is ignored (reference Module.loadTorch flow)
+        raise RuntimeError("torch models are whole-model files; handled "
+                           "in main() before builder construction")
     elif model_type == "bigdl":
         params, mod_state = common.load_trained(model, model_path)
     else:
@@ -50,9 +52,12 @@ def load_into(model, model_type: str, model_path: str, weights: str | None):
 def main(argv=None):
     common.setup_logging()
     p = argparse.ArgumentParser("bigdl-tpu loadmodel")
+    common._add_platform_arg(p)
     p.add_argument("--modelType", required=True,
                    choices=["caffe", "torch", "bigdl"])
-    p.add_argument("--modelName", required=True, choices=sorted(_BUILDERS))
+    p.add_argument("--modelName", default=None, choices=sorted(_BUILDERS),
+                   help="model builder (required for caffe/bigdl; torch "
+                        ".t7 files carry the whole graph and ignore it)")
     p.add_argument("--model", required=True,
                    help="prototxt (caffe) / .t7 (torch) / checkpoint (bigdl)")
     p.add_argument("--weights", default=None, help=".caffemodel (caffe)")
@@ -60,6 +65,10 @@ def main(argv=None):
                    help="val folder: <class>/<imgs>")
     p.add_argument("-b", "--batchSize", type=int, default=32)
     p.add_argument("--classNum", type=int, default=1000)
+    p.add_argument("--imageSize", type=int, default=None,
+                   help="val crop size (default: 227 for alexnet, else "
+                        "224 — whole-model .t7 files need this when the "
+                        "graph was built for another size)")
     args = p.parse_args(argv)
     common.apply_platform(args)
 
@@ -67,11 +76,23 @@ def main(argv=None):
     from bigdl_tpu.dataset.folder import ImageFolderDataSet
     from bigdl_tpu.optim import Top1Accuracy, Top5Accuracy
 
-    model = _BUILDERS[args.modelName](args.classNum)
-    params, mod_state = load_into(model, args.modelType, args.model,
-                                  args.weights)
+    if args.modelType == "torch":
+        # whole-model .t7: reconstruct the graph + weights directly
+        # (reference Module.loadTorch, nn/Module.scala:32)
+        from bigdl_tpu.interop import load_torch_module
+        model, params, mod_state = load_torch_module(args.model)
+    else:
+        if args.modelName is None:
+            raise SystemExit("--modelName is required for "
+                             f"modelType={args.modelType}")
+        model = _BUILDERS[args.modelName](args.classNum)
+        params, mod_state = load_into(model, args.modelType, args.model,
+                                      args.weights)
     # Caffe AlexNet crops to 227; the rest take 224
-    size = (227, 227) if args.modelName == "alexnet" else (224, 224)
+    if args.imageSize is not None:
+        size = (args.imageSize, args.imageSize)
+    else:
+        size = (227, 227) if args.modelName == "alexnet" else (224, 224)
     from bigdl_tpu.dataset.folder import IMAGENET_MEAN, IMAGENET_STD
     val = ImageFolderDataSet(args.folder, args.batchSize, size=size,
                              mean=IMAGENET_MEAN, std=IMAGENET_STD)

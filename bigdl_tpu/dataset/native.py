@@ -48,14 +48,18 @@ def _build_dir() -> str:
                        os.path.join(os.path.expanduser("~"), ".cache")),
         "bigdl_tpu", "native")
     os.makedirs(cache, exist_ok=True)
+    import filecmp
     import shutil
     for fname in ("bigdl_native.cpp", "Makefile"):
         src = os.path.join(_PKG_NATIVE_DIR, fname)
-        if os.path.exists(src):
-            # copyfile (not copy2): the dst must get a FRESH mtime so make
-            # rebuilds the cached .so — preserving a SOURCE_DATE_EPOCH
-            # wheel mtime would leave a stale .so after a package upgrade
-            shutil.copyfile(src, os.path.join(cache, fname))
+        dst = os.path.join(cache, fname)
+        # copy only on content change, with a fresh dst mtime: mtime
+        # comparison alone misfires on SOURCE_DATE_EPOCH wheels (stale .so
+        # after upgrade), while unconditional copying would force a full
+        # g++ rebuild on every process start
+        if os.path.exists(src) and not (
+                os.path.exists(dst) and filecmp.cmp(src, dst, shallow=False)):
+            shutil.copyfile(src, dst)
     return cache
 
 

@@ -1,11 +1,15 @@
 from bigdl_tpu.interop.torchfile import (
     load_t7, save_t7, TorchObject, load_torch_params,
 )
+from bigdl_tpu.interop.torch_import import (
+    load_torch_module, save_torch_module, TorchFlatten,
+)
 from bigdl_tpu.interop.caffe import (
     parse_caffemodel, parse_prototxt, load_caffe,
 )
 
 __all__ = [
     "load_t7", "save_t7", "TorchObject", "load_torch_params",
+    "load_torch_module", "save_torch_module", "TorchFlatten",
     "parse_caffemodel", "parse_prototxt", "load_caffe",
 ]

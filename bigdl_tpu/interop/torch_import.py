@@ -1,0 +1,517 @@
+"""Whole-model Torch ``.t7`` import: construct the module GRAPH, not just
+the params (reference ``Module.loadTorch``, nn/Module.scala:32, backed by
+the ~30-class mapping in utils/TorchFile.scala:136-181 ``readModuleWithType``
+and the per-class readers :911-1000).
+
+``load_torch_module(path)`` returns ``(module, params, state)`` ready for
+``module.apply(params, state, x)`` — the reference's
+``example/loadmodel`` Torch flow (ModelValidator.scala) reproduced.
+
+Layout note (the one real divergence from a 1:1 mapping): Torch runs NCHW;
+this framework runs NHWC (TPU-native — conv kernels are HWIO so the MXU
+sees the channels-minor layout it wants). Weights are transposed at import
+(OIHW→HWIO, (out,in)→(in,out)), and the conv→linear flatten — where the
+element ORDER of the collapse differs between layouts — is imported as
+:class:`TorchFlatten`, which restores torch's CHW order before
+flattening, so the following Linear's rows line up verbatim with the
+torch weights. Concat dimensions are remapped NCHW→NHWC the same way.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import numpy as np
+
+from bigdl_tpu.core.module import Module, SimpleModule
+from bigdl_tpu.interop.torchfile import TorchObject, load_t7
+
+__all__ = ["load_torch_module", "save_torch_module", "TorchFlatten"]
+
+
+class TorchFlatten(SimpleModule):
+    """Flatten imported from a torch ``nn.View``/``nn.Reshape`` that sat on
+    a 4-D NCHW feature map: transpose NHWC back to CHW element order before
+    collapsing, so downstream imported Linear weights match torch
+    bit-for-bit. On non-4-D input it is a plain batch-preserving reshape."""
+
+    def __init__(self, size, name=None):
+        super().__init__(name)
+        self.size = tuple(int(s) for s in size)
+
+    def _forward(self, params, x, *, training, rng):
+        # same batch-sharding pin as nn.Reshape: without it, imported
+        # models reintroduce the GSPMD full-remat cliff (parallel/hints.py)
+        from bigdl_tpu.parallel.hints import constrain_batch
+
+        x = constrain_batch(x)
+        if x.ndim == 4:
+            x = x.transpose(0, 3, 1, 2)  # NHWC -> NCHW order
+        return constrain_batch(x.reshape((x.shape[0],) + self.size))
+
+
+def _cls(obj: TorchObject) -> str:
+    """``nn.SpatialConvolutionMM`` -> ``SpatialConvolutionMM``; cudnn
+    aliases fold into nn (reference TorchFile.scala:139-143)."""
+    name = obj.torch_typename
+    if name.startswith("cudnn."):
+        name = "nn." + name[len("cudnn."):]
+    return name.rsplit(".", 1)[-1]
+
+
+def _int(fields: dict, key: str, default: Optional[int] = None) -> int:
+    v = fields.get(key, default)
+    if v is None:
+        raise ValueError(f"torch module missing field {key!r}")
+    return int(v)
+
+
+def _seq_size(arr) -> Tuple[int, ...]:
+    """A torch ``size`` field is a LongStorage (numpy array) or a number."""
+    if isinstance(arr, np.ndarray):
+        return tuple(int(s) for s in arr.tolist())
+    if isinstance(arr, (list, tuple)):
+        return tuple(int(s) for s in arr)
+    return (int(arr),)
+
+
+def _map_concat_dim(dim: int) -> int:
+    """Torch ``Concat``/``JoinTable`` dimension (1-based, NCHW incl. batch)
+    -> our axis on NHWC. dim 2 (channels) -> -1; dim 1 (batch) -> 0;
+    spatial dims shift left by the channel move."""
+    return {1: 0, 2: -1, 3: 1, 4: 2}.get(dim, dim - 1)
+
+
+# ---------------------------------------------------------------- builders
+# each returns (module, params, state); containers recurse via _import
+
+def _import_children(mods) -> Tuple[list, dict, dict]:
+    built, params, state = [], {}, {}
+    for i, child in enumerate(mods or []):
+        m, p, s = _import(child)
+        built.append(m)
+        params[str(i)] = p
+        state[str(i)] = s
+    return built, params, state
+
+
+def _linear(obj):
+    from bigdl_tpu import nn
+
+    fields = obj.fields
+    w = np.asarray(fields["weight"], np.float32)      # torch (out, in)
+    bias = fields.get("bias")
+    mod = nn.Linear(w.shape[1], w.shape[0], with_bias=bias is not None)
+    p = {"weight": np.ascontiguousarray(w.T)}
+    if bias is not None:
+        p["bias"] = np.asarray(bias, np.float32)
+    return mod, p, {}
+
+
+def _conv(obj):
+    from bigdl_tpu import nn
+
+    fields = obj.fields
+    n_in = _int(fields, "nInputPlane")
+    n_out = _int(fields, "nOutputPlane")
+    kw, kh = _int(fields, "kW"), _int(fields, "kH")
+    mod = nn.SpatialConvolution(
+        n_in, n_out, kw, kh,
+        stride_w=_int(fields, "dW", 1), stride_h=_int(fields, "dH", 1),
+        pad_w=_int(fields, "padW", 0), pad_h=_int(fields, "padH", 0),
+        with_bias=fields.get("bias") is not None)
+    w = np.asarray(fields["weight"], np.float32)
+    # SpatialConvolutionMM stores (out, in*kH*kW); plain stores OIHW
+    w = w.reshape(n_out, n_in, kh, kw)
+    p = {"weight": np.transpose(w, (2, 3, 1, 0)).copy()}  # OIHW -> HWIO
+    if fields.get("bias") is not None:
+        p["bias"] = np.asarray(fields["bias"], np.float32)
+    return mod, p, {}
+
+
+def _maxpool(obj):
+    from bigdl_tpu import nn
+
+    f = obj.fields
+    mod = nn.SpatialMaxPooling(
+        _int(f, "kW"), _int(f, "kH"),
+        _int(f, "dW", _int(f, "kW")), _int(f, "dH", _int(f, "kH")),
+        pad_w=_int(f, "padW", 0), pad_h=_int(f, "padH", 0),
+        ceil_mode=bool(f.get("ceil_mode", False)))
+    return mod, {}, {}
+
+
+def _avgpool(obj):
+    from bigdl_tpu import nn
+
+    f = obj.fields
+    mod = nn.SpatialAveragePooling(
+        _int(f, "kW"), _int(f, "kH"),
+        _int(f, "dW", _int(f, "kW")), _int(f, "dH", _int(f, "kH")),
+        pad_w=_int(f, "padW", 0), pad_h=_int(f, "padH", 0),
+        ceil_mode=bool(f.get("ceil_mode", False)),
+        count_include_pad=bool(f.get("count_include_pad", True)))
+    return mod, {}, {}
+
+
+def _batchnorm(obj, spatial: bool):
+    from bigdl_tpu import nn
+
+    f = obj.fields
+    running_mean = np.asarray(f["running_mean"], np.float32)
+    affine = f.get("weight") is not None
+    cls = nn.SpatialBatchNormalization if spatial else nn.BatchNormalization
+    mod = cls(running_mean.shape[0],
+              eps=float(f.get("eps", 1e-5)),
+              momentum=float(f.get("momentum", 0.1)),
+              affine=affine)
+    p = {}
+    if affine:
+        p = {"weight": np.asarray(f["weight"], np.float32),
+             "bias": np.asarray(f["bias"], np.float32)}
+    s = {"running_mean": running_mean,
+         "running_var": np.asarray(f["running_var"], np.float32)}
+    return mod, p, s
+
+
+def _sequential(obj):
+    from bigdl_tpu.core import Sequential
+
+    built, params, state = _import_children(obj.fields.get("modules"))
+    return Sequential(*built), params, state
+
+
+def _concat(obj):
+    from bigdl_tpu import nn
+
+    built, params, state = _import_children(obj.fields.get("modules"))
+    axis = _map_concat_dim(_int(obj.fields, "dimension", 2))
+    return nn.Concat(*built, axis=axis), params, state
+
+
+def _concat_table(obj):
+    from bigdl_tpu import nn
+
+    built, params, state = _import_children(obj.fields.get("modules"))
+    return nn.ConcatTable(*built), params, state
+
+
+def _view(obj):
+    f = obj.fields
+    size = _seq_size(f.get("size", f.get("numElements")))
+    return TorchFlatten(size), {}, {}
+
+
+def _dropout(obj):
+    from bigdl_tpu import nn
+
+    return nn.Dropout(float(obj.fields.get("p", 0.5))), {}, {}
+
+
+def _threshold(obj):
+    from bigdl_tpu import nn
+
+    f = obj.fields
+    return nn.Threshold(float(f.get("threshold", 1e-6)),
+                        float(f.get("val", 0.0))), {}, {}
+
+
+def _zero_padding(obj):
+    from bigdl_tpu import nn
+
+    f = obj.fields
+    return nn.SpatialZeroPadding(
+        _int(f, "pad_l", 0), _int(f, "pad_r", 0),
+        _int(f, "pad_t", 0), _int(f, "pad_b", 0)), {}, {}
+
+
+def _cadd_table(obj):
+    from bigdl_tpu import nn
+
+    return nn.CAddTable(), {}, {}
+
+
+_BUILDERS = {
+    "Linear": _linear,
+    "SpatialConvolution": _conv,
+    "SpatialConvolutionMM": _conv,
+    "SpatialMaxPooling": _maxpool,
+    "SpatialAveragePooling": _avgpool,
+    "BatchNormalization": lambda o: _batchnorm(o, spatial=False),
+    "SpatialBatchNormalization": lambda o: _batchnorm(o, spatial=True),
+    "Sequential": _sequential,
+    "Concat": _concat,
+    "ConcatTable": _concat_table,
+    "CAddTable": _cadd_table,
+    "View": _view,
+    "Reshape": _view,
+    "Dropout": _dropout,
+    "Threshold": _threshold,
+    "SpatialZeroPadding": _zero_padding,
+}
+
+# parameter-free classes resolved by name on bigdl_tpu.nn (the analog of
+# the reference's createInstanceFor reflection fallback,
+# TorchFile.scala:163-178)
+_PARAM_FREE = {
+    "ReLU", "Tanh", "Sigmoid", "LogSoftMax", "SoftMax", "Identity",
+    "SoftPlus", "SoftSign", "ELU", "Abs", "Square", "Sqrt", "HardTanh",
+    "LeakyReLU", "ReLU6", "SoftMin", "Exp", "Log",
+}
+
+
+def _import(obj: Any) -> Tuple[Module, Any, Any]:
+    if not isinstance(obj, TorchObject):
+        raise ValueError(f"expected a torch nn module, got {type(obj)}")
+    cls = _cls(obj)
+    builder = _BUILDERS.get(cls)
+    if builder is not None:
+        return builder(obj)
+    if cls in _PARAM_FREE:
+        from bigdl_tpu import nn
+
+        return getattr(nn, cls)(), {}, {}
+    # last resort, mirrors the reference's reflection: a same-named
+    # parameter-free class on bigdl_tpu.nn (only safe when the torch
+    # object carries no weights we would silently drop)
+    from bigdl_tpu import nn
+
+    target = getattr(nn, cls, None)
+    has_params = (isinstance(obj.fields, dict)
+                  and any(isinstance(obj.fields.get(k), np.ndarray)
+                          for k in ("weight", "bias")))
+    if target is not None and not has_params:
+        try:
+            return target(), {}, {}
+        except TypeError:
+            pass
+    raise ValueError(f"unsupported torch module nn.{cls} "
+                     f"(reference parity set: TorchFile.scala:136-181)")
+
+
+def load_torch_module(path_or_obj) -> Tuple[Module, Any, Any]:
+    """Reconstruct ``(module, params, state)`` from a ``.t7`` file or an
+    already-parsed :class:`TorchObject` tree (reference Module.loadTorch,
+    nn/Module.scala:32)."""
+    import jax
+    import jax.numpy as jnp
+
+    obj = (load_t7(path_or_obj) if isinstance(path_or_obj, str)
+           else path_or_obj)
+    mod, params, state = _import(obj)
+    to_dev = lambda t: jax.tree_util.tree_map(jnp.asarray, t)
+    return mod, to_dev(params), to_dev(state)
+
+
+# ---------------------------------------------------------------- export
+# Inverse mapping: a repo module tree -> the TorchObject tree the reference
+# reader (TorchFile.scala:136-181) and this file's importer both accept.
+# Field spellings follow the reference readers exactly: ReLU requires
+# "inplace", pooling requires "ceil_mode", Linear requires "bias", View
+# requires "numElements" (all checked against the reference source).
+
+def _perm_chw(h: int, w: int, c: int) -> np.ndarray:
+    """perm[t] = HWC-flat index of the element at CHW-flat position t, so
+    ``torch_rows = my_rows[perm]`` reorders a flattened feature dim from
+    this framework's NHWC collapse to torch's NCHW collapse."""
+    return np.arange(h * w * c).reshape(h, w, c).transpose(2, 0, 1).ravel()
+
+
+def _np(t) -> np.ndarray:
+    return np.asarray(t, np.float32)
+
+
+class _ExportCtx:
+    """Threads (a) the activation shape through Sequential chains so the
+    conv->linear flatten can compute its row permutation, and (b) that
+    pending permutation until the next Linear consumes it."""
+
+    def __init__(self, example_input=None):
+        self.aval = None
+        if example_input is not None:
+            import jax
+
+            self.aval = jax.eval_shape(lambda x: x, example_input)
+        self.perm: Optional[np.ndarray] = None
+
+    def advance(self, mod, p, s):
+        if self.aval is None:
+            return
+        import jax
+
+        try:
+            self.aval = jax.eval_shape(
+                lambda x: mod.apply(p, s, x, training=False)[0], self.aval)
+        except Exception:
+            self.aval = None  # shape tracking ends at exotic modules
+
+
+_PASS_THROUGH = {  # elementwise: a pending flatten-perm flows through
+    "ReLU", "Tanh", "Sigmoid", "Threshold", "Dropout", "LogSoftMax",
+    "SoftMax", "Identity", "ELU", "LeakyReLU", "ReLU6", "Abs",
+}
+
+
+def _obj(cls: str, fields: dict) -> TorchObject:
+    fields.setdefault("_type", "torch.FloatTensor")
+    fields.setdefault("train", False)
+    return TorchObject(f"nn.{cls}", fields)
+
+
+def _export(mod, p, s, ctx: _ExportCtx) -> TorchObject:
+    from bigdl_tpu import nn
+    from bigdl_tpu.core import Sequential as CoreSequential
+
+    name = type(mod).__name__
+    in_aval = ctx.aval
+
+    if isinstance(mod, CoreSequential):
+        children = []
+        for i, ch in enumerate(mod.children()):
+            k = str(i)
+            children.append(_export(ch, p.get(k, {}), s.get(k, {}), ctx))
+        return _obj("Sequential", {"modules": children})
+
+    if isinstance(mod, nn.Concat):
+        children = []
+        for i, ch in enumerate(mod.children()):
+            k = str(i)
+            branch = _ExportCtx()
+            branch.aval, branch.perm = in_aval, None
+            children.append(_export(ch, p.get(k, {}), s.get(k, {}), branch))
+        ctx.advance(mod, p, s)
+        axis = mod.axis
+        dim = {0: 1, -1: 2, 3: 2, 1: 3, 2: 4}.get(axis)
+        if dim is None:
+            raise ValueError(f"cannot map Concat axis {axis} to torch")
+        return _obj("Concat", {"modules": children,
+                               "dimension": float(dim)})
+
+    if isinstance(mod, nn.ConcatTable):
+        children = []
+        for i, ch in enumerate(mod.children()):
+            k = str(i)
+            branch = _ExportCtx()
+            branch.aval, branch.perm = in_aval, None
+            children.append(_export(ch, p.get(k, {}), s.get(k, {}), branch))
+        ctx.aval = None
+        return _obj("ConcatTable", {"modules": children})
+
+    if isinstance(mod, nn.CAddTable):
+        ctx.advance(mod, p, s)
+        return _obj("CAddTable", {"inplace": False})
+
+    if isinstance(mod, nn.Linear):
+        w = _np(p["weight"])                       # ours: (in, out)
+        if ctx.perm is not None:
+            if ctx.perm.shape[0] != w.shape[0]:
+                raise ValueError(
+                    "flatten permutation does not match Linear fan-in "
+                    f"({ctx.perm.shape[0]} vs {w.shape[0]})")
+            w = w[ctx.perm]
+            ctx.perm = None
+        bias = (_np(p["bias"]) if "bias" in p
+                else np.zeros((w.shape[1],), np.float32))
+        ctx.advance(mod, p, s)
+        return _obj("Linear", {"weight": np.ascontiguousarray(w.T),
+                               "bias": bias})
+
+    if isinstance(mod, nn.SpatialConvolution):
+        w = _np(p["weight"])                       # HWIO
+        kh, kw, cin_g, cout = w.shape
+        oihw = np.transpose(w, (3, 2, 0, 1))
+        bias = (_np(p["bias"]) if "bias" in p
+                else np.zeros((cout,), np.float32))
+        ctx.advance(mod, p, s)
+        return _obj("SpatialConvolutionMM", {
+            "nInputPlane": float(mod.n_input_plane),
+            "nOutputPlane": float(mod.n_output_plane),
+            "kW": float(mod.kernel_w), "kH": float(mod.kernel_h),
+            "dW": float(mod.stride_w), "dH": float(mod.stride_h),
+            "padW": float(mod.pad_w), "padH": float(mod.pad_h),
+            "weight": np.ascontiguousarray(
+                oihw.reshape(cout, cin_g * kh * kw)),
+            "bias": bias,
+        })
+
+    if isinstance(mod, nn.SpatialMaxPooling) or \
+            isinstance(mod, nn.SpatialAveragePooling):
+        ctx.advance(mod, p, s)
+        fields = {
+            "kW": float(mod.kernel_w), "kH": float(mod.kernel_h),
+            "dW": float(mod.stride_w), "dH": float(mod.stride_h),
+            "padW": float(mod.pad_w), "padH": float(mod.pad_h),
+            "ceil_mode": bool(mod.ceil_mode),
+        }
+        if isinstance(mod, nn.SpatialAveragePooling):
+            fields["count_include_pad"] = bool(mod.count_include_pad)
+            return _obj("SpatialAveragePooling", fields)
+        return _obj("SpatialMaxPooling", fields)
+
+    if isinstance(mod, nn.BatchNormalization):
+        cls = ("SpatialBatchNormalization"
+               if isinstance(mod, nn.SpatialBatchNormalization)
+               else "BatchNormalization")
+        fields = {
+            "eps": float(mod.eps), "momentum": float(mod.momentum),
+            "affine": bool(mod.affine),
+            "running_mean": _np(s["running_mean"]),
+            "running_var": _np(s["running_var"]),
+        }
+        if mod.affine:
+            fields["weight"] = _np(p["weight"])
+            fields["bias"] = _np(p["bias"])
+        ctx.advance(mod, p, s)
+        return _obj(cls, fields)
+
+    if isinstance(mod, TorchFlatten):
+        size = np.asarray(mod.size, np.int64)
+        ctx.advance(mod, p, s)
+        return _obj("View", {"size": size,
+                             "numElements": float(int(np.prod(size)))})
+
+    if isinstance(mod, nn.Reshape):             # includes nn.View alias
+        size = np.asarray(mod.size, np.int64)
+        if in_aval is not None and len(in_aval.shape) == 4:
+            # our flatten collapses HWC; torch consumers expect CHW order
+            # -> permute the next Linear's rows (consumed above)
+            b, h, w_, c = in_aval.shape
+            ctx.perm = _perm_chw(h, w_, c)
+        ctx.advance(mod, p, s)
+        return _obj("View", {"size": size,
+                             "numElements": float(int(np.prod(size)))})
+
+    if isinstance(mod, nn.Threshold) and not isinstance(mod, nn.ReLU):
+        ctx.advance(mod, p, s)
+        return _obj("Threshold", {"threshold": float(mod.th),
+                                  "val": float(mod.v), "inplace": False})
+
+    if isinstance(mod, nn.Dropout):
+        ctx.advance(mod, p, s)
+        return _obj("Dropout", {"p": float(mod.p), "inplace": False})
+
+    if name in _PASS_THROUGH:
+        ctx.advance(mod, p, s)
+        fields = {"inplace": False} if name == "ReLU" else {}
+        return _obj(name, fields)
+
+    raise ValueError(
+        f"cannot export {name} to .t7 (reference writeModule parity set: "
+        "TorchFile.scala:258-295)")
+
+
+def save_torch_module(module, params, state, path: str,
+                      example_input=None) -> None:
+    """Write a repo module tree as a Torch7 ``.t7`` model file (reference
+    ``Module.saveTorch`` / TorchFile.writeModule, TorchFile.scala:258-295).
+
+    ``example_input`` (an array or ShapeDtypeStruct) enables shape tracking
+    through Sequential chains; it is required for exact export of models
+    with a conv->linear flatten, where torch's NCHW collapse order differs
+    from this framework's NHWC one and the following Linear's rows must be
+    permuted (see :func:`_perm_chw`)."""
+    from bigdl_tpu.interop.torchfile import save_t7
+
+    ctx = _ExportCtx(example_input)
+    obj = _export(module, params, state, ctx)
+    save_t7(path, obj)

@@ -1,0 +1,188 @@
+"""Whole-model .t7 import/export (reference Module.loadTorch,
+nn/Module.scala:32; class mapping utils/TorchFile.scala:136-181; writer
+:258-295). The layout oracle uses real pytorch in NCHW to prove the
+NHWC↔NCHW weight/flatten conversions are exact."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu import nn
+from bigdl_tpu.core import Sequential
+from bigdl_tpu.interop import (TorchObject, load_torch_module,
+                               save_torch_module, save_t7)
+from bigdl_tpu.interop.torch_import import TorchFlatten
+
+torch = pytest.importorskip("torch")
+
+
+def _t7_obj(cls, **fields):
+    fields.setdefault("_type", "torch.FloatTensor")
+    return TorchObject(f"nn.{cls}", fields)
+
+
+def _lua_lenet_obj(rs):
+    """Hand-build the TorchObject tree a Lua-torch LeNet .t7 parses to:
+    NCHW semantics, (out,in) linears, MM conv weights."""
+    conv_w = rs.randn(8, 1, 5, 5).astype(np.float32)      # OIHW
+    conv_b = rs.randn(8).astype(np.float32)
+    fc_w = rs.randn(10, 8 * 4 * 4).astype(np.float32)     # (out, in) CHW
+    fc_b = rs.randn(10).astype(np.float32)
+    return _t7_obj(
+        "Sequential",
+        modules=[
+            _t7_obj("SpatialConvolutionMM",
+                    nInputPlane=1.0, nOutputPlane=8.0, kW=5.0, kH=5.0,
+                    dW=1.0, dH=1.0, padW=2.0, padH=2.0,
+                    weight=conv_w.reshape(8, 25), bias=conv_b),
+            _t7_obj("ReLU", inplace=False),
+            _t7_obj("SpatialMaxPooling", kW=2.0, kH=2.0, dW=2.0, dH=2.0,
+                    padW=0.0, padH=0.0, ceil_mode=False),
+            _t7_obj("View", size=np.asarray([8 * 4 * 4], np.int64),
+                    numElements=float(8 * 4 * 4)),
+            _t7_obj("Linear", weight=fc_w, bias=fc_b),
+            _t7_obj("LogSoftMax"),
+        ]), (conv_w, conv_b, fc_w, fc_b)
+
+
+def _torch_forward_nchw(x_nchw, conv_w, conv_b, fc_w, fc_b):
+    """The Lua model's semantics, executed by pytorch in NCHW."""
+    t = torch.from_numpy(x_nchw)
+    t = torch.nn.functional.conv2d(t, torch.from_numpy(conv_w),
+                                   torch.from_numpy(conv_b), padding=2)
+    t = torch.relu(t)
+    t = torch.nn.functional.max_pool2d(t, 2, 2)
+    t = t.reshape(t.shape[0], -1)
+    t = t @ torch.from_numpy(fc_w).T + torch.from_numpy(fc_b)
+    return torch.log_softmax(t, dim=-1).numpy()
+
+
+def test_import_constructs_graph_and_matches_torch_oracle(tmp_path):
+    """A .t7 LeNet round-trips through the wire format, reconstructs the
+    module graph, and its NHWC forward equals pytorch's NCHW forward."""
+    rs = np.random.RandomState(0)
+    obj, (conv_w, conv_b, fc_w, fc_b) = _lua_lenet_obj(rs)
+    path = str(tmp_path / "lenet.t7")
+    save_t7(path, obj)
+
+    model, params, state = load_torch_module(path)
+    assert isinstance(model, Sequential)
+    kinds = [type(m).__name__ for m in model.children()]
+    assert kinds == ["SpatialConvolution", "ReLU", "SpatialMaxPooling",
+                     "TorchFlatten", "Linear", "LogSoftMax"]
+
+    x_nchw = rs.randn(4, 1, 8, 8).astype(np.float32)
+    want = _torch_forward_nchw(x_nchw, conv_w, conv_b, fc_w, fc_b)
+    got, _ = model.apply(params, state,
+                         jnp.asarray(np.transpose(x_nchw, (0, 2, 3, 1))))
+    # logits reach ~2e2 here, so float32 rounding alone is ~3e-5
+    np.testing.assert_allclose(np.asarray(got), want, atol=5e-4)
+
+
+def test_batchnorm_import_params_and_state(tmp_path):
+    rs = np.random.RandomState(1)
+    obj = _t7_obj(
+        "Sequential",
+        modules=[_t7_obj("SpatialBatchNormalization",
+                         weight=rs.rand(6).astype(np.float32) + 0.5,
+                         bias=rs.randn(6).astype(np.float32),
+                         running_mean=rs.randn(6).astype(np.float32),
+                         running_var=rs.rand(6).astype(np.float32) + 0.5,
+                         eps=1e-5, momentum=0.1)])
+    path = str(tmp_path / "bn.t7")
+    save_t7(path, obj)
+    model, params, state = load_torch_module(path)
+    bn = list(model.children())[0]
+    assert isinstance(bn, nn.SpatialBatchNormalization)
+
+    x_nchw = rs.randn(3, 6, 5, 5).astype(np.float32)
+    t = torch.nn.functional.batch_norm(
+        torch.from_numpy(x_nchw),
+        torch.from_numpy(np.asarray(state["0"]["running_mean"])),
+        torch.from_numpy(np.asarray(state["0"]["running_var"])),
+        torch.from_numpy(np.asarray(params["0"]["weight"])),
+        torch.from_numpy(np.asarray(params["0"]["bias"])),
+        training=False, eps=1e-5).numpy()
+    got, _ = model.apply(params, state,
+                         jnp.asarray(np.transpose(x_nchw, (0, 2, 3, 1))),
+                         training=False)
+    np.testing.assert_allclose(np.asarray(got),
+                               np.transpose(t, (0, 2, 3, 1)), atol=1e-5)
+
+
+def test_concat_dimension_maps_to_channels():
+    obj = _t7_obj(
+        "Concat", dimension=2.0,
+        modules=[_t7_obj("ReLU", inplace=False),
+                 _t7_obj("Tanh")])
+    model, params, state = load_torch_module(obj)
+    x = jnp.asarray(np.random.RandomState(2).randn(2, 3, 3, 4),
+                    jnp.float32)
+    y, _ = model.apply(params, state, x)
+    assert y.shape == (2, 3, 3, 8)  # channel concat on NHWC
+
+
+def test_export_roundtrip_identical_outputs(tmp_path):
+    """save_torch_module of a repo conv net -> load_torch_module -> same
+    outputs (VERDICT r3 item 5's done-condition). The flatten swaps
+    nn.Reshape for TorchFlatten, so the export must permute the Linear
+    rows to keep outputs identical."""
+    model = Sequential(
+        nn.SpatialConvolution(3, 8, 3, 3, pad_w=1, pad_h=1),
+        nn.ReLU(),
+        nn.SpatialMaxPooling(2, 2, 2, 2),
+        nn.Reshape([8 * 4 * 4]),
+        nn.Linear(8 * 4 * 4, 16),
+        nn.Tanh(),
+        nn.Linear(16, 10),
+        nn.LogSoftMax(),
+    )
+    params = model.init(jax.random.PRNGKey(0))
+    state = model.init_state()
+    x = jnp.asarray(np.random.RandomState(3).randn(2, 8, 8, 3), jnp.float32)
+
+    path = str(tmp_path / "model.t7")
+    save_torch_module(model, params, state, path, example_input=x)
+    model2, params2, state2 = load_torch_module(path)
+
+    y1, _ = model.apply(params, state, x)
+    y2, _ = model2.apply(params2, state2, x)
+    np.testing.assert_allclose(np.asarray(y1), np.asarray(y2), atol=1e-5)
+
+
+def test_export_roundtrip_bn_and_concat(tmp_path):
+    model = Sequential(
+        nn.SpatialConvolution(3, 4, 3, 3, pad_w=1, pad_h=1),
+        nn.SpatialBatchNormalization(4),
+        nn.Concat(nn.ReLU(), nn.Tanh(), axis=-1),
+        nn.SpatialAveragePooling(2, 2, 2, 2),
+    )
+    params = model.init(jax.random.PRNGKey(1))
+    state = model.init_state()
+    # non-trivial running stats so eval-mode BN actually checks them
+    state["1"]["running_mean"] = jnp.asarray(
+        np.random.RandomState(4).randn(4), jnp.float32)
+    x = jnp.asarray(np.random.RandomState(5).randn(2, 6, 6, 3), jnp.float32)
+
+    path = str(tmp_path / "bnc.t7")
+    save_torch_module(model, params, state, path, example_input=x)
+    model2, params2, state2 = load_torch_module(path)
+    y1, _ = model.apply(params, state, x, training=False)
+    y2, _ = model2.apply(params2, state2, x, training=False)
+    np.testing.assert_allclose(np.asarray(y1), np.asarray(y2), atol=1e-5)
+
+
+def test_import_rejects_unknown_parameterized_module():
+    obj = _t7_obj("FancyCustomLayer",
+                  weight=np.zeros((3, 3), np.float32))
+    with pytest.raises(ValueError, match="unsupported torch module"):
+        load_torch_module(obj)
+
+
+def test_torchflatten_on_2d_is_plain_reshape():
+    m = TorchFlatten([6])
+    y = m.apply({}, {}, jnp.arange(12.0).reshape(2, 6))[0]
+    np.testing.assert_allclose(np.asarray(y),
+                               np.arange(12.0).reshape(2, 6))
