@@ -10,6 +10,7 @@ Spark DataFrame becomes a plain file stream through
 from __future__ import annotations
 
 import argparse
+import logging
 import os
 
 from bigdl_tpu.cli import common
@@ -18,7 +19,11 @@ from bigdl_tpu.cli import common
 def main(argv=None):
     common.setup_logging()
     p = argparse.ArgumentParser("bigdl-tpu predict")
-    p.add_argument("--model", required=True, help="checkpoint dir or file")
+    p.add_argument("--model", required=True,
+                   help="checkpoint dir or file. Whole-model files embed "
+                        "their definition as a pickle — only load files "
+                        "you produced (same trust model as the "
+                        "reference's Java deserialization)")
     p.add_argument("--modelName", default="lenet",
                    choices=["lenet", "alexnet", "inception_v1", "resnet50",
                             "vgg16"])
@@ -48,7 +53,16 @@ def main(argv=None):
             model, params, mod_state = load_module(args.model)
             side = args.imageSize or 224
             size = (side, side)
-        except Exception:
+        except KeyError:
+            # no __module__ marker: a weights-only file — rebuild from
+            # --modelName below
+            model = None
+        except Exception as e:
+            # a corrupt/incompatible whole-model file would otherwise
+            # surface as a confusing pytree mismatch far from here
+            logging.getLogger("bigdl_tpu").warning(
+                "load_module(%s) failed (%s: %s); falling back to "
+                "--modelName rebuild", args.model, type(e).__name__, e)
             model = None
     if model is None:
         if args.modelName == "lenet":

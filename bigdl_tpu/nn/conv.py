@@ -148,8 +148,9 @@ class SpatialFullConvolution(SimpleModule):
         self.n_group = n_group
         self.with_bias = with_bias
         self.param_dtype = param_dtype
-        if init not in ("default", "bilinear"):
-            raise ValueError(f"init {init!r} not in ('default','bilinear')")
+        if init not in ("default", "bilinear", "bilinear_upsample"):
+            raise ValueError(f"init {init!r} not in "
+                             "('default','bilinear','bilinear_upsample')")
         self.init_method = init
 
     def init(self, rng):
@@ -157,12 +158,14 @@ class SpatialFullConvolution(SimpleModule):
         fan_in = self.kernel_w * self.kernel_h * (self.n_output_plane // self.n_group)
         shape = (self.kernel_h, self.kernel_w,
                  self.n_input_plane // self.n_group, self.n_output_plane)
-        if self.init_method == "bilinear":
-            # BilinearFiller (reference SpatialFullConvolution.scala:121 +
-            # InitializationMethod.scala:48): the deconv starts as exact
-            # bilinear upsampling — FCN-style segmentation heads. Each
-            # input channel maps to the matching output channel with the
-            # separable triangle kernel; cross-channel taps start at 0.
+        if self.init_method.startswith("bilinear"):
+            # "bilinear": BilinearFiller parity (reference
+            # SpatialFullConvolution.scala:121-135) — EVERY (in,out)
+            # channel pair gets the separable triangle kernel, bias zeroed.
+            # "bilinear_upsample": the Caffe/FCN diagonal variant — only
+            # matching channels filled, so the deconv starts as exact
+            # bilinear upsampling (what segmentation heads actually want;
+            # identical to "bilinear" when n_in == n_out == 1).
             f_h = (self.kernel_h + 1) // 2
             c_h = (2 * f_h - 1 - f_h % 2) / (2.0 * f_h)
             wh = 1 - np.abs(np.arange(self.kernel_h) / f_h - c_h)
@@ -170,17 +173,20 @@ class SpatialFullConvolution(SimpleModule):
             c_w = (2 * f_w - 1 - f_w % 2) / (2.0 * f_w)
             ww = 1 - np.abs(np.arange(self.kernel_w) / f_w - c_w)
             tri = wh[:, None] * ww[None, :]
-            w = np.zeros(shape, np.float64)
             cin = self.n_input_plane // self.n_group
-            for i in range(min(cin, self.n_output_plane)):
-                w[:, :, i, i] = tri
+            if self.init_method == "bilinear":
+                w = np.broadcast_to(tri[:, :, None, None], shape).copy()
+            else:
+                w = np.zeros(shape, np.float64)
+                for i in range(min(cin, self.n_output_plane)):
+                    w[:, :, i, i] = tri
             p = {"weight": jnp.asarray(w, self.param_dtype)}
         else:
             p = {"weight": uniform_fan_in(k_w, shape, fan_in,
                                           self.param_dtype)}
         if self.with_bias:
             p["bias"] = (jnp.zeros((self.n_output_plane,), self.param_dtype)
-                         if self.init_method == "bilinear" else
+                         if self.init_method.startswith("bilinear") else
                          uniform_fan_in(k_b, (self.n_output_plane,), fan_in,
                                         self.param_dtype))
         return p

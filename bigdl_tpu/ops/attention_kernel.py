@@ -177,7 +177,12 @@ def blockwise_attention(q, k, v, *, causal: bool = False,
     l0 = jnp.zeros(q.shape[:-1] + (1,), jnp.float32)
     a0 = jnp.zeros(q.shape, jnp.float32)
     (_, l, acc, _), _ = jax.lax.scan(body, (m0, l0, a0, 0), scan_in)
-    return (acc / jnp.maximum(l, 1e-30)).astype(q.dtype)
+    out = (acc / jnp.maximum(l, 1e-30)).astype(q.dtype)
+    if segments is not None:
+        # id-0 padding rows stayed live in the scan (finite backward);
+        # zero them so this path agrees with the dense fallback above
+        out = jnp.where((segments != 0)[:, None, :, None], out, 0)
+    return out
 
 
 def _block_valid(causal, q_ids, k_ids, bq, j, kk, block_q, block_k,
@@ -690,7 +695,12 @@ def flash_attention(q, k, v, *, causal: bool = False,
             return _dense.dot_product_attention(
                 q, k, v, causal=causal,
                 mask=_dense.make_segment_mask(segments))
-        return _flash_seg(q, k, v, segments, causal, block_q, block_k)
+        out = _flash_seg(q, k, v, segments, causal, block_q, block_k)
+        # in-kernel, id-0 padding rows attend id-0 keys (keeps softmax
+        # rows live for a finite backward); the dense fallback above
+        # fully masks them to 0 instead. Zero them here so the same call
+        # returns the same values regardless of shape-driven path choice.
+        return jnp.where((segments != 0)[:, None, :, None], out, 0)
     if mask is not None:
         if _as_key_padding(mask, q.shape[0], k.shape[-2]) is not None:
             return blockwise_attention(q, k, v, causal=causal, mask=mask,

@@ -147,7 +147,7 @@ def test_full_conv_bilinear_filler_upsamples():
     from bigdl_tpu.nn import SpatialFullConvolution
 
     m = SpatialFullConvolution(1, 1, 4, 4, 2, 2, 1, 1, with_bias=False,
-                               init="bilinear")
+                               init="bilinear_upsample")
     p = m.init(jax.random.PRNGKey(0))
 
     ones = jnp.ones((1, 5, 5, 1), jnp.float32)
@@ -163,3 +163,28 @@ def test_full_conv_bilinear_filler_upsamples():
     # interiors agree exactly; borders differ by the padding convention
     np.testing.assert_allclose(got[2:-2, 2:-2], want[2:-2, 2:-2],
                                atol=1e-5)
+
+
+def test_bilinear_filler_reference_vs_upsample_variants():
+    """init="bilinear" matches the reference BilinearFiller exactly
+    (SpatialFullConvolution.scala:121-135: EVERY channel pair filled with
+    the triangle kernel); init="bilinear_upsample" is the diagonal FCN
+    variant (cross-channel taps zero). They agree at 1->1 channels."""
+    from bigdl_tpu.nn import SpatialFullConvolution
+
+    ref = SpatialFullConvolution(3, 2, 4, 4, 2, 2, 1, 1, init="bilinear")
+    w = np.asarray(ref.init(jax.random.PRNGKey(0))["weight"])
+    # reference formula, computed independently per element
+    f = int(np.ceil(4 / 2.0))
+    c = (2 * f - 1 - f % 2) / (2.0 * f)
+    tri = np.array([[(1 - abs(x / f - c)) * (1 - abs(y / f - c))
+                     for x in range(4)] for y in range(4)], np.float32)
+    for i in range(3):
+        for o in range(2):
+            np.testing.assert_allclose(w[:, :, i, o], tri, atol=1e-6)
+
+    up = SpatialFullConvolution(3, 2, 4, 4, 2, 2, 1, 1,
+                                init="bilinear_upsample")
+    wu = np.asarray(up.init(jax.random.PRNGKey(0))["weight"])
+    np.testing.assert_allclose(wu[:, :, 0, 0], tri, atol=1e-6)
+    assert np.all(wu[:, :, 0, 1] == 0)  # cross-channel taps zeroed

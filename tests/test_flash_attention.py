@@ -470,3 +470,52 @@ def test_block_specs_satisfy_mosaic_tiling():
         a0, a1 = ashape[-2], ashape[-1]
         assert b1 == a1 or b1 % 128 == 0, (bs, ashape)
         assert b0 == a0 or b0 % 8 == 0, (bs, ashape)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_segment_padding_rows_agree_across_paths(causal):
+    """ADVICE r3: the same flash_attention(..., segments=...) call used to
+    return different values at id-0 padding positions depending on
+    shape-driven path selection (in-kernel: live self-attending rows;
+    dense fallback: zeroed rows). All paths must now return ZERO there."""
+    from bigdl_tpu.nn.attention import (dot_product_attention,
+                                        make_segment_mask)
+    from bigdl_tpu.ops import blockwise_attention
+
+    rs = np.random.RandomState(7)
+    b, h, s, d = 2, 2, 128, 16
+    q = jnp.asarray(rs.randn(b, h, s, d), jnp.float32)
+    k = jnp.asarray(rs.randn(b, h, s, d), jnp.float32)
+    v = jnp.asarray(rs.randn(b, h, s, d), jnp.float32)
+    segs = np.zeros((b, s), np.int32)
+    segs[0, :100] = 1
+    segs[1, :64] = 1
+    segs[1, 64:90] = 2
+    segs = jnp.asarray(segs)
+    pad = np.asarray(segs) == 0
+
+    kernel = np.asarray(flash_attention(q, k, v, causal=causal,
+                                        segments=segs, block_k=128))
+    dense = np.asarray(dot_product_attention(
+        q, k, v, causal=causal, mask=make_segment_mask(segs)))
+    blockwise = np.asarray(blockwise_attention(q, k, v, causal=causal,
+                                               segments=segs, block_k=32))
+    # ragged s_k forces flash_attention's dense fallback: same call, other
+    # path — use s=120 variant
+    q2, k2, v2 = q[:, :, :120], k[:, :, :120], v[:, :, :120]
+    fallback = np.asarray(flash_attention(q2, k2, v2, causal=causal,
+                                          segments=segs[:, :120],
+                                          block_k=33))
+
+    for name, out in [("kernel", kernel), ("dense", dense),
+                      ("blockwise", blockwise)]:
+        assert np.all(out[:, :, pad[0], :][0] == 0), name
+        np.testing.assert_allclose(out, dense, atol=2e-5, err_msg=name)
+    pad2 = np.asarray(segs[:, :120]) == 0
+    assert np.all(fallback[0][:, pad2[0], :] == 0)
+
+    # backward stays finite through the zeroed rows
+    g = jax.grad(lambda a, b_, c: jnp.sum(jnp.square(flash_attention(
+        a, b_, c, causal=causal, segments=segs))), argnums=(0, 1, 2))(q, k, v)
+    for t in g:
+        assert np.all(np.isfinite(np.asarray(t)))
