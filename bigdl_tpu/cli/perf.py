@@ -49,6 +49,11 @@ def _bn_subset(m, k: int = 32):
     return set_bn_stat_sample(m, k)
 
 
+def _bn_fused(m):
+    from bigdl_tpu.nn import set_bn_fused
+    return set_bn_fused(m)
+
+
 def build_model(name: str, class_num: int = 1000):
     import jax
 
@@ -65,6 +70,10 @@ def build_model(name: str, class_num: int = 1000):
         # BN stats from 32 batch rows: cuts the stats-pass HBM re-read of
         # every activation (the dominant BN cost, PERF.md §2) by b/32
         "resnet50_bnss": lambda: _bn_subset(models.resnet50(class_num)),
+        # single-read Pallas BN stats (ops/bn_kernel.py): the stats pass
+        # is the #1 sync op category (PERF.md §2); exact semantics,
+        # unlike the bnss subset sampling
+        "resnet50_fbn": lambda: _bn_fused(models.resnet50(class_num)),
         "lenet5": lambda: models.lenet5(10),
         # long-context flagship: 32k vocab, 512-token causal LM. The Pallas
         # kernel only off-interpret on TPU; elsewhere the dense path keeps

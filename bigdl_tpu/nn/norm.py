@@ -19,6 +19,7 @@ from bigdl_tpu.core.module import Module, SimpleModule
 __all__ = [
     "BatchNormalization",
     "set_bn_stat_sample",
+    "set_bn_fused",
     "SpatialBatchNormalization",
     "SpatialCrossMapLRN",
     "SpatialSubtractiveNormalization",
@@ -51,12 +52,19 @@ class BatchNormalization(Module):
     def __init__(self, n_output: int, eps: float = 1e-5, momentum: float = 0.1,
                  affine: bool = True, axis_name: Optional[str] = None,
                  gamma_init: float = 1.0, stat_sample: Optional[int] = None,
-                 name: Optional[str] = None):
+                 fused: bool = False, name: Optional[str] = None):
         super().__init__(name)
         self.n_output = n_output
         self.eps, self.momentum, self.affine = eps, momentum, affine
         self.axis_name = axis_name
         self.gamma_init = gamma_init
+        # fused=True routes training stats through the single-read Pallas
+        # kernel (ops/bn_kernel.py) — the BN stats pass is the largest
+        # sync op category in the ResNet step (PERF.md §2). Single-device
+        # jit only: under SPMD-sharded batches a pallas_call does not
+        # auto-partition (use axis_name + shard_map for sync-BN instead),
+        # and it composes with neither axis_name nor stat_sample.
+        self.fused = fused
         # stat_sample=k: training statistics from the first k batch rows
         # only. The stats pass re-reads every activation from HBM (the
         # dominant BN cost on TPU — PERF.md §2); a subset cuts that read
@@ -82,6 +90,20 @@ class BatchNormalization(Module):
 
     def apply(self, params, state, x, *, training=False, rng=None):
         axes = tuple(range(x.ndim - 1))  # all but features
+        if (training and self.fused and self.affine
+                and self.axis_name is None and not self.stat_sample):
+            from bigdl_tpu.ops.bn_kernel import fused_bn_train
+
+            y, mean, var = fused_bn_train(x, params["weight"],
+                                          params["bias"], self.eps)
+            m = self.momentum
+            n = x.size // x.shape[-1]
+            unbiased = var * n / max(1, n - 1)
+            new_state = {
+                "running_mean": (1 - m) * state["running_mean"] + m * mean,
+                "running_var": (1 - m) * state["running_var"] + m * unbiased,
+            }
+            return y, new_state
         xf = x.astype(jnp.float32)
         if training:
             k = self.stat_sample
@@ -124,6 +146,17 @@ def set_bn_stat_sample(module, k: Optional[int]):
         module.stat_sample = k
     for ch in getattr(module, "children", lambda: ())() or ():
         set_bn_stat_sample(ch, k)
+    return module
+
+
+def set_bn_fused(module, fused: bool = True):
+    """Route every BatchNormalization's training stats through the
+    single-read Pallas kernel (ops/bn_kernel.py; single-device jit —
+    see the ``fused`` constructor note). Returns the module."""
+    if isinstance(module, BatchNormalization):
+        module.fused = fused
+    for ch in getattr(module, "children", lambda: ())() or ():
+        set_bn_fused(ch, fused)
     return module
 
 

@@ -11,5 +11,7 @@ pure-XLA fallback used off-TPU so the API is always importable.
 from bigdl_tpu.ops.attention_kernel import (
     blockwise_attention, flash_attention,
 )
+from bigdl_tpu.ops.bn_kernel import bn_stats, bn_bwd_stats, fused_bn_train
 
-__all__ = ["flash_attention", "blockwise_attention"]
+__all__ = ["flash_attention", "blockwise_attention",
+           "bn_stats", "bn_bwd_stats", "fused_bn_train"]

@@ -1,0 +1,220 @@
+"""Fused batch-norm statistics for TPU — single-read Pallas kernels.
+
+Why this exists: the round-3 xplane profile (PERF.md §2) shows BN stat
+reductions as the largest synchronous op category in the ResNet-50 step
+(15.6 ms at b128 — more than the optimizer). The stats pass re-reads the
+full activation from HBM, and XLA schedules the mean and mean-of-squares
+reductions (plus the bf16→f32 convert) as separate fusion consumers of
+that read. The reference never had this problem shape: its MKL BN
+(nn/SpatialBatchNormalization.scala backed by the native batchnorm) ran
+per-core on cache-resident tiles.
+
+Two kernels, both one HBM pass:
+
+* :func:`bn_stats` — (rows, C) activations → per-channel (sum, sumsq)
+  accumulated in f32 VMEM scratch across a serial row-block grid. One
+  read of x instead of XLA's convert+double-reduce chain.
+* :func:`bn_bwd_stats` — the backward needs Σdy and Σ(dy·x̂) per channel;
+  same pattern over (dy, x) with the normalization folded in, one read
+  of each operand.
+
+The elementwise apply ((x-μ)·inv·γ+β) and the dx elementwise expression
+stay in jnp — XLA fuses those into neighbors for free; only the
+reductions needed hand-tiling. :func:`fused_bn_train` packages
+stats+apply+backward under one ``jax.custom_vjp`` so
+``nn.BatchNormalization(fused=True)`` can swap it in transparently.
+
+Non-TPU backends run interpret mode (tests); block specs follow the
+(8, 128) tiling rule (validated by the Mosaic block-spec lint in
+tests/test_flash_attention.py).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+__all__ = ["bn_stats", "bn_bwd_stats", "fused_bn_train"]
+
+
+def _vmem_scratch(shape):
+    from jax.experimental.pallas import tpu as pltpu
+
+    return pltpu.VMEM(shape, jnp.float32)
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+# row-block height per grid step; 512 f32 lanes × C_BLOCK channels of x
+# plus two f32 scratch rows stay far under VMEM
+_ROW_BLOCK = 512
+_C_BLOCK = 128
+
+
+def _stats_kernel(x_ref, sum_ref, sq_ref, acc_ref):
+    """Grid (c_blocks, row_blocks) — row dim innermost, so the f32 scratch
+    accumulator persists across the row sweep of one channel block."""
+    r = pl.program_id(1)
+    x = x_ref[...].astype(jnp.float32)
+
+    @pl.when(r == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    acc_ref[0, :] += jnp.sum(x, axis=0)
+    acc_ref[1, :] += jnp.sum(x * x, axis=0)
+
+    @pl.when(r == pl.num_programs(1) - 1)
+    def _emit():
+        sum_ref[...] = acc_ref[0:1, :]
+        sq_ref[...] = acc_ref[1:2, :]
+
+
+def bn_stats(x2d: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Per-channel (sum, sum-of-squares) of a (rows, C) array in ONE HBM
+    read, f32 accumulation regardless of input dtype. Requires rows %
+    {row block} == 0 and C % 128 == 0 (the NHWC ResNet shapes satisfy
+    both); callers fall back to jnp otherwise."""
+    rows, c = x2d.shape
+    rb = min(_ROW_BLOCK, rows)
+    cb = min(_C_BLOCK, c)
+    if rows % rb or c % cb:
+        raise ValueError(f"bn_stats needs rows%{rb}==0 and C%{cb}==0, "
+                         f"got {x2d.shape}")
+    grid = (c // cb, rows // rb)
+    out_shape = [
+        jax.ShapeDtypeStruct((1, c), jnp.float32),
+        jax.ShapeDtypeStruct((1, c), jnp.float32),
+    ]
+    s, sq = pl.pallas_call(
+        _stats_kernel,
+        grid=grid,
+        in_specs=[pl.BlockSpec((rb, cb), lambda ci, ri: (ri, ci))],
+        out_specs=[
+            pl.BlockSpec((1, cb), lambda ci, ri: (0, ci)),
+            pl.BlockSpec((1, cb), lambda ci, ri: (0, ci)),
+        ],
+        out_shape=out_shape,
+        scratch_shapes=[_vmem_scratch((2, cb))],
+        interpret=_interpret(),
+    )(x2d)
+    return s[0], sq[0]
+
+
+def _bwd_kernel(dy_ref, xhat_ref, sdy_ref, sdyx_ref, acc_ref):
+    r = pl.program_id(1)
+    dy = dy_ref[...].astype(jnp.float32)
+    xh = xhat_ref[...].astype(jnp.float32)
+
+    @pl.when(r == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    acc_ref[0, :] += jnp.sum(dy, axis=0)
+    acc_ref[1, :] += jnp.sum(dy * xh, axis=0)
+
+    @pl.when(r == pl.num_programs(1) - 1)
+    def _emit():
+        sdy_ref[...] = acc_ref[0:1, :]
+        sdyx_ref[...] = acc_ref[1:2, :]
+
+
+def bn_bwd_stats(dy2d: jax.Array, xhat2d: jax.Array):
+    """(Σdy, Σ(dy·x̂)) per channel — the two reductions of the BN backward
+    — in one pass over each operand."""
+    rows, c = dy2d.shape
+    rb = min(_ROW_BLOCK, rows)
+    cb = min(_C_BLOCK, c)
+    if rows % rb or c % cb:
+        raise ValueError(f"bn_bwd_stats needs rows%{rb}==0 and C%{cb}==0, "
+                         f"got {dy2d.shape}")
+    grid = (c // cb, rows // rb)
+    sdy, sdyx = pl.pallas_call(
+        _bwd_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((rb, cb), lambda ci, ri: (ri, ci)),
+            pl.BlockSpec((rb, cb), lambda ci, ri: (ri, ci)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, cb), lambda ci, ri: (0, ci)),
+            pl.BlockSpec((1, cb), lambda ci, ri: (0, ci)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((1, c), jnp.float32),
+            jax.ShapeDtypeStruct((1, c), jnp.float32),
+        ],
+        scratch_shapes=[_vmem_scratch((2, cb))],
+        interpret=_interpret(),
+    )(dy2d, xhat2d)
+    return sdy[0], sdyx[0]
+
+
+def _tileable(rows: int, c: int) -> bool:
+    return rows % min(_ROW_BLOCK, rows) == 0 and rows % 8 == 0 \
+        and c % min(_C_BLOCK, c) == 0 and c % 128 == 0
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def fused_bn_train(x, gamma, beta, eps: float):
+    """Training-mode BN over the last axis with fused single-read stats.
+    x: (..., C); returns (y, mean, var) — mean/var are the BATCH stats the
+    caller folds into its running estimates (the reference's EMA rule,
+    BatchNormalization.scala updateOutput)."""
+    y, mean, var, _ = _fused_fwd(x, gamma, beta, eps)
+    return y, mean, var
+
+
+def _fused_fwd(x, gamma, beta, eps):
+    c = x.shape[-1]
+    rows = x.size // c
+    x2 = x.reshape(rows, c)
+    if _tileable(rows, c):
+        s, sq = bn_stats(x2)
+    else:  # jnp fallback, same math
+        xf = x2.astype(jnp.float32)
+        s, sq = jnp.sum(xf, 0), jnp.sum(xf * xf, 0)
+    mean = s / rows
+    var = jnp.maximum(sq / rows - mean * mean, 0.0)
+    inv = jax.lax.rsqrt(var + eps)
+    scale = inv * gamma
+    shift = beta - mean * scale
+    y = (x.astype(jnp.float32) * scale + shift).astype(x.dtype)
+    return y, mean, var, (x, mean, inv, gamma)
+
+
+def _fused_vjp_fwd(x, gamma, beta, eps):
+    y, mean, var, res = _fused_fwd(x, gamma, beta, eps)
+    return (y, mean, var), res
+
+
+def _fused_vjp_bwd(eps, res, cts):
+    dy, d_mean, d_var = cts
+    del d_mean, d_var  # running-stat EMA carries no gradient
+    x, mean, inv, gamma = res
+    c = x.shape[-1]
+    rows = x.size // c
+    dy2 = dy.reshape(rows, c)
+    xhat2 = ((x.reshape(rows, c).astype(jnp.float32) - mean) * inv)
+    if _tileable(rows, c):
+        sdy, sdyx = bn_bwd_stats(dy2, xhat2.astype(dy2.dtype))
+    else:
+        dyf = dy2.astype(jnp.float32)
+        sdy, sdyx = jnp.sum(dyf, 0), jnp.sum(dyf * xhat2, 0)
+    m_dy = sdy / rows
+    m_dyx = sdyx / rows
+    # the classic BN backward (batch stats differentiated through)
+    dx = ((dy.reshape(rows, c).astype(jnp.float32)
+           - m_dy - xhat2 * m_dyx) * (gamma * inv)).astype(x.dtype)
+    dgamma = sdyx
+    dbeta = sdy
+    return dx.reshape(x.shape), dgamma, dbeta
+
+
+fused_bn_train.defvjp(_fused_vjp_fwd, _fused_vjp_bwd)

@@ -1,10 +1,10 @@
 #!/usr/bin/env bash
-# Round-3 clean re-measurement: the first capture's resnet50 trajectory ran
-# while a pytest process shared the single host core (dispatch-side
-# contention), and the transformer/flash steps hit the lse block-spec
-# lowering bug since fixed in ops/attention_kernel.py. This sweep re-records
-# everything with the host idle. Appends to $OUT (default
-# /tmp/tpu_capture_r04.log), mirrored into the repo per step.
+# Round-4 capture: chip evidence for VERDICT r4 item 1 — compiled kernels,
+# clean b128 + transformer_lm_1k MFU, flash rows, and the lever A/Bs
+# (s2d, innerSteps, bnss, and the new fused-BN Pallas stats kernel).
+# Appends to $OUT, mirrored into the repo per step.
+
+
 set -uo pipefail
 cd "$(dirname "$0")/.."
 OUT="${OUT:-/tmp/tpu_capture_r04.log}"
@@ -36,6 +36,10 @@ step "flash_bench" 1800 python scripts/flash_bench.py 4 8 64
 # 4. lever A/Bs + the rest of the trajectory
 step "perf_resnet50_inner10_b128" 900 python -m bigdl_tpu.cli.perf -m resnet50 -b 128 -i 4 --innerSteps 10 --dataType random
 step "perf_resnet50_bnss_b128" 900 python -m bigdl_tpu.cli.perf -m resnet50_bnss -b 128 -i 20 --dataType random
+# round-4 lever: single-read Pallas BN stats (ops/bn_kernel.py) — exact
+# semantics, targets the 15.6 ms/step BN stat category head-on
+step "perf_resnet50_fbn_b128" 900 python -m bigdl_tpu.cli.perf -m resnet50_fbn -b 128 -i 20 --dataType random
+step "perf_resnet50_fbn_s2d_inner10" 900 python -m bigdl_tpu.cli.perf -m resnet50_fbn -b 128 -i 4 --innerSteps 10 --dataType random
 step "perf_resnet50_s2d_b128" 900 python -m bigdl_tpu.cli.perf -m resnet50_s2d -b 128 -i 20 --dataType random
 for B in 64 256 512; do
   step "perf_resnet50_b$B" 900 python -m bigdl_tpu.cli.perf -m resnet50 -b "$B" -i 20 --dataType random

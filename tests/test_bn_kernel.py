@@ -1,0 +1,209 @@
+"""Fused single-read BN stats kernels (ops/bn_kernel.py) — parity vs the
+jnp math, module integration, and the Mosaic tiling lint (the CPU-side
+check that caught two real lowering bugs in round 3)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu import nn
+from bigdl_tpu.ops.bn_kernel import bn_stats, bn_bwd_stats, fused_bn_train
+
+
+def test_bn_stats_matches_jnp():
+    rs = np.random.RandomState(0)
+    x = jnp.asarray(rs.randn(1024, 256), jnp.float32)
+    s, sq = bn_stats(x)
+    # sums of ~1e3 standard normals can land near 0 -> atol, not rtol
+    np.testing.assert_allclose(np.asarray(s), np.asarray(x).sum(0),
+                               atol=5e-3)
+    np.testing.assert_allclose(np.asarray(sq), (np.asarray(x) ** 2).sum(0),
+                               rtol=1e-5, atol=5e-3)
+
+
+def test_bn_stats_bf16_accumulates_f32():
+    rs = np.random.RandomState(1)
+    xf = rs.randn(2048, 128).astype(np.float32)
+    s, sq = bn_stats(jnp.asarray(xf, jnp.bfloat16))
+    assert s.dtype == jnp.float32
+    # bf16 quantization of inputs, but no accumulation-order blowup
+    np.testing.assert_allclose(
+        np.asarray(s),
+        np.asarray(jnp.asarray(xf, jnp.bfloat16), np.float32).sum(0),
+        rtol=2e-2, atol=2e-1)
+
+
+def test_bn_stats_rejects_untileable():
+    with pytest.raises(ValueError, match="bn_stats needs"):
+        bn_stats(jnp.zeros((100, 130)))
+
+
+def test_bn_bwd_stats_matches_jnp():
+    rs = np.random.RandomState(2)
+    dy = jnp.asarray(rs.randn(512, 128), jnp.float32)
+    xh = jnp.asarray(rs.randn(512, 128), jnp.float32)
+    sdy, sdyx = bn_bwd_stats(dy, xh)
+    np.testing.assert_allclose(np.asarray(sdy), np.asarray(dy).sum(0),
+                               atol=5e-3)
+    np.testing.assert_allclose(np.asarray(sdyx),
+                               (np.asarray(dy) * np.asarray(xh)).sum(0),
+                               atol=5e-3)
+
+
+def _ref_bn(x, gamma, beta, eps):
+    """Plain differentiable BN in jnp — the oracle for the custom vjp."""
+    c = x.shape[-1]
+    xf = x.astype(jnp.float32).reshape(-1, c)
+    mean = xf.mean(0)
+    var = xf.var(0)
+    xhat = (xf - mean) * jax.lax.rsqrt(var + eps)
+    return (xhat * gamma + beta).reshape(x.shape).astype(x.dtype)
+
+
+@pytest.mark.parametrize("shape", [(8, 4, 4, 128), (1024, 256)])
+def test_fused_bn_train_forward_and_grads(shape):
+    rs = np.random.RandomState(3)
+    x = jnp.asarray(rs.randn(*shape), jnp.float32)
+    c = shape[-1]
+    gamma = jnp.asarray(rs.rand(c) + 0.5, jnp.float32)
+    beta = jnp.asarray(rs.randn(c), jnp.float32)
+    eps = 1e-5
+
+    y, mean, var = fused_bn_train(x, gamma, beta, eps)
+    want = _ref_bn(x, gamma, beta, eps)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(want), atol=1e-4)
+    xf = np.asarray(x, np.float64).reshape(-1, c)
+    np.testing.assert_allclose(np.asarray(mean), xf.mean(0), atol=1e-4)
+    np.testing.assert_allclose(np.asarray(var), xf.var(0), atol=1e-4)
+
+    w = jnp.asarray(rs.randn(*shape), jnp.float32)  # non-uniform cotangent
+
+    def loss_fused(x, g, b):
+        return jnp.sum(fused_bn_train(x, g, b, eps)[0] * w)
+
+    def loss_ref(x, g, b):
+        return jnp.sum(_ref_bn(x, g, b, eps) * w)
+
+    gf = jax.grad(loss_fused, argnums=(0, 1, 2))(x, gamma, beta)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(x, gamma, beta)
+    for a, b_, n in zip(gf, gr, ("dx", "dgamma", "dbeta")):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   atol=2e-4, err_msg=n)
+
+
+def test_fused_module_matches_unfused():
+    """BatchNormalization(fused=True) training step == fused=False:
+    outputs, running-stat updates, and input grads."""
+    rs = np.random.RandomState(4)
+    x = jnp.asarray(rs.randn(16, 4, 4, 128), jnp.float32)
+    p = {"weight": jnp.asarray(rs.rand(128) + 0.5, jnp.float32),
+         "bias": jnp.asarray(rs.randn(128), jnp.float32)}
+
+    out = {}
+    for fused in (False, True):
+        bn = nn.SpatialBatchNormalization(128, fused=fused)
+        s = bn.init_state()
+        y, ns = bn.apply(p, s, x, training=True)
+        g = jax.grad(lambda xx: jnp.sum(
+            jnp.square(bn.apply(p, s, xx, training=True)[0])))(x)
+        out[fused] = (np.asarray(y), {k: np.asarray(v)
+                                      for k, v in ns.items()}, np.asarray(g))
+
+    y0, s0, g0 = out[False]
+    y1, s1, g1 = out[True]
+    np.testing.assert_allclose(y1, y0, atol=1e-4)
+    for k in s0:
+        np.testing.assert_allclose(s1[k], s0[k], atol=1e-5, err_msg=k)
+    np.testing.assert_allclose(g1, g0, atol=2e-4)
+
+
+def test_fused_falls_back_on_untileable_shapes():
+    """Channels not %128: the jnp fallback inside fused_bn_train keeps the
+    module usable with identical semantics."""
+    rs = np.random.RandomState(5)
+    x = jnp.asarray(rs.randn(8, 3, 3, 20), jnp.float32)
+    bn = nn.SpatialBatchNormalization(20, fused=True)
+    p, s = bn.init(jax.random.PRNGKey(0)), bn.init_state()
+    y_f, _ = bn.apply(p, s, x, training=True)
+    bn.fused = False
+    y_u, _ = bn.apply(p, s, x, training=True)
+    np.testing.assert_allclose(np.asarray(y_f), np.asarray(y_u), atol=1e-5)
+
+
+def test_bn_kernel_block_specs_satisfy_mosaic_tiling():
+    """Same lint as the flash kernels: every pallas_call block's last two
+    dims must be (8,128)-aligned or equal to the array dims."""
+    from unittest import mock
+
+    from jax.experimental import pallas as real_pl
+
+    captured = []
+    real_call = real_pl.pallas_call
+
+    def spy(kernel, **kw):
+        in_specs = kw.get("in_specs") or []
+        out_specs = kw.get("out_specs")
+        out_shape = kw.get("out_shape")
+        outs = out_specs if isinstance(out_specs, (list, tuple)) \
+            else [out_specs]
+        shapes = out_shape if isinstance(out_shape, (list, tuple)) \
+            else [out_shape]
+        inner = real_call(kernel, **kw)
+
+        def wrapped(*args):
+            for spec, arr in list(zip(in_specs, args)) + [
+                    (sp, sh) for sp, sh in zip(outs, shapes)]:
+                if spec is not None:
+                    captured.append((tuple(spec.block_shape),
+                                     tuple(arr.shape)))
+            return inner(*args)
+
+        return wrapped
+
+    import bigdl_tpu.ops.bn_kernel as bnk
+    with mock.patch.object(bnk.pl, "pallas_call", side_effect=spy):
+        rs = np.random.RandomState(6)
+        x = jnp.asarray(rs.randn(1024, 256), jnp.float32)
+        bn_stats(x)
+        bn_bwd_stats(x, x)
+        g = jnp.asarray(rs.rand(256), jnp.float32)
+        jax.grad(lambda xx: jnp.sum(
+            fused_bn_train(xx, g, g, 1e-5)[0]))(x)
+
+    assert len(captured) >= 6, len(captured)
+    for bs, ashape in captured:
+        b0, b1 = bs[-2], bs[-1]
+        a0, a1 = ashape[-2], ashape[-1]
+        assert b1 == a1 or b1 % 128 == 0, (bs, ashape)
+        assert b0 == a0 or b0 % 8 == 0, (bs, ashape)
+
+
+@pytest.mark.tpu
+def test_bn_kernel_compiled_on_tpu():
+    """Non-interpret (Mosaic-compiled) parity for the BN stats kernels —
+    the flash kernels' first chip contact found two lowering bugs that
+    interpret mode could not see; same insurance here."""
+    if jax.default_backend() != "tpu":
+        pytest.skip("needs a TPU backend (kernel runs interpret elsewhere)")
+    rs = np.random.RandomState(21)
+    x = jnp.asarray(rs.randn(4096, 256), jnp.bfloat16)
+    s, sq = jax.jit(bn_stats)(x)
+    xf = np.asarray(x, np.float32)
+    np.testing.assert_allclose(np.asarray(s), xf.sum(0), rtol=2e-2,
+                               atol=2e-1)
+    np.testing.assert_allclose(np.asarray(sq), (xf * xf).sum(0), rtol=2e-2)
+
+    gamma = jnp.asarray(rs.rand(256) + 0.5, jnp.float32)
+    beta = jnp.asarray(rs.randn(256), jnp.float32)
+    xt = jnp.asarray(rs.randn(16, 8, 8, 256), jnp.float32)
+    y, mean, var = jax.jit(
+        lambda a, g, b: fused_bn_train(a, g, b, 1e-5))(xt, gamma, beta)
+    want = _ref_bn(xt, gamma, beta, 1e-5)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(want), atol=1e-3)
+    g = jax.jit(jax.grad(lambda a: jnp.sum(
+        jnp.square(fused_bn_train(a, gamma, beta, 1e-5)[0]))))(xt)
+    gr = jax.grad(lambda a: jnp.sum(
+        jnp.square(_ref_bn(a, gamma, beta, 1e-5))))(xt)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(gr), atol=1e-3)
