@@ -1,9 +1,15 @@
 """Named metric counters (reference optim/Metrics.scala:25-117).
 
 The reference aggregates counters across the cluster with Spark
-accumulators; here counters are host-side (per-process), and multi-host
-aggregation — when running under jax.distributed — is a psum over a tiny
-array done by the caller. The API (set/add/summary) matches the reference.
+accumulators (each executor adds into a driver-visible accumulator, so
+the driver can log "computing time for each node"). Here counters are
+host-side (per-process); :meth:`Metrics.aggregate` is the accumulator
+analog — an ``process_allgather`` of the counter vector under
+``jax.distributed``, giving every host the per-node values plus global
+sum/mean. ``summary(aggregate=True)`` renders the per-node rows at the
+same log points the reference does. The collective is symmetric: every
+process must reach the same aggregate() call (the Optimizer calls it at
+epoch end on all hosts).
 """
 
 from __future__ import annotations
@@ -43,8 +49,51 @@ class Metrics:
             self._sum.clear()
             self._count.clear()
 
-    def summary(self, unit: str = "s", scale: float = 1.0) -> str:
-        """Pretty-print all counters (reference Metrics.summary :99)."""
+    def aggregate(self) -> Dict[str, dict]:
+        """Cross-process view of every counter:
+        ``{name: {"per_host": [v0, v1, ...], "sum": s, "mean": m}}``
+        (reference Metrics.scala distributed accumulators — "computing
+        time for each node"). Single-process: per_host has one entry.
+        Under ``jax.distributed`` this is a collective (one small
+        allgather); every process must call it at the same point, and the
+        key set must match across processes (same training loop ⇒ same
+        counters)."""
+        import jax
+
+        with self._lock:
+            keys = sorted(self._sum)
+            vals = [self._sum[k] for k in keys]
+        if jax.process_count() == 1:
+            return {k: {"per_host": [v], "sum": v, "mean": v}
+                    for k, v in zip(keys, vals)}
+        import numpy as np
+        from jax.experimental import multihost_utils
+
+        vec = np.asarray(vals, np.float64)
+        gathered = np.asarray(
+            multihost_utils.process_allgather(vec))  # (n_proc, n_keys)
+        out = {}
+        for i, k in enumerate(keys):
+            per_host = gathered[:, i].tolist()
+            out[k] = {"per_host": per_host,
+                      "sum": float(gathered[:, i].sum()),
+                      "mean": float(gathered[:, i].mean())}
+        return out
+
+    def summary(self, unit: str = "s", scale: float = 1.0,
+                aggregate: bool = False) -> str:
+        """Pretty-print all counters (reference Metrics.summary :99).
+        ``aggregate=True`` adds per-node rows via :meth:`aggregate`
+        (collective — call symmetrically on every process)."""
+        if aggregate:
+            agg = self.aggregate()
+            lines = []
+            for k, a in sorted(agg.items()):
+                nodes = " ".join(f"node{i}={v / scale:.4g}{unit}"
+                                 for i, v in enumerate(a["per_host"]))
+                lines.append(f"  {k}: sum={a['sum'] / scale:.4g}{unit} "
+                             f"mean={a['mean'] / scale:.4g}{unit} [{nodes}]")
+            return "\n".join(["Metrics (all nodes):"] + lines)
         with self._lock:
             lines = [f"  {k}: sum={v / scale:.4g}{unit} "
                      f"mean={v / max(1, self._count[k]) / scale:.4g}{unit}"

@@ -372,6 +372,12 @@ class Optimizer:
             logger.info("Epoch %d done: %d records in %.2fs (%.1f rec/s)",
                         driver["epoch"] - 1, records_this_epoch, dt_e,
                         records_this_epoch / max(dt_e, 1e-9))
+            if jax.process_count() > 1:
+                # reference driver logs "computing time for each node"
+                # via Spark accumulators (Metrics.scala:25-117); the
+                # aggregate is a collective, so it runs UNCONDITIONALLY
+                # on every host (a log-level guard could deadlock gloo)
+                logger.info("%s", self.metrics.summary(aggregate=True))
             self._maybe_validate(eval_fn, params, mod_state, driver)
             self._maybe_checkpoint(params, mod_state, opt_state, driver)
 

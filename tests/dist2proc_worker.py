@@ -139,11 +139,26 @@ def main() -> None:
     hloss = float(np.asarray(hout[-1].addressable_data(0)))
     hybrid_ok = bool(np.isfinite(hloss)) and hybrid_grouping_ok
 
+    # Metrics.aggregate: the Spark-accumulator analog ("computing time
+    # for each node", Metrics.scala:25-117). Distinct per-host values in,
+    # every host sees the per-node vector + global sum.
+    from bigdl_tpu.optim.metrics import Metrics
+
+    m = Metrics()
+    m.add("computing time", 1.0 + pid)
+    agg = m.aggregate()
+    per_host = agg["computing time"]["per_host"]
+    metrics_ok = (per_host == [1.0, 2.0]
+                  and abs(agg["computing time"]["sum"] - 3.0) < 1e-9)
+    rendered = m.summary(aggregate=False)  # local view still works
+    metrics_ok = metrics_ok and "computing time" in rendered
+
     with open(out_path, "w") as f:
         json.dump({"pid": pid, "digest": digest,
                    "restore_ok": bool(restore_ok),
                    "fsdp_matches_dp": bool(fsdp_matches_dp),
                    "hybrid_ok": hybrid_ok,
+                   "metrics_ok": metrics_ok,
                    "devices": jax.device_count()}, f)
 
 

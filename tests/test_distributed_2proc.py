@@ -60,3 +60,6 @@ def test_two_process_dp_training(tmp_path):
     # hybrid ICI/DCN mesh: process_index slice grouping + a cross-host
     # TP/ring-attention step executed with finite loss
     assert all(r["hybrid_ok"] for r in results), results
+    # Metrics.aggregate: per-node counter rows visible on every host
+    # (reference "computing time for each node", Metrics.scala:25-117)
+    assert all(r["metrics_ok"] for r in results), results

@@ -151,6 +151,20 @@ def test_metrics():
     assert "computing time" in m.summary()
 
 
+def test_metrics_aggregate_single_process():
+    """aggregate() is the Spark-accumulator analog; single-process it
+    degrades to one per_host entry (the 2-proc path is asserted in
+    tests/test_distributed_2proc.py)."""
+    m = Metrics()
+    m.add("get batch time", 0.5)
+    m.add("computing time", 2.0)
+    agg = m.aggregate()
+    assert agg["computing time"] == {"per_host": [2.0], "sum": 2.0,
+                                     "mean": 2.0}
+    s = m.summary(aggregate=True)
+    assert "node0=2" in s and "all nodes" in s
+
+
 def test_adamw_decoupled_decay():
     """AdamW wd must scale the weight directly (decoupled), not flow
     through the moments: with zero grads, params shrink by lr*wd each
