@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import time
 
 
@@ -74,6 +75,10 @@ def build_model(name: str, class_num: int = 1000):
         # is the #1 sync op category (PERF.md §2); exact semantics,
         # unlike the bnss subset sampling
         "resnet50_fbn": lambda: _bn_fused(models.resnet50(class_num)),
+        # CIFAR-shaped depth-20 resnet (reference models/resnet/README
+        # recipe) — the fast time-to-accuracy config
+        "resnet20_cifar": lambda: models.resnet_cifar(
+            20, class_num if class_num != 1000 else 10),
         "lenet5": lambda: models.lenet5(10),
         # long-context flagship: 32k vocab, 512-token causal LM. The Pallas
         # kernel only off-interpret on TPU; elsewhere the dense path keeps
@@ -99,6 +104,7 @@ def build_model(name: str, class_num: int = 1000):
     if name not in table:
         raise SystemExit(f"unknown model {name}; choose from {list(table)}")
     size = {"lenet5": (28, 28, 1),
+            "resnet20_cifar": (32, 32, 3),
             "transformer_lm": (512,),
             "transformer_lm_rope": (512,),
             "transformer_lm_1k": (1024,)}.get(name, (224, 224, 3))
@@ -330,6 +336,130 @@ def run(model_name: str, batch: int, iterations: int, data_type: str,
     return out
 
 
+def _make_class_image_tree(root: str, classes: int, per_class: int,
+                           size: int, seed: int = 0) -> None:
+    """Synthetic LEARNABLE image tree (zero-egress stand-in for ImageNet):
+    each class gets a distinct mean color + a bright band at a
+    class-specific height, under heavy pixel noise — decodable by a conv
+    net but not linearly trivial. JPEG-encoded so the full decode+augment
+    path runs."""
+    import numpy as np
+    from PIL import Image
+
+    rs = np.random.RandomState(seed)
+    for c in range(classes):
+        d = os.path.join(root, f"class{c:03d}")
+        os.makedirs(d, exist_ok=True)
+        hue = np.array([(40 + c * 53) % 200, (60 + c * 97) % 200,
+                        (80 + c * 151) % 200], np.float32)
+        band = (c * size) // classes
+        bh = max(2, size // classes)
+        for i in range(per_class):
+            img = np.broadcast_to(hue, (size, size, 3)).copy()
+            img[band:band + bh] += 55.0
+            img += rs.randn(size, size, 3) * 30.0
+            Image.fromarray(
+                np.clip(img, 0, 255).astype(np.uint8)).save(
+                os.path.join(d, f"{i:04d}.jpg"), quality=85)
+
+
+def run_time_to_acc(model_name: str, batch: int, target: float,
+                    max_epochs: int = 40, image_size: int = 64,
+                    classes: int = 10, train_per_class: int = 200,
+                    val_per_class: int = 40, learning_rate: float = 0.1,
+                    use_bf16: bool = True, data_dir: str | None = None):
+    """Time-to-accuracy harness (BASELINE.json metric: images/sec/chip
+    **+ time-to-76%-top1**; reference recipe models/inception/Train.scala
+    :77-83 + scripts/run.example.sh:54). Trains ``model_name`` from
+    RECORD SHARDS (decode+augment in the timed path, like the reference's
+    SequenceFile flow), validates top-1 each epoch against wall clock,
+    stops at ``target`` via Trigger.max_score, and reports the first
+    crossing time from the val curve. Zero-egress sandbox ⇒ the dataset
+    is synthetic-but-learnable (_make_class_image_tree); on real ImageNet
+    shards pass ``data_dir`` with train/ and val/ record subdirs plus
+    ``classes=1000`` and target=0.76."""
+    import shutil
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+
+    from bigdl_tpu import nn
+    from bigdl_tpu.dataset import RecordImageDataSet, write_image_shards
+    from bigdl_tpu.optim import (Optimizer, SGD, Top1Accuracy, Trigger)
+    from bigdl_tpu.parallel import DataParallel, local_mesh
+
+    t_setup = time.time()
+    td = None
+    summary_dir = tempfile.mkdtemp(prefix="tta_summary_")
+    try:
+        if data_dir is None:
+            td = tempfile.mkdtemp(prefix="tta_")
+            for split, per in (("train", train_per_class),
+                               ("val", val_per_class)):
+                tree = os.path.join(td, "imgs", split)
+                _make_class_image_tree(tree, classes, per, image_size,
+                                       seed=0 if split == "train" else 1)
+                write_image_shards(tree, os.path.join(td, "shards", split),
+                                   prefix=split, images_per_shard=256,
+                                   workers=4)
+            data_dir = os.path.join(td, "shards")
+
+        mean, std = [127.0] * 3, [60.0] * 3
+        crop = (image_size, image_size)
+        train_ds = RecordImageDataSet(os.path.join(data_dir, "train"),
+                                      batch, crop=crop, train=True,
+                                      mean=mean, std=std)
+        val_ds = RecordImageDataSet(os.path.join(data_dir, "val"), batch,
+                                    crop=crop, train=False, mean=mean,
+                                    std=std)
+
+        model, _ = build_model(model_name, class_num=classes)
+        opt = Optimizer(
+            model, train_ds, nn.ClassNLLCriterion(),
+            optim_method=SGD(learning_rate=learning_rate, momentum=0.9),
+            end_when=Trigger.or_(Trigger.max_epoch(max_epochs),
+                                 Trigger.max_score(target)),
+            strategy=DataParallel(local_mesh()),
+            compute_dtype=(jnp.bfloat16 if use_bf16 else None))
+        opt.set_validation(Trigger.every_epoch(), val_ds, [Top1Accuracy()])
+        opt.set_summary(summary_dir)
+
+        t_train = time.time()
+        opt.optimize()
+        wall = time.time() - t_train
+
+        curve = []
+        with open(os.path.join(summary_dir, "val.jsonl")) as f:
+            for line in f:
+                curve.append(json.loads(line))
+    finally:
+        if td is not None:
+            shutil.rmtree(td, ignore_errors=True)
+        shutil.rmtree(summary_dir, ignore_errors=True)
+    reached = [r for r in curve if r.get("top1_accuracy", 0.0) >= target]
+    out = {
+        "model": model_name,
+        "metric": "time_to_acc",
+        "target_top1": target,
+        "reached": bool(reached),
+        "time_to_acc_s": (round(reached[0]["wall_s"], 2) if reached
+                          else None),
+        "train_wall_s": round(wall, 2),
+        "setup_s": round(t_train - t_setup, 2),
+        "final_top1": curve[-1]["top1_accuracy"] if curve else None,
+        "epochs_run": len(curve),  # one val point per epoch
+        "batch": batch,
+        "image_size": image_size,
+        "classes": classes,
+        "device": jax.devices()[0].device_kind,
+        "curve": [{"wall_s": r.get("wall_s"),
+                   "top1": r.get("top1_accuracy")} for r in curve],
+    }
+    print(json.dumps(out))
+    return out
+
+
 def main(argv=None):
     p = argparse.ArgumentParser("bigdl-tpu perf")
     p.add_argument("-m", "--model", default="inception_v1")
@@ -350,10 +480,32 @@ def main(argv=None):
     p.add_argument("--profile", default=None, metavar="DIR",
                    help="write a jax.profiler xplane trace of the timed "
                         "loop to DIR")
+    p.add_argument("--timeToAcc", type=float, default=None, metavar="T",
+                   help="run the time-to-accuracy harness instead of the "
+                        "throughput loop: train from record shards to "
+                        "val top1 >= T (BASELINE metric "
+                        "'time-to-76%%-top1'; synthetic learnable data "
+                        "unless --data record:DIR points at real shards)")
+    p.add_argument("--maxEpoch", type=int, default=40,
+                   help="epoch cap for --timeToAcc")
+    p.add_argument("--imageSize", type=int, default=64,
+                   help="image side for --timeToAcc synthetic data")
+    p.add_argument("--classes", type=int, default=10,
+                   help="class count for --timeToAcc (pass 1000 with real "
+                        "ImageNet shards via --data record:DIR)")
     from bigdl_tpu.cli.common import _add_platform_arg, apply_platform
     _add_platform_arg(p)
     args = p.parse_args(argv)
     apply_platform(args)
+    if args.timeToAcc is not None:
+        data_dir = None
+        if args.data and args.data.startswith("record:"):
+            data_dir = args.data[len("record:"):]
+        run_time_to_acc(args.model, args.batchSize, args.timeToAcc,
+                        max_epochs=args.maxEpoch,
+                        image_size=args.imageSize, classes=args.classes,
+                        use_bf16=not args.f32, data_dir=data_dir)
+        return
     run(args.model, args.batchSize, args.iteration, args.dataType,
         use_bf16=not args.f32, data_parallel=args.dataParallel,
         data_source=args.data, inner_steps=args.innerSteps,

@@ -295,6 +295,7 @@ class Optimizer:
         driver = {"epoch": 1, "iteration": 0, "epoch_finished": False,
                   "loss": float("inf")}
         wall_start = time.time()
+        self._wall_start = wall_start
         records_this_epoch = 0
         _end = object()  # end-of-epoch sentinel (None could be a real batch)
         last_log_t = time.time()
@@ -405,6 +406,8 @@ class Optimizer:
             **{m.name.replace(" ", "_"): r.result()[0]
                for m, r in zip(self._val_methods, results)}})
         driver["val_results"] = results
+        # first method's scalar drives Trigger.max_score (time-to-accuracy)
+        driver["val_score"] = float(results[0].result()[0])
         return results
 
     # -------------------------------------------------------- summaries
@@ -422,6 +425,9 @@ class Optimizer:
         if d is None:
             return
         import json
+        start = getattr(self, "_wall_start", None)
+        if start is not None:  # accuracy-vs-wall-clock curves need time
+            row = {**row, "wall_s": round(time.time() - start, 3)}
         with open(os.path.join(d, f"{which}.jsonl"), "a") as f:
             f.write(json.dumps(row) + "\n")
 

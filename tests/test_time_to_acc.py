@@ -1,0 +1,71 @@
+"""Time-to-accuracy harness (BASELINE.json: "images/sec/chip +
+time-to-76%-top1"; reference recipe models/inception/Train.scala:77-83).
+Runs the full path — synthetic learnable JPEGs → record shards →
+RecordImageDataSet decode/augment → train → per-epoch val top1 vs wall
+clock → first-crossing extraction."""
+
+import numpy as np
+
+import jax
+
+from bigdl_tpu.optim import Trigger
+
+
+def test_trigger_max_score():
+    t = Trigger.max_score(0.75)
+    assert not t({"iteration": 1})
+    assert not t({"val_score": 0.6})
+    assert t({"val_score": 0.75})
+    assert t({"val_score": 0.9})
+
+
+def test_time_to_acc_harness_end_to_end():
+    from bigdl_tpu.cli.perf import run_time_to_acc
+
+    out = run_time_to_acc("resnet20_cifar", 16, target=0.75, max_epochs=6,
+                          image_size=32, train_per_class=40,
+                          val_per_class=10, use_bf16=False)
+    assert out["metric"] == "time_to_acc"
+    assert out["epochs_run"] >= 1
+    assert len(out["curve"]) == out["epochs_run"]
+    # every curve point carries wall clock and accuracy
+    assert all(r["wall_s"] > 0 and 0.0 <= r["top1"] <= 1.0
+               for r in out["curve"])
+    # the synthetic task is learnable: the net must beat chance quickly
+    assert out["final_top1"] > 0.2
+    if out["reached"]:
+        assert out["time_to_acc_s"] is not None
+        assert out["time_to_acc_s"] <= out["train_wall_s"] + 1.0
+        # the crossing time is the FIRST val point at/above target
+        crossing = [r for r in out["curve"] if r["top1"] >= 0.75][0]
+        assert abs(crossing["wall_s"] - out["time_to_acc_s"]) < 0.02
+
+
+def test_summary_rows_carry_wall_clock(tmp_path):
+    """set_summary rows gained wall_s (the accuracy-vs-time axis)."""
+    import json
+
+    import jax.numpy as jnp
+
+    from bigdl_tpu import nn
+    from bigdl_tpu.core import Sequential
+    from bigdl_tpu.dataset import BatchDataSet
+    from bigdl_tpu.optim import Optimizer, SGD, Top1Accuracy
+
+    rs = np.random.RandomState(0)
+    x = rs.rand(64, 8).astype(np.float32)
+    y = (x[:, 0] > 0.5).astype(np.int32)
+    model = Sequential(nn.Linear(8, 2), nn.LogSoftMax())
+    ds = BatchDataSet(x, y, batch_size=16)
+    opt = Optimizer(model, ds, nn.ClassNLLCriterion(),
+                    optim_method=SGD(learning_rate=0.5),
+                    end_when=Trigger.max_epoch(2))
+    opt.set_validation(Trigger.every_epoch(), ds, [Top1Accuracy()])
+    opt.set_summary(str(tmp_path))
+    opt.optimize()
+
+    for fname in ("train.jsonl", "val.jsonl"):
+        rows = [json.loads(l) for l in open(tmp_path / fname)]
+        assert rows and all("wall_s" in r for r in rows), fname
+        assert all(a["wall_s"] <= b["wall_s"]
+                   for a, b in zip(rows, rows[1:])), fname
