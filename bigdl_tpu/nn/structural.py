@@ -384,10 +384,16 @@ class Contiguous(Copy):
 
 
 class Echo(SimpleModule):
-    """Debug print of shape/dtype during trace (reference nn/Echo.scala)."""
+    """Debug print per forward (reference nn/Echo.scala prints every
+    updateOutput). Shape/dtype are static so they print at trace time;
+    ``jax.debug.print`` fires on every EXECUTION too — including under
+    jit — matching the reference's per-forward behavior."""
 
     def _forward(self, params, x, *, training, rng):
         print(f"[Echo:{self.name}] shape={tuple(x.shape)} dtype={x.dtype}")
+        jax.debug.print("[Echo:{n}] max={m:.4g} mean={a:.4g}",
+                        n=self.name or "?", m=jnp.max(x),
+                        a=jnp.mean(x))
         return x
 
 
