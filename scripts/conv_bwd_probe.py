@@ -1,0 +1,90 @@
+"""Conv backward layout probe (PERF.md §2: the backward runs at ~38% MFU
+vs the forward's 46% — this isolates WHERE).
+
+For each representative ResNet-50 conv shape, times the three conv passes
+separately (forward, input-grad, filter-grad) in bf16, for both NHWC and
+NCHW activation layouts. XLA picks internal layouts per op; what the
+framework controls is the activation layout it hands XLA — if NCHW wins
+the backward for some shape class, a layout-swapped backward (transpose
+in, transpose out, fused by XLA into neighbors) is the lever.
+
+Usage: python scripts/conv_bwd_probe.py [iters]   # one JSON line per cell
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+# (name, batch, h, w, cin, cout, k, stride)
+SHAPES = [
+    ("stem7x7s2", 128, 224, 224, 3, 64, 7, 2),
+    ("s1_3x3", 128, 56, 56, 64, 64, 3, 1),
+    ("s2_3x3", 128, 28, 28, 128, 128, 3, 1),
+    ("s3_3x3", 128, 14, 14, 256, 256, 3, 1),
+    ("s4_3x3", 128, 7, 7, 512, 512, 3, 1),
+    ("s2_1x1", 128, 28, 28, 512, 128, 1, 1),
+]
+
+_DIMSPEC = {"NHWC": ("NHWC", "HWIO", "NHWC"),
+            "NCHW": ("NCHW", "OIHW", "NCHW")}
+
+
+def _conv(x, w, stride, layout):
+    k = w.shape[0] if layout == "NHWC" else w.shape[2]
+    pad = (k - 1) // 2
+    # bf16 in/out (MXU accumulates f32 internally); an explicit f32
+    # preferred_element_type would hand the backward a mixed-dtype conv
+    return lax.conv_general_dilated(
+        x, w, (stride, stride), ((pad, pad), (pad, pad)),
+        dimension_numbers=_DIMSPEC[layout])
+
+
+def _time(fn, args, iters):
+    out = jax.block_until_ready(fn(*args))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def probe(iters: int = 30):
+    dev = jax.devices()[0]
+    for name, b, h, w_, cin, cout, k, stride in SHAPES:
+        flops = 2.0 * b * (h // stride) * (w_ // stride) * cin * cout * k * k
+        rs = np.random.RandomState(0)
+        for layout in ("NHWC", "NCHW"):
+            if layout == "NHWC":
+                x = jnp.asarray(rs.randn(b, h, w_, cin), jnp.bfloat16)
+                kern = jnp.asarray(rs.randn(k, k, cin, cout), jnp.bfloat16)
+            else:
+                x = jnp.asarray(rs.randn(b, cin, h, w_), jnp.bfloat16)
+                kern = jnp.asarray(rs.randn(cout, cin, k, k), jnp.bfloat16)
+
+            fwd = jax.jit(lambda a, c: _conv(a, c, stride, layout))
+            loss = lambda a, c: jnp.sum(
+                _conv(a, c, stride, layout).astype(jnp.float32))
+            dgrad = jax.jit(jax.grad(loss, argnums=0))
+            wgrad = jax.jit(jax.grad(loss, argnums=1))
+
+            row = {"shape": name, "layout": layout,
+                   "gflops": round(flops / 1e9, 1),
+                   "device": dev.device_kind}
+            for pname, fn in (("fwd", fwd), ("dgrad", dgrad),
+                              ("wgrad", wgrad)):
+                dt = _time(fn, (x, kern), iters)
+                row[f"{pname}_ms"] = round(dt * 1e3, 3)
+                row[f"{pname}_tfs"] = round(flops / dt / 1e12, 2)
+            print(json.dumps(row), flush=True)
+
+
+if __name__ == "__main__":
+    probe(int(sys.argv[1]) if len(sys.argv) > 1 else 30)

@@ -56,6 +56,9 @@ if [ ! -f /tmp/synth_mnist_full/train-images-idx3-ubyte ]; then
 fi
 step "lenet_convergence" 1800 ./scripts/run_example.sh lenet /tmp/synth_mnist_full -b 128 --maxEpoch 20 --learningRate 0.1
 
+# where does the backward lose its 8 MFU points: per-pass conv layout probe
+step "conv_bwd_probe" 1500 python scripts/conv_bwd_probe.py 30
+
 # accuracy-vs-wall-clock on the chip (BASELINE's second metric)
 step "time_to_acc_cifar" 1200 python -m bigdl_tpu.cli.perf -m resnet20_cifar --timeToAcc 0.9 -b 128 --imageSize 32
 step "time_to_acc_resnet50" 2400 python -m bigdl_tpu.cli.perf -m resnet50 --timeToAcc 0.85 -b 64 --imageSize 224 --maxEpoch 15
