@@ -38,6 +38,8 @@ def main(path: str) -> None:
     pipe = [(s, r) for s, r in rows if r.get("metric") ==
             "input_pipeline_imagenet_shape"]
     tests = [(s, r) for s, r in rows if "pytest" in r]
+    tta = [(s, r) for s, r in rows if r.get("metric") == "time_to_acc"]
+    convp = [(s, r) for s, r in rows if "dgrad_tfs" in r]
 
     if perf:
         print("### Training throughput / MFU\n")
@@ -65,6 +67,26 @@ def main(path: str) -> None:
         print("### Input pipeline\n")
         for _, r in pipe:
             print(f"- {r}")
+        print()
+    if tta:
+        print("### Time to accuracy\n")
+        print("| run | model | target | reached | t (s) | final top1 | "
+              "epochs | device |")
+        print("|---|---|---|---|---|---|---|---|")
+        for s, r in tta:
+            print(f"| {s} | {r.get('model')} | {r.get('target_top1')} "
+                  f"| {r.get('reached')} | {r.get('time_to_acc_s')} "
+                  f"| {r.get('final_top1')} | {r.get('epochs_run')} "
+                  f"| {r.get('device')} |")
+        print()
+    if convp:
+        print("### Conv backward layout probe (TF/s)\n")
+        print("| shape | layout | fwd | dgrad | wgrad |")
+        print("|---|---|---|---|---|")
+        for _, r in convp:
+            print(f"| {r.get('shape')} | {r.get('layout')} "
+                  f"| {r.get('fwd_tfs')} | {r.get('dgrad_tfs')} "
+                  f"| {r.get('wgrad_tfs')} |")
         print()
     if tests:
         print("### Test runs\n")
