@@ -333,6 +333,10 @@ class _ExportCtx:
 
             self.aval = jax.eval_shape(lambda x: x, example_input)
         self.perm: Optional[np.ndarray] = None
+        # set when a Reshape/View is exported without a live aval: the CHW
+        # permutation question could not be answered, so a following Linear
+        # must refuse rather than silently write NHWC-ordered rows
+        self.blind_flatten = False
 
     def advance(self, mod, p, s):
         if self.aval is None:
@@ -402,6 +406,12 @@ def _export(mod, p, s, ctx: _ExportCtx) -> TorchObject:
         return _obj("CAddTable", {"inplace": False})
 
     if isinstance(mod, nn.Linear):
+        if ctx.blind_flatten:
+            raise ValueError(
+                "Reshape->Linear export without shape tracking: pass "
+                "example_input to save_torch_module so the CHW flatten "
+                "permutation can be computed; exporting blind would write "
+                "NHWC-ordered Linear rows that torch consumers misread")
         w = _np(p["weight"])                       # ours: (in, out)
         if ctx.perm is not None:
             if ctx.perm.shape[0] != w.shape[0]:
@@ -477,6 +487,8 @@ def _export(mod, p, s, ctx: _ExportCtx) -> TorchObject:
             # -> permute the next Linear's rows (consumed above)
             b, h, w_, c = in_aval.shape
             ctx.perm = _perm_chw(h, w_, c)
+        elif in_aval is None:
+            ctx.blind_flatten = True
         ctx.advance(mod, p, s)
         return _obj("View", {"size": size,
                              "numElements": float(int(np.prod(size)))})

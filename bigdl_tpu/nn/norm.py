@@ -20,6 +20,7 @@ __all__ = [
     "BatchNormalization",
     "set_bn_stat_sample",
     "set_bn_fused",
+    "unfuse_bn_for_spmd",
     "SpatialBatchNormalization",
     "SpatialCrossMapLRN",
     "SpatialSubtractiveNormalization",
@@ -158,6 +159,23 @@ def set_bn_fused(module, fused: bool = True):
     for ch in getattr(module, "children", lambda: ())() or ():
         set_bn_fused(ch, fused)
     return module
+
+
+def unfuse_bn_for_spmd(module, n_devices: int) -> int:
+    """Disable ``fused`` (Pallas) BN stats before compiling a step over a
+    multi-device mesh: ``pallas_call`` carries no GSPMD partitioning rule,
+    so a batch-sharded activation would be replicated onto every device
+    (memory/perf cliff) or fail to lower — defeating the kernel's purpose.
+    Called by the Optimizer's distributed compile path; returns the number
+    of modules switched back to the jnp stats path."""
+    count = 0
+    if n_devices > 1:
+        if isinstance(module, BatchNormalization) and module.fused:
+            module.fused = False
+            count += 1
+        for ch in getattr(module, "children", lambda: ())() or ():
+            count += unfuse_bn_for_spmd(ch, n_devices)
+    return count
 
 
 class SpatialBatchNormalization(BatchNormalization):

@@ -84,9 +84,11 @@ def bn_stats(x2d: jax.Array) -> Tuple[jax.Array, jax.Array]:
     rows, c = x2d.shape
     rb = min(_ROW_BLOCK, rows)
     cb = min(_C_BLOCK, c)
-    if rows % rb or c % cb:
-        raise ValueError(f"bn_stats needs rows%{rb}==0 and C%{cb}==0, "
-                         f"got {x2d.shape}")
+    # rows%8 / c%128 are Mosaic's sublane/lane minima — without them the
+    # call lowers in interpret mode but compile-fails on real TPU
+    if rows % rb or c % cb or rows % 8 or c % 128:
+        raise ValueError(f"bn_stats needs rows%{rb}==0, rows%8==0, "
+                         f"C%{cb}==0 and C%128==0, got {x2d.shape}")
     grid = (c // cb, rows // rb)
     out_shape = [
         jax.ShapeDtypeStruct((1, c), jnp.float32),
@@ -131,9 +133,9 @@ def bn_bwd_stats(dy2d: jax.Array, xhat2d: jax.Array):
     rows, c = dy2d.shape
     rb = min(_ROW_BLOCK, rows)
     cb = min(_C_BLOCK, c)
-    if rows % rb or c % cb:
-        raise ValueError(f"bn_bwd_stats needs rows%{rb}==0 and C%{cb}==0, "
-                         f"got {dy2d.shape}")
+    if rows % rb or c % cb or rows % 8 or c % 128:
+        raise ValueError(f"bn_bwd_stats needs rows%{rb}==0, rows%8==0, "
+                         f"C%{cb}==0 and C%128==0, got {dy2d.shape}")
     grid = (c // cb, rows // rb)
     sdy, sdyx = pl.pallas_call(
         _bwd_kernel,
@@ -203,7 +205,9 @@ def _fused_vjp_bwd(eps, res, cts):
     dy2 = dy.reshape(rows, c)
     xhat2 = ((x.reshape(rows, c).astype(jnp.float32) - mean) * inv)
     if _tileable(rows, c):
-        sdy, sdyx = bn_bwd_stats(dy2, xhat2.astype(dy2.dtype))
+        # xhat stays f32 into the kernel (it upcasts per block anyway) so
+        # dgamma precision matches the jnp fallback under mixed precision
+        sdy, sdyx = bn_bwd_stats(dy2, xhat2)
     else:
         dyf = dy2.astype(jnp.float32)
         sdy, sdyx = jnp.sum(dyf, 0), jnp.sum(dyf * xhat2, 0)

@@ -268,6 +268,17 @@ class Optimizer:
             return new_params, new_ms, new_opt, loss
 
         if self.strategy is not None:
+            mesh = getattr(self.strategy, "mesh", None)
+            n_dev = mesh.size if mesh is not None else jax.device_count()
+            from bigdl_tpu.nn.norm import unfuse_bn_for_spmd
+            unfused = unfuse_bn_for_spmd(self.model, n_dev)
+            if unfused:
+                logger.warning(
+                    "fused BN disabled on %d module(s): pallas_call has no "
+                    "GSPMD partitioning rule, so the single-read stats "
+                    "kernel would replicate sharded activations under the "
+                    "%d-device mesh (jnp stats path used instead)",
+                    unfused, n_dev)
             return self.strategy.compile_step(train_step)
         return jax.jit(train_step, donate_argnums=(0, 1, 2))
 
@@ -406,8 +417,9 @@ class Optimizer:
             **{m.name.replace(" ", "_"): r.result()[0]
                for m, r in zip(self._val_methods, results)}})
         driver["val_results"] = results
-        # first method's scalar drives Trigger.max_score (time-to-accuracy)
-        driver["val_score"] = float(results[0].result()[0])
+        if results:
+            # first method's scalar drives Trigger.max_score (time-to-acc)
+            driver["val_score"] = float(results[0].result()[0])
         return results
 
     # -------------------------------------------------------- summaries

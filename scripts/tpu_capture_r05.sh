@@ -1,0 +1,69 @@
+#!/usr/bin/env bash
+# Round-5 capture: chip evidence for VERDICT r4 item 1 — compiled kernels,
+# clean b128 + transformer_lm_1k MFU, flash rows, and the lever A/Bs
+# (s2d, innerSteps, bnss, and the new fused-BN Pallas stats kernel).
+# Appends to $OUT, mirrored into the repo per step.
+
+
+set -uo pipefail
+cd "$(dirname "$0")/.."
+OUT="${OUT:-/tmp/tpu_capture_r05.log}"
+REPO_LOG="${REPO_LOG:-TPU_CAPTURE_r05.log}"
+trap 'cp -f "$OUT" "$REPO_LOG" 2>/dev/null || true' EXIT
+
+step() {
+  local name="$1" tmo="$2"; shift 2
+  echo "=== $name ($(date -u +%H:%M:%SZ))" | tee -a "$OUT"
+  timeout "$tmo" "$@" 2>&1 | tail -30 | tee -a "$OUT"
+  echo "=== end $name rc=$?" | tee -a "$OUT"
+  cp -f "$OUT" "$REPO_LOG" 2>/dev/null || true
+}
+
+# Ordered by evidentiary value so a short tunnel window still captures
+# the essentials (every step mirrors the log into the repo).
+
+# 1. compiled flash kernel: proves the lse-layout fix lowers on Mosaic
+step "pytest_tpu_marked" 1200 env BIGDL_TPU_TESTS=1 python -m pytest tests/ -m tpu -q
+
+# 2. clean headline number + the transformer datapoints
+step "perf_resnet50_b128" 900 python -m bigdl_tpu.cli.perf -m resnet50 -b 128 -i 20 --dataType random
+step "perf_transformer_lm_b32" 900 python -m bigdl_tpu.cli.perf -m transformer_lm -b 32 -i 10 --dataType random
+step "perf_transformer_lm_1k_b16" 900 python -m bigdl_tpu.cli.perf -m transformer_lm_1k -b 16 -i 10 --dataType random
+
+# 3. flash vs dense microbenchmark (incl. 16k/32k flash-only rows)
+step "flash_bench" 1800 python scripts/flash_bench.py 4 8 64
+
+# 4. lever A/Bs + the rest of the trajectory
+step "perf_resnet50_inner10_b128" 900 python -m bigdl_tpu.cli.perf -m resnet50 -b 128 -i 4 --innerSteps 10 --dataType random
+step "perf_resnet50_bnss_b128" 900 python -m bigdl_tpu.cli.perf -m resnet50_bnss -b 128 -i 20 --dataType random
+# round-4 lever: single-read Pallas BN stats (ops/bn_kernel.py) — exact
+# semantics, targets the 15.6 ms/step BN stat category head-on
+step "perf_resnet50_fbn_b128" 900 python -m bigdl_tpu.cli.perf -m resnet50_fbn -b 128 -i 20 --dataType random
+step "perf_resnet50_fbn_s2d_inner10" 900 python -m bigdl_tpu.cli.perf -m resnet50_fbn -b 128 -i 4 --innerSteps 10 --dataType random
+step "perf_resnet50_s2d_b128" 900 python -m bigdl_tpu.cli.perf -m resnet50_s2d -b 128 -i 20 --dataType random
+for B in 64 256 512; do
+  step "perf_resnet50_b$B" 900 python -m bigdl_tpu.cli.perf -m resnet50 -b "$B" -i 20 --dataType random
+done
+step "perf_transformer_lm_rope_b32" 900 python -m bigdl_tpu.cli.perf -m transformer_lm_rope -b 32 -i 10 --dataType random
+
+# train-from-storage: first capture's TPU attempt breached the default 900s
+# (JPEG generation shared the core with a pytest run); give it headroom
+step "bench_pipe" 2400 env BENCH_TPU_TIMEOUT=2000 BENCH_COMPANIONS=0 python bench.py resnet50_pipe 128 20
+
+# convergence on the chip (first capture lost it to the tunnel dropping)
+if [ ! -f /tmp/synth_mnist_full/train-images-idx3-ubyte ]; then
+  step "make_synth_mnist" 1200 python scripts/make_synth_mnist.py /tmp/synth_mnist_full 20000 4000
+fi
+step "lenet_convergence" 1800 ./scripts/run_example.sh lenet /tmp/synth_mnist_full -b 128 --maxEpoch 20 --learningRate 0.1
+
+# where does the backward lose its 8 MFU points: per-pass conv layout probe
+step "conv_bwd_probe" 1500 python scripts/conv_bwd_probe.py 30
+
+# accuracy-vs-wall-clock on the chip (BASELINE's second metric)
+step "time_to_acc_cifar" 1200 python -m bigdl_tpu.cli.perf -m resnet20_cifar --timeToAcc 0.9 -b 128 --imageSize 32
+step "time_to_acc_resnet50" 2400 python -m bigdl_tpu.cli.perf -m resnet50 --timeToAcc 0.85 -b 64 --imageSize 224 --maxEpoch 15
+
+# the official bench line last
+step "bench_main" 2400 python bench.py
+
+echo "capture2 complete -> $OUT"

@@ -186,3 +186,32 @@ def test_torchflatten_on_2d_is_plain_reshape():
     y = m.apply({}, {}, jnp.arange(12.0).reshape(2, 6))[0]
     np.testing.assert_allclose(np.asarray(y),
                                np.arange(12.0).reshape(2, 6))
+
+
+def test_export_blind_flatten_into_linear_refuses(tmp_path):
+    """Without example_input the conv->Reshape->Linear CHW permutation
+    cannot be computed; the export must raise instead of silently writing
+    NHWC-ordered Linear rows (advisor r4)."""
+    model = Sequential(
+        nn.SpatialConvolution(3, 8, 3, 3, pad_w=1, pad_h=1),
+        nn.Reshape([8 * 8 * 8]),
+        nn.Linear(8 * 8 * 8, 10),
+    )
+    params = model.init(jax.random.PRNGKey(0))
+    state = model.init_state()
+    with pytest.raises(ValueError, match="without shape tracking"):
+        save_torch_module(model, params, state, str(tmp_path / "b.t7"))
+
+
+def test_export_linear_only_without_example_input_ok(tmp_path):
+    """No flatten in the chain -> example_input stays optional."""
+    model = Sequential(nn.Linear(6, 4), nn.Tanh(), nn.Linear(4, 2))
+    params = model.init(jax.random.PRNGKey(0))
+    state = model.init_state()
+    path = str(tmp_path / "lin.t7")
+    save_torch_module(model, params, state, path)
+    model2, params2, state2 = load_torch_module(path)
+    x = jnp.asarray(np.random.RandomState(0).randn(3, 6), jnp.float32)
+    np.testing.assert_allclose(
+        np.asarray(model.apply(params, state, x)[0]),
+        np.asarray(model2.apply(params2, state2, x)[0]), atol=1e-5)
