@@ -35,6 +35,18 @@ import sys
 TPU_TIMEOUT = int(os.environ.get("BENCH_TPU_TIMEOUT", "900"))
 CPU_TIMEOUT = int(os.environ.get("BENCH_CPU_TIMEOUT", "900"))
 PROBE_TIMEOUT = int(os.environ.get("BENCH_PROBE_TIMEOUT", "150"))
+# a successful TPU probe is cached for this long; inside one tunnel
+# window, later invocations probe with a tightened timeout (the probe
+# still runs — a mid-window tunnel drop must be detected, not assumed away)
+PROBE_CACHE_TTL = int(os.environ.get("BENCH_PROBE_CACHE_TTL", "900"))
+PROBE_CACHE = os.environ.get("BENCH_PROBE_CACHE",
+                             "/tmp/bigdl_bench_probe_ok")
+# every TPU-backed result is appended here the moment it lands, so a
+# tunnel drop (or the driver killing us) mid-sweep keeps partial evidence
+PARTIAL_LOG = os.environ.get(
+    "BENCH_PARTIAL_LOG",
+    os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                 "BENCH_PARTIAL.jsonl"))
 
 
 def child(backend: str, model: str, batch: int, iters: int) -> None:
@@ -132,13 +144,37 @@ def _emit():
         print(json.dumps(_line), flush=True)
 
 
+def _partial(tag: str, row) -> None:
+    """Append one timestamped JSON line of evidence immediately (flushed) —
+    a killed run must still leave every TPU row it produced."""
+    import time
+
+    try:
+        with open(PARTIAL_LOG, "a") as f:
+            f.write(json.dumps({"tag": tag, "t": int(time.time()),
+                                **(row or {})}) + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+    except OSError:
+        pass
+
+
 def _build_line(model, result, companions, errors):
+    # vs_baseline must be unmistakable on degraded rows: a CPU fallback
+    # carrying 0.0 reads as "at parity" on a dashboard (VERDICT r4 weak
+    # #7) — null means "no comparable measurement", never parity
+    on_tpu = result is not None and result.get("backend") == "tpu"
     line = {
         "metric": f"{model}_train_throughput",
         "value": 0.0,
         "unit": "images/sec/chip",
-        "vs_baseline": 0.0,  # BASELINE.json publishes no reference number
+        # BASELINE.json publishes no reference img/s number; 0.0 = "TPU
+        # measurement, baseline unpublished", null = "not a TPU number"
+        "vs_baseline": 0.0 if on_tpu else None,
     }
+    if not on_tpu:
+        line["degraded"] = ("no result" if result is None
+                            else f"{result.get('backend')}-fallback")
     if result is not None:
         line.update({
             "metric": (f"{model}_train_throughput_b{result['batch']}"
@@ -191,17 +227,42 @@ def main() -> None:
     errors = []
     result = None
     companions = {}
-    probe, perr = _attempt("probe", model, batch, iters, PROBE_TIMEOUT)
+    import time
+
+    # A fresh successful probe cached by a previous invocation shortens the
+    # probe timeout (a live tunnel answers in well under 90 s) — it must
+    # not SKIP the probe: the tunnel can drop mid-window, and an unprobed
+    # "default" attempt would then burn TPU_TIMEOUT on the cpu backend.
+    probe_timeout = PROBE_TIMEOUT
+    try:
+        if time.time() - os.path.getmtime(PROBE_CACHE) < PROBE_CACHE_TTL:
+            probe_timeout = min(PROBE_TIMEOUT, 90)
+    except OSError:
+        pass
+    tpu_up = False
+    probe, perr = _attempt("probe", model, batch, iters, probe_timeout)
     if probe is None:
         errors.append(f"backend probe failed ({perr}); skipping to cpu")
     elif probe.get("probe") != "tpu":
-        # default backend resolved to something slow (cpu) — don't burn
-        # TPU_TIMEOUT running the full-size config on it
+        # default backend resolved to something slow (cpu) — don't
+        # burn TPU_TIMEOUT running the full-size config on it
         errors.append(f"default backend is {probe.get('probe')}, not tpu")
     else:
+        tpu_up = True
+    try:
+        if tpu_up:
+            with open(PROBE_CACHE, "w") as f:
+                f.write(json.dumps(probe))
+        elif os.path.exists(PROBE_CACHE):
+            os.unlink(PROBE_CACHE)  # stale: tunnel dropped
+    except OSError:
+        pass
+    if tpu_up:
         result, err = _attempt("default", model, batch, iters, TPU_TIMEOUT)
         if err:
             errors.append(err)
+        if result is not None and result.get("backend") == "tpu":
+            _partial("headline", result)
         _line = _build_line(model, result, companions, errors)
         if result is not None and os.environ.get(
                 "BENCH_COMPANIONS", "1") != "0":
@@ -230,6 +291,8 @@ def main() -> None:
                             "time_to_acc_s", "target_top1", "reached",
                             "final_top1")
                         if cres.get(k) is not None}
+                    if cres.get("backend") == "tpu":
+                        _partial(cname, cres)
                 else:
                     companions[cname] = {"error": cerr}
                 _line = _build_line(model, result, companions, errors)

@@ -27,8 +27,33 @@ import jax  # noqa: E402
 if not os.environ.get("BIGDL_TPU_TESTS"):
     jax.config.update("jax_platforms", "cpu")
 
+import re  # noqa: E402
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
+
+# The suite may skip ONLY for a missing runtime dependency: the real TPU
+# backend, pytorch (golden-test oracle), or the native C++ library/libjpeg.
+# Any other skip reason is turned into a test failure so coverage cannot
+# silently shrink (VERDICT r4 item 8; the reference gates explicitly too,
+# torch/TH.scala:36-40).
+_ALLOWED_SKIP = re.compile(
+    r"TPU backend|torch|native lib|libjpeg", re.IGNORECASE)
+
+
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_makereport(item, call):
+    outcome = yield
+    report = outcome.get_result()
+    if report.skipped and not hasattr(report, "wasxfail"):
+        lr = report.longrepr
+        reason = lr[2] if isinstance(lr, tuple) else str(lr)
+        if not _ALLOWED_SKIP.search(reason):
+            report.outcome = "failed"
+            report.longrepr = (
+                f"disallowed skip reason {reason!r} — the suite may only "
+                "skip for a missing TPU backend, torch, or the native "
+                "library (tests/conftest.py)")
 
 
 def pytest_configure(config):
