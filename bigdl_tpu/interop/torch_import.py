@@ -129,6 +129,35 @@ def _conv(obj):
     return mod, p, {}
 
 
+def _conv_map(obj):
+    """Torch SpatialConvolutionMap (reference reader
+    TorchFile.scala:922-939): ``weight`` is per-connection (nPairs, kH,
+    kW), ``connTable`` (nPairs, 2) 1-based (in, out). Our module is the
+    masked-dense MXU form, so scatter each pair's kernel into the dense
+    HWIO weight — the fixed binary mask zeroes everything else."""
+    from bigdl_tpu import nn
+
+    f = obj.fields
+    ct = np.asarray(f["connTable"], np.float32).astype(np.int64) - 1
+    kw, kh = _int(f, "kW"), _int(f, "kH")
+    # honor explicit plane counts when present: a legal table may leave
+    # the highest-numbered plane unconnected, so inference undercounts
+    n_in = _int(f, "nInputPlane", 0) or None
+    n_out = _int(f, "nOutputPlane", 0) or None
+    mod = nn.SpatialConvolutionMap(
+        ct, kw, kh,
+        stride_w=_int(f, "dW", 1), stride_h=_int(f, "dH", 1),
+        pad_w=_int(f, "padW", 0), pad_h=_int(f, "padH", 0),
+        n_input_plane=n_in, n_output_plane=n_out)
+    w = np.asarray(f["weight"], np.float32).reshape(len(ct), kh, kw)
+    dense = np.zeros((kh, kw, mod.n_input_plane, mod.n_output_plane),
+                     np.float32)
+    dense[:, :, ct[:, 0], ct[:, 1]] = np.transpose(w, (1, 2, 0))
+    p = {"weight": dense,
+         "bias": np.asarray(f["bias"], np.float32)}
+    return mod, p, {}
+
+
 def _maxpool(obj):
     from bigdl_tpu import nn
 
@@ -235,6 +264,7 @@ _BUILDERS = {
     "Linear": _linear,
     "SpatialConvolution": _conv,
     "SpatialConvolutionMM": _conv,
+    "SpatialConvolutionMap": _conv_map,
     "SpatialMaxPooling": _maxpool,
     "SpatialAveragePooling": _avgpool,
     "BatchNormalization": lambda o: _batchnorm(o, spatial=False),
@@ -425,6 +455,22 @@ def _export(mod, p, s, ctx: _ExportCtx) -> TorchObject:
         ctx.advance(mod, p, s)
         return _obj("Linear", {"weight": np.ascontiguousarray(w.T),
                                "bias": bias})
+
+    if isinstance(mod, nn.SpatialConvolutionMap):
+        w = _np(p["weight"])                       # dense HWIO, masked
+        ct = mod.conn_table                        # (nPairs, 2) 0-based
+        per_pair = np.transpose(w[:, :, ct[:, 0], ct[:, 1]], (2, 0, 1))
+        ctx.advance(mod, p, s)
+        return _obj("SpatialConvolutionMap", {
+            "connTable": (ct + 1).astype(np.float64),   # torch is 1-based
+            "kW": float(mod.kernel_w), "kH": float(mod.kernel_h),
+            "dW": float(mod.stride_w), "dH": float(mod.stride_h),
+            "padW": float(mod.pad_w), "padH": float(mod.pad_h),
+            "nInputPlane": float(mod.n_input_plane),
+            "nOutputPlane": float(mod.n_output_plane),
+            "weight": np.ascontiguousarray(per_pair),
+            "bias": _np(p["bias"]),
+        })
 
     if isinstance(mod, nn.SpatialConvolution):
         w = _np(p["weight"])                       # HWIO

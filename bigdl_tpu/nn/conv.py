@@ -96,13 +96,24 @@ class SpatialConvolution(SimpleModule):
 
     def _forward(self, params, x, *, training, rng):
         w = params["weight"].astype(x.dtype)
-        y = lax.conv_general_dilated(
-            x, w,
-            window_strides=(self.stride_h, self.stride_w),
-            padding=((self.pad_h, self.pad_h), (self.pad_w, self.pad_w)),
-            dimension_numbers=DIMSPEC,
-            feature_group_count=self.n_group,
-        )
+        from bigdl_tpu.ops import conv2d as _c2d
+
+        if not _c2d.is_default_policy():
+            # a conv_bwd_probe decision is installed: route through the
+            # per-pass-layout custom vjp (ops/conv2d.py) so each of
+            # fwd/dgrad/wgrad compiles under its probe-winning layout
+            y = _c2d.conv2d(
+                x, w, (self.stride_h, self.stride_w),
+                ((self.pad_h, self.pad_h), (self.pad_w, self.pad_w)),
+                (1, 1), self.n_group)
+        else:
+            y = lax.conv_general_dilated(
+                x, w,
+                window_strides=(self.stride_h, self.stride_w),
+                padding=((self.pad_h, self.pad_h), (self.pad_w, self.pad_w)),
+                dimension_numbers=DIMSPEC,
+                feature_group_count=self.n_group,
+            )
         if self.with_bias:
             y = y + params["bias"].astype(y.dtype)
         return y
@@ -230,13 +241,21 @@ class SpatialDilatedConvolution(SpatialConvolution):
 
     def _forward(self, params, x, *, training, rng):
         w = params["weight"].astype(x.dtype)
-        y = lax.conv_general_dilated(
-            x, w,
-            window_strides=(self.stride_h, self.stride_w),
-            padding=((self.pad_h, self.pad_h), (self.pad_w, self.pad_w)),
-            rhs_dilation=(self.dilation_h, self.dilation_w),
-            dimension_numbers=DIMSPEC,
-        )
+        from bigdl_tpu.ops import conv2d as _c2d
+
+        if not _c2d.is_default_policy():
+            y = _c2d.conv2d(
+                x, w, (self.stride_h, self.stride_w),
+                ((self.pad_h, self.pad_h), (self.pad_w, self.pad_w)),
+                (self.dilation_h, self.dilation_w), 1)
+        else:
+            y = lax.conv_general_dilated(
+                x, w,
+                window_strides=(self.stride_h, self.stride_w),
+                padding=((self.pad_h, self.pad_h), (self.pad_w, self.pad_w)),
+                rhs_dilation=(self.dilation_h, self.dilation_w),
+                dimension_numbers=DIMSPEC,
+            )
         if self.with_bias:
             y = y + params["bias"].astype(y.dtype)
         return y
@@ -253,13 +272,22 @@ class SpatialConvolutionMap(SimpleModule):
 
     def __init__(self, conn_table, kernel_w: int, kernel_h: int,
                  stride_w: int = 1, stride_h: int = 1,
-                 pad_w: int = 0, pad_h: int = 0, name: Optional[str] = None):
+                 pad_w: int = 0, pad_h: int = 0,
+                 n_input_plane: Optional[int] = None,
+                 n_output_plane: Optional[int] = None,
+                 name: Optional[str] = None):
         super().__init__(name)
         ct = np.asarray(conn_table, np.int32)
         assert ct.ndim == 2 and ct.shape[1] == 2
         self.conn_table = ct
-        self.n_input_plane = int(ct[:, 0].max()) + 1
-        self.n_output_plane = int(ct[:, 1].max()) + 1
+        # explicit plane counts matter when the table leaves the highest
+        # plane unconnected (legal in torch's nn.tables.random)
+        self.n_input_plane = (int(ct[:, 0].max()) + 1
+                              if n_input_plane is None else n_input_plane)
+        self.n_output_plane = (int(ct[:, 1].max()) + 1
+                               if n_output_plane is None else n_output_plane)
+        assert ct[:, 0].max() < self.n_input_plane
+        assert ct[:, 1].max() < self.n_output_plane
         self.kernel_w, self.kernel_h = kernel_w, kernel_h
         self.stride_w, self.stride_h = stride_w, stride_h
         self.pad_w, self.pad_h = pad_w, pad_h

@@ -215,3 +215,70 @@ def test_export_linear_only_without_example_input_ok(tmp_path):
     np.testing.assert_allclose(
         np.asarray(model.apply(params, state, x)[0]),
         np.asarray(model2.apply(params2, state2, x)[0]), atol=1e-5)
+
+
+def test_spatial_convolution_map_import_matches_torch_oracle():
+    """SpatialConvolutionMap .t7 import (reference reader
+    TorchFile.scala:922-939): per-pair (nPairs, kH, kW) kernels + 1-based
+    connTable scatter into our masked-dense HWIO weight, semantics checked
+    against a pytorch grouped/manual oracle in NCHW."""
+    rs = np.random.RandomState(11)
+    # partial connectivity: out0 <- in0,in1; out1 <- in2; out2 <- in0,in2
+    ct1 = np.asarray([[1, 1], [2, 1], [3, 2], [1, 3], [3, 3]], np.float64)
+    w = rs.randn(5, 3, 3).astype(np.float32)
+    b = rs.randn(3).astype(np.float32)
+    obj = _t7_obj("SpatialConvolutionMap", connTable=ct1,
+                  kW=3.0, kH=3.0, dW=1.0, dH=1.0, padW=1.0, padH=1.0,
+                  weight=w, bias=b)
+    mod, params, state = load_torch_module(obj)
+    x_nchw = rs.randn(2, 3, 6, 6).astype(np.float32)
+
+    # oracle: dense conv with kernels scattered per connection, in NCHW
+    dense = np.zeros((3, 3, 3, 3), np.float32)            # OIHW
+    for k, (i1, o1) in enumerate(ct1.astype(int)):
+        dense[o1 - 1, i1 - 1] = w[k]
+    want = torch.nn.functional.conv2d(
+        torch.from_numpy(x_nchw), torch.from_numpy(dense),
+        torch.from_numpy(b), padding=1).numpy()
+
+    x_nhwc = jnp.asarray(np.transpose(x_nchw, (0, 2, 3, 1)))
+    got, _ = mod.apply(params, state, x_nhwc, training=False)
+    np.testing.assert_allclose(np.transpose(np.asarray(got), (0, 3, 1, 2)),
+                               want, atol=1e-5)
+
+
+def test_spatial_convolution_map_roundtrip(tmp_path):
+    """export -> import -> identical outputs (and identical connTable)."""
+    table = nn.SpatialConvolutionMap.one_to_one(4)
+    model = Sequential(nn.SpatialConvolutionMap(table, 3, 3,
+                                                pad_w=1, pad_h=1),
+                       nn.ReLU())
+    params = model.init(jax.random.PRNGKey(5))
+    state = model.init_state()
+    path = str(tmp_path / "cm.t7")
+    save_torch_module(model, params, state, path)
+    model2, params2, state2 = load_torch_module(path)
+    np.testing.assert_array_equal(model2.children()[0].conn_table, table)
+    x = jnp.asarray(np.random.RandomState(1).randn(2, 5, 5, 4), jnp.float32)
+    np.testing.assert_allclose(
+        np.asarray(model.apply(params, state, x)[0]),
+        np.asarray(model2.apply(params2, state2, x)[0]), atol=1e-5)
+
+
+def test_spatial_convolution_map_unconnected_trailing_plane():
+    """A legal torch table may leave the highest-numbered plane
+    unconnected; the importer must honor the file's nInputPlane/
+    nOutputPlane instead of inferring from the table max (review r5)."""
+    rs = np.random.RandomState(3)
+    # 4 input planes, 3 output planes; plane 4 (in) and 3 (out) unused
+    ct1 = np.asarray([[1, 1], [2, 1], [3, 2]], np.float64)
+    obj = _t7_obj("SpatialConvolutionMap", connTable=ct1,
+                  kW=3.0, kH=3.0, dW=1.0, dH=1.0, padW=1.0, padH=1.0,
+                  nInputPlane=4.0, nOutputPlane=3.0,
+                  weight=rs.randn(3, 3, 3).astype(np.float32),
+                  bias=rs.randn(3).astype(np.float32))
+    mod, params, state = load_torch_module(obj)
+    assert mod.n_input_plane == 4 and mod.n_output_plane == 3
+    x = jnp.asarray(rs.randn(2, 5, 5, 4), jnp.float32)
+    y, _ = mod.apply(params, state, x, training=False)
+    assert y.shape == (2, 5, 5, 3)
