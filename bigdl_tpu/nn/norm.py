@@ -170,11 +170,10 @@ def unfuse_bn_for_spmd(module, n_devices: int) -> int:
     of modules switched back to the jnp stats path."""
     count = 0
     if n_devices > 1:
-        if isinstance(module, BatchNormalization) and module.fused:
-            module.fused = False
-            count += 1
-        for ch in getattr(module, "children", lambda: ())() or ():
-            count += unfuse_bn_for_spmd(ch, n_devices)
+        for m in module.modules():
+            if isinstance(m, BatchNormalization) and m.fused:
+                m.fused = False
+                count += 1
     return count
 
 

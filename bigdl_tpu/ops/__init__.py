@@ -12,6 +12,10 @@ from bigdl_tpu.ops.attention_kernel import (
     blockwise_attention, flash_attention,
 )
 from bigdl_tpu.ops.bn_kernel import bn_stats, bn_bwd_stats, fused_bn_train
+from bigdl_tpu.ops.conv2d import (decide_from_probe, get_conv_pass_layouts,
+                                  set_conv_pass_layouts)
 
 __all__ = ["flash_attention", "blockwise_attention",
-           "bn_stats", "bn_bwd_stats", "fused_bn_train"]
+           "bn_stats", "bn_bwd_stats", "fused_bn_train",
+           "set_conv_pass_layouts", "get_conv_pass_layouts",
+           "decide_from_probe"]

@@ -1,0 +1,36 @@
+"""Turn conv_bwd_probe output into a conv layout decision.
+
+Reads probe JSONL rows (file args or stdin), aggregates per-pass totals
+via ops.conv2d, prints the winning ``FWD,DGRAD,WGRAD`` string on stdout
+(consumable by ``perf --convLayout $(...)``) and the per-pass totals on
+stderr.
+
+Usage:
+    python scripts/conv_bwd_probe.py 30 | tee /tmp/probe.jsonl
+    python -m bigdl_tpu.cli.perf -m resnet50 -b 128 -i 20 \
+        --convLayout "$(python scripts/apply_conv_probe.py /tmp/probe.jsonl)"
+"""
+
+import fileinput
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from bigdl_tpu.ops.conv2d import (_PASSES, decide_from_probe,  # noqa: E402
+                                  probe_totals)
+
+
+def main():
+    lines = list(fileinput.input())
+    totals = probe_totals(lines)
+    decision = decide_from_probe(lines)
+    for p in _PASSES:
+        t = totals[p]
+        print(f"{p}: NHWC {t['NHWC']:.1f} ms vs NCHW {t['NCHW']:.1f} ms "
+              f"-> {decision[p]}", file=sys.stderr)
+    print(",".join(decision[p] for p in _PASSES))
+
+
+if __name__ == "__main__":
+    main()

@@ -188,3 +188,102 @@ def test_bilinear_filler_reference_vs_upsample_variants():
     wu = np.asarray(up.init(jax.random.PRNGKey(0))["weight"])
     np.testing.assert_allclose(wu[:, :, 0, 0], tri, atol=1e-6)
     assert np.all(wu[:, :, 0, 1] == 0)  # cross-channel taps zeroed
+
+
+class TestConvLayoutPolicy:
+    """Per-pass conv layout policy (ops/conv2d.py, VERDICT r4 weak #4):
+    any fwd/dgrad/wgrad layout combination must be numerically identical
+    to the default NHWC path — the policy only steers XLA's layout
+    assignment, never the math."""
+
+    def teardown_method(self):
+        from bigdl_tpu.ops import set_conv_pass_layouts
+        set_conv_pass_layouts()  # restore default
+
+    def _loss_and_grads(self, mod, params, x):
+        def loss(p, xx):
+            y, _ = mod.apply(p, {}, xx, training=True)
+            return jnp.sum(jnp.square(y.astype(jnp.float32)))
+
+        l, g = jax.value_and_grad(loss, argnums=(0, 1))(params, x)
+        return np.asarray(l), jax.tree_util.tree_map(np.asarray, g)
+
+    @pytest.mark.parametrize("layouts", [
+        ("NCHW", "NCHW", "NCHW"),
+        ("NHWC", "NCHW", "NHWC"),
+        ("NHWC", "NHWC", "NCHW"),
+        ("NCHW", "NHWC", "NHWC"),
+    ])
+    def test_policy_matches_default_path(self, layouts, rng):
+        from bigdl_tpu import nn
+        from bigdl_tpu.ops import set_conv_pass_layouts
+
+        mod = nn.SpatialConvolution(3, 8, 3, 3, stride_w=2, stride_h=2,
+                                    pad_w=1, pad_h=1)
+        params = mod.init(rng)
+        x = jnp.asarray(np.random.RandomState(0).randn(2, 8, 8, 3),
+                        jnp.float32)
+        l0, (gp0, gx0) = self._loss_and_grads(mod, params, x)
+        set_conv_pass_layouts(*layouts)
+        l1, (gp1, gx1) = self._loss_and_grads(mod, params, x)
+        np.testing.assert_allclose(l1, l0, rtol=1e-5)
+        np.testing.assert_allclose(gx1, gx0, atol=1e-4)
+        np.testing.assert_allclose(gp1["weight"], gp0["weight"], atol=1e-4)
+        np.testing.assert_allclose(gp1["bias"], gp0["bias"], atol=1e-4)
+
+    def test_grouped_and_dilated_under_policy(self, rng):
+        from bigdl_tpu import nn
+        from bigdl_tpu.ops import set_conv_pass_layouts
+
+        g = nn.SpatialConvolution(4, 8, 3, 3, pad_w=1, pad_h=1, n_group=2)
+        d = nn.SpatialDilatedConvolution(3, 6, 3, 3, pad_w=2, pad_h=2,
+                                         dilation_w=2, dilation_h=2)
+        gp, dp = g.init(rng), d.init(jax.random.PRNGKey(9))
+        xg = jnp.asarray(np.random.RandomState(1).randn(2, 6, 6, 4),
+                         jnp.float32)
+        xd = jnp.asarray(np.random.RandomState(2).randn(2, 7, 7, 3),
+                         jnp.float32)
+        lg0, (ggp0, ggx0) = self._loss_and_grads(g, gp, xg)
+        ld0, (dgp0, dgx0) = self._loss_and_grads(d, dp, xd)
+        set_conv_pass_layouts("NCHW", "NCHW", "NCHW")
+        lg1, (ggp1, ggx1) = self._loss_and_grads(g, gp, xg)
+        ld1, (dgp1, dgx1) = self._loss_and_grads(d, dp, xd)
+        np.testing.assert_allclose(lg1, lg0, rtol=1e-5)
+        np.testing.assert_allclose(ld1, ld0, rtol=1e-5)
+        np.testing.assert_allclose(ggx1, ggx0, atol=1e-4)
+        np.testing.assert_allclose(dgx1, dgx0, atol=1e-4)
+        np.testing.assert_allclose(ggp1["weight"], ggp0["weight"], atol=1e-4)
+        np.testing.assert_allclose(dgp1["weight"], dgp0["weight"], atol=1e-4)
+
+    def test_decide_from_probe(self):
+        from bigdl_tpu.ops import decide_from_probe
+
+        rows = [
+            {"layout": "NHWC", "fwd_ms": 1.0, "dgrad_ms": 5.0,
+             "wgrad_ms": 2.0},
+            {"layout": "NCHW", "fwd_ms": 2.0, "dgrad_ms": 3.0,
+             "wgrad_ms": 2.5},
+            {"layout": "NHWC", "fwd_ms": 1.0, "dgrad_ms": 5.0,
+             "wgrad_ms": 2.0},
+            {"layout": "NCHW", "fwd_ms": 2.0, "dgrad_ms": 3.0,
+             "wgrad_ms": 2.5},
+        ]
+        import json as _json
+        d = decide_from_probe([_json.dumps(r) for r in rows])
+        assert d == {"fwd": "NHWC", "dgrad": "NCHW", "wgrad": "NHWC"}
+        with pytest.raises(ValueError, match="no probe rows"):
+            decide_from_probe(["not json", ""])
+
+
+def test_decide_from_probe_rejects_truncated_coverage():
+    """A tunnel-drop-truncated probe leaves one layout with fewer rows
+    (or none) — deciding from that would let an unmeasured layout win at
+    0.0 ms (review r5)."""
+    import json as _json
+
+    from bigdl_tpu.ops import decide_from_probe
+
+    only_nhwc = [_json.dumps({"layout": "NHWC", "fwd_ms": 1.0,
+                              "dgrad_ms": 1.0, "wgrad_ms": 1.0})]
+    with pytest.raises(ValueError, match="asymmetric probe coverage"):
+        decide_from_probe(only_nhwc)
