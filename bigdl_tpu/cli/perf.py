@@ -493,10 +493,29 @@ def main(argv=None):
     p.add_argument("--classes", type=int, default=10,
                    help="class count for --timeToAcc (pass 1000 with real "
                         "ImageNet shards via --data record:DIR)")
+    p.add_argument("--trainPerClass", type=int, default=200,
+                   help="synthetic train images per class for --timeToAcc "
+                        "(5000 = CIFAR-10 scale, the reference recipe "
+                        "models/resnet/README.md Training section)")
+    p.add_argument("--valPerClass", type=int, default=40,
+                   help="synthetic val images per class for --timeToAcc "
+                        "(1000 = CIFAR-10 scale)")
+    p.add_argument("--convLayout", default=None, metavar="FWD,DGRAD,WGRAD",
+                   help="per-pass conv activation layouts (NHWC|NCHW "
+                        "each), e.g. NHWC,NCHW,NCHW — install a "
+                        "scripts/conv_bwd_probe.py decision (see "
+                        "scripts/apply_conv_probe.py) before compiling")
     from bigdl_tpu.cli.common import _add_platform_arg, apply_platform
     _add_platform_arg(p)
     args = p.parse_args(argv)
     apply_platform(args)
+    if args.convLayout:
+        from bigdl_tpu.ops import set_conv_pass_layouts
+        parts = args.convLayout.upper().split(",")
+        if len(parts) != 3:
+            raise SystemExit("--convLayout wants FWD,DGRAD,WGRAD")
+        print("conv pass layouts:",
+              set_conv_pass_layouts(*parts), flush=True)
     if args.timeToAcc is not None:
         data_dir = None
         if args.data and args.data.startswith("record:"):
@@ -504,6 +523,8 @@ def main(argv=None):
         run_time_to_acc(args.model, args.batchSize, args.timeToAcc,
                         max_epochs=args.maxEpoch,
                         image_size=args.imageSize, classes=args.classes,
+                        train_per_class=args.trainPerClass,
+                        val_per_class=args.valPerClass,
                         use_bf16=not args.f32, data_dir=data_dir)
         return
     run(args.model, args.batchSize, args.iteration, args.dataType,

@@ -1,6 +1,27 @@
-"""ResNet on CIFAR-10 (reference models/resnet/{Train,Utils}.scala:
-depth-20/32/44/56/110 with basic blocks, momentum 0.9, weight decay 1e-4,
-nesterov; reference default optnet memory sharing is XLA's job here)."""
+"""ResNet training CLI (reference models/resnet/{Train,TrainImageNet,
+Utils}.scala).
+
+Two dataset families, as in the reference:
+
+* ``--dataset cifar10`` (default) — depth 6n+2 basic-block nets on CIFAR
+  folders, the reference Train.scala recipe (momentum 0.9, wd 1e-4,
+  nesterov, lr drops at epochs 81/122).
+* ``--dataset imagenet`` — depth 18/34/50/101/152 on an ImageNet-style
+  label-by-folder tree at 224x224 (reference TrainImageNet.scala).
+
+TPU perf levers are first-class flags here, not perf-harness-only
+(VERDICT r4 item 3; the reference exposes its perf knobs on the CLI the
+same way, models/inception/Options.scala:134):
+
+* ``--s2d`` (imagenet-only) — space-to-depth stem: the 7x7/2 conv on
+  224x224x3 runs at ~3.6% of MXU peak (PERF.md §3); the s2d rewrite is
+  the same math with MXU-sized channel dims.
+* ``--fusedBN`` — single-read Pallas BN stats (ops/bn_kernel.py),
+  targeting the BN-stats HBM re-read (largest sync-op category in the
+  profiled ResNet-50 step, PERF.md §2). Single-device jit path; the
+  Optimizer falls back automatically (with a warning) under multi-device
+  SPMD, where pallas_call has no partitioning rule.
+"""
 
 from __future__ import annotations
 
@@ -10,51 +31,137 @@ from bigdl_tpu.cli import common
 from bigdl_tpu.cli.vgg import _datasets, _one_split
 
 
+def _add_lever_args(tr):
+    tr.add_argument("--bnStatSample", type=int, default=None,
+                    help="BN training stats from this many batch rows "
+                         "(throughput lever; see nn.set_bn_stat_sample)")
+    tr.add_argument("--fusedBN", action="store_true",
+                    help="single-read Pallas BN stats kernel "
+                         "(single-device jit; auto-disabled under SPMD)")
+    tr.add_argument("--s2d", action="store_true",
+                    help="space-to-depth stem (imagenet models only): "
+                         "MXU-friendly rewrite of the 7x7/2 stem conv")
+
+
+def _imagenet_datasets(folder: str, batch: int):
+    import os
+
+    from bigdl_tpu.dataset.folder import (IMAGENET_MEAN, IMAGENET_STD,
+                                          ImageFolderDataSet)
+
+    train = ImageFolderDataSet(os.path.join(folder, "train"), batch,
+                               size=(224, 224), train=True,
+                               mean=IMAGENET_MEAN, std=IMAGENET_STD)
+    vdir = os.path.join(folder, "val")
+    val = (ImageFolderDataSet(vdir, batch, size=(224, 224),
+                              mean=IMAGENET_MEAN, std=IMAGENET_STD)
+           if os.path.isdir(vdir) else None)
+    return train, val
+
+
+def _build_model(args):
+    from bigdl_tpu.models import resnet, resnet_cifar
+    from bigdl_tpu.models.resnet import _IMAGENET_CFG
+
+    if args.dataset == "imagenet":
+        if args.depth not in _IMAGENET_CFG:
+            raise SystemExit(
+                f"--depth {args.depth} invalid for imagenet; pick one of "
+                f"{sorted(_IMAGENET_CFG)}")
+        return resnet(args.depth, args.classNum,
+                      s2d_stem=getattr(args, "s2d", False))
+    if getattr(args, "s2d", False):
+        raise SystemExit("--s2d applies to --dataset imagenet models only "
+                         "(the CIFAR stem is already a 3x3/1 conv)")
+    if (args.depth - 2) % 6:
+        raise SystemExit(f"--depth {args.depth} invalid for cifar10; "
+                         "depth must be 6n+2 (20/32/44/56/110)")
+    return resnet_cifar(args.depth, args.classNum)
+
+
 def main(argv=None):
     common.setup_logging()
     p = argparse.ArgumentParser("bigdl-tpu resnet")
     sub = p.add_subparsers(dest="cmd", required=True)
     tr = sub.add_parser("train")
     common.add_train_args(tr)
-    tr.add_argument("--depth", type=int, default=20)
-    tr.add_argument("--bnStatSample", type=int, default=None,
-                    help="BN training stats from this many batch rows "
-                         "(throughput lever; see nn.set_bn_stat_sample)")
+    tr.add_argument("--dataset", choices=["cifar10", "imagenet"],
+                    default="cifar10")
+    tr.add_argument("--depth", type=int, default=None,
+                    help="6n+2 for cifar10 (default 20); 18/34/50/101/152 "
+                         "for imagenet (default 50)")
+    tr.add_argument("--classNum", type=int, default=None)
+    _add_lever_args(tr)
     # reference resnet recipe defaults (an explicit --weightDecay 0 still
     # disables decay; only the *default* changes here)
     tr.set_defaults(weightDecay=1e-4)
     te = sub.add_parser("test")
     common.add_test_args(te)
-    te.add_argument("--depth", type=int, default=20)
+    te.add_argument("--dataset", choices=["cifar10", "imagenet"],
+                    default="cifar10")
+    te.add_argument("--depth", type=int, default=None)
+    te.add_argument("--classNum", type=int, default=None)
+    te.add_argument("--s2d", action="store_true",
+                    help="evaluate a checkpoint trained with --s2d "
+                         "(the stem param tree differs)")
     args = p.parse_args(argv)
     common.apply_platform(args)
+    if args.classNum is None:
+        args.classNum = 1000 if args.dataset == "imagenet" else 10
+    if args.depth is None:
+        args.depth = 50 if args.dataset == "imagenet" else 20
 
     from bigdl_tpu import nn
-    from bigdl_tpu.models import resnet_cifar
-    from bigdl_tpu.optim import SGD, Top1Accuracy, Trigger
+    from bigdl_tpu.optim import SGD, Top1Accuracy, Top5Accuracy, Trigger
     from bigdl_tpu.optim.schedules import EpochSchedule, Regime
 
-    model = resnet_cifar(args.depth, 10)
+    model = _build_model(args)
     if getattr(args, "bnStatSample", None):
         from bigdl_tpu.nn import set_bn_stat_sample
         set_bn_stat_sample(model, args.bnStatSample)
+    if getattr(args, "fusedBN", False):
+        from bigdl_tpu.nn import set_bn_fused
+        set_bn_fused(model)
     if args.cmd == "train":
-        train, test = _datasets(args.folder, args.batchSize, train_aug=True)
-        # reference resnet training regime: lr drops at epochs 81/122
-        sched = EpochSchedule([
-            Regime(1, 80, {"learning_rate": args.learningRate}),
-            Regime(81, 121, {"learning_rate": args.learningRate * 0.1}),
-            Regime(122, 10**9, {"learning_rate": args.learningRate * 0.01}),
-        ])
+        if args.dataset == "imagenet":
+            train, test = _imagenet_datasets(args.folder, args.batchSize)
+            # reference TrainImageNet regime: warmup-free step decay /10
+            # at epochs 30/60/80
+            sched = EpochSchedule([
+                Regime(1, 29, args.learningRate),
+                Regime(30, 59, args.learningRate * 0.1),
+                Regime(60, 79, args.learningRate * 0.01),
+                Regime(80, 10**9, args.learningRate * 0.001),
+            ])
+        else:
+            train, test = _datasets(args.folder, args.batchSize,
+                                    train_aug=True)
+            # reference resnet training regime: lr drops at epochs 81/122
+            sched = EpochSchedule([
+                Regime(1, 80, args.learningRate),
+                Regime(81, 121, args.learningRate * 0.1),
+                Regime(122, 10**9, args.learningRate * 0.01),
+            ])
         method = SGD(learning_rate=args.learningRate,
                      weight_decay=args.weightDecay,
                      momentum=args.momentum, dampening=0.0,
                      nesterov=args.momentum > 0, schedule=sched)
         opt = common.build_optimizer(model, train, nn.ClassNLLCriterion(),
                                      args, optim_method=method)
-        opt.set_validation(Trigger.every_epoch(), test, [Top1Accuracy()])
+        if test is not None:
+            metrics = [Top1Accuracy()]
+            if args.dataset == "imagenet":
+                metrics.append(Top5Accuracy())
+            opt.set_validation(Trigger.every_epoch(), test, metrics)
         return opt.optimize()
     params, mod_state = common.load_trained(model, args.model)
+    if args.dataset == "imagenet":
+        _, test = _imagenet_datasets(args.folder, args.batchSize)
+        if test is None:
+            raise FileNotFoundError(
+                f"no val/ directory under {args.folder}")
+        return common.evaluate(model, params, mod_state, test,
+                               [Top1Accuracy(), Top5Accuracy()])
     test = _one_split(args.folder, args.batchSize, False, False)
     return common.evaluate(model, params, mod_state, test)
 

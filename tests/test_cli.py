@@ -132,3 +132,54 @@ def test_capture_scripts_reference_valid_perf_models():
     assert names, "no perf invocations found in capture scripts"
     for n in names:
         build_model(n, 10)  # raises SystemExit on unknown names
+
+
+def test_resnet_cli_cifar_fused_bn(tmp_path):
+    """--fusedBN on the real training CLI (VERDICT r4 item 3): one epoch
+    on synthetic CIFAR runs end-to-end with the Pallas BN stats path."""
+    from bigdl_tpu.cli import resnet
+
+    data = str(tmp_path / "cifar")
+    _write_cifar(data)
+    trained = resnet.main(["train", "-f", data, "-b", "8", "--maxEpoch",
+                           "1", "--depth", "8", "--fusedBN",
+                           "--logEvery", "100"])
+    assert trained is not None
+
+
+def test_resnet_cli_imagenet_s2d(tmp_path):
+    """--dataset imagenet --s2d: space-to-depth stem on the training CLI,
+    one epoch over a tiny label-by-folder image tree."""
+    from PIL import Image
+
+    from bigdl_tpu.cli import resnet
+
+    rng = np.random.RandomState(0)
+    for cls in ("cat", "dog"):
+        d = tmp_path / "train" / cls
+        d.mkdir(parents=True)
+        for i in range(4):
+            Image.fromarray(rng.randint(0, 255, (64, 64, 3), np.uint8)
+                            ).save(d / f"{i}.jpg")
+    trained = resnet.main(["train", "-f", str(tmp_path), "-b", "4",
+                           "--dataset", "imagenet", "--depth", "18",
+                           "--classNum", "2", "--maxEpoch", "1",
+                           "--s2d", "--fusedBN", "--logEvery", "100"])
+    assert trained is not None
+
+
+def test_resnet_cli_s2d_rejected_on_cifar(tmp_path):
+    from bigdl_tpu.cli import resnet
+
+    with pytest.raises(SystemExit, match="imagenet"):
+        resnet.main(["train", "-f", str(tmp_path), "--s2d"])
+
+
+def test_resnet_cli_depth_validation(tmp_path):
+    from bigdl_tpu.cli import resnet
+
+    with pytest.raises(SystemExit, match="invalid for imagenet"):
+        resnet.main(["train", "-f", str(tmp_path), "--dataset", "imagenet",
+                     "--depth", "20"])
+    with pytest.raises(SystemExit, match="invalid for cifar10"):
+        resnet.main(["train", "-f", str(tmp_path), "--depth", "21"])
