@@ -337,12 +337,19 @@ def run(model_name: str, batch: int, iterations: int, data_type: str,
 
 
 def _make_class_image_tree(root: str, classes: int, per_class: int,
-                           size: int, seed: int = 0) -> None:
+                           size: int, seed: int = 0,
+                           hard: bool = False) -> None:
     """Synthetic LEARNABLE image tree (zero-egress stand-in for ImageNet):
     each class gets a distinct mean color + a bright band at a
     class-specific height, under heavy pixel noise — decodable by a conv
     net but not linearly trivial. JPEG-encoded so the full decode+augment
-    path runs."""
+    path runs.
+
+    ``hard=True`` removes the per-class color (all classes share one
+    hue): the only signal is the band's position at reduced contrast
+    under stronger noise, so a conv net needs several epochs — produces
+    a multi-point accuracy-vs-wall-clock curve instead of one-epoch
+    saturation."""
     import numpy as np
     from PIL import Image
 
@@ -350,14 +357,19 @@ def _make_class_image_tree(root: str, classes: int, per_class: int,
     for c in range(classes):
         d = os.path.join(root, f"class{c:03d}")
         os.makedirs(d, exist_ok=True)
-        hue = np.array([(40 + c * 53) % 200, (60 + c * 97) % 200,
-                        (80 + c * 151) % 200], np.float32)
+        if hard:
+            hue = np.array([110.0, 110.0, 110.0], np.float32)
+            lift, noise = 28.0, 48.0
+        else:
+            hue = np.array([(40 + c * 53) % 200, (60 + c * 97) % 200,
+                            (80 + c * 151) % 200], np.float32)
+            lift, noise = 55.0, 30.0
         band = (c * size) // classes
         bh = max(2, size // classes)
         for i in range(per_class):
             img = np.broadcast_to(hue, (size, size, 3)).copy()
-            img[band:band + bh] += 55.0
-            img += rs.randn(size, size, 3) * 30.0
+            img[band:band + bh] += lift
+            img += rs.randn(size, size, 3) * noise
             Image.fromarray(
                 np.clip(img, 0, 255).astype(np.uint8)).save(
                 os.path.join(d, f"{i:04d}.jpg"), quality=85)
@@ -367,7 +379,8 @@ def run_time_to_acc(model_name: str, batch: int, target: float,
                     max_epochs: int = 40, image_size: int = 64,
                     classes: int = 10, train_per_class: int = 200,
                     val_per_class: int = 40, learning_rate: float = 0.1,
-                    use_bf16: bool = True, data_dir: str | None = None):
+                    use_bf16: bool = True, data_dir: str | None = None,
+                    hard: bool = False, val_every_iters: int | None = None):
     """Time-to-accuracy harness (BASELINE.json metric: images/sec/chip
     **+ time-to-76%-top1**; reference recipe models/inception/Train.scala
     :77-83 + scripts/run.example.sh:54). Trains ``model_name`` from
@@ -399,7 +412,8 @@ def run_time_to_acc(model_name: str, batch: int, target: float,
                                ("val", val_per_class)):
                 tree = os.path.join(td, "imgs", split)
                 _make_class_image_tree(tree, classes, per, image_size,
-                                       seed=0 if split == "train" else 1)
+                                       seed=0 if split == "train" else 1,
+                                       hard=hard)
                 write_image_shards(tree, os.path.join(td, "shards", split),
                                    prefix=split, images_per_shard=256,
                                    workers=4)
@@ -422,7 +436,9 @@ def run_time_to_acc(model_name: str, batch: int, target: float,
                                  Trigger.max_score(target)),
             strategy=DataParallel(local_mesh()),
             compute_dtype=(jnp.bfloat16 if use_bf16 else None))
-        opt.set_validation(Trigger.every_epoch(), val_ds, [Top1Accuracy()])
+        val_trig = (Trigger.several_iteration(val_every_iters)
+                    if val_every_iters else Trigger.every_epoch())
+        opt.set_validation(val_trig, val_ds, [Top1Accuracy()])
         opt.set_summary(summary_dir)
 
         t_train = time.time()
@@ -448,7 +464,12 @@ def run_time_to_acc(model_name: str, batch: int, target: float,
         "train_wall_s": round(wall, 2),
         "setup_s": round(t_train - t_setup, 2),
         "final_top1": curve[-1]["top1_accuracy"] if curve else None,
-        "epochs_run": len(curve),  # one val point per epoch
+        # distinct epoch stamps across val points: equals the epoch count
+        # under every-epoch validation, and "epochs touched" under
+        # --valEvery (the val row's epoch field is post-rollover)
+        "epochs_run": len({r.get("epoch") for r in curve}),
+        "val_points": len(curve),
+        "hard_data": hard,
         "batch": batch,
         "image_size": image_size,
         "classes": classes,
@@ -500,6 +521,13 @@ def main(argv=None):
     p.add_argument("--valPerClass", type=int, default=40,
                    help="synthetic val images per class for --timeToAcc "
                         "(1000 = CIFAR-10 scale)")
+    p.add_argument("--ttaHard", action="store_true",
+                   help="harder synthetic classes (band position only, "
+                        "no color cue) so the accuracy curve spans "
+                        "multiple epochs")
+    p.add_argument("--valEvery", type=int, default=None, metavar="ITERS",
+                   help="validate every N iterations instead of every "
+                        "epoch (denser accuracy-vs-wall-clock curve)")
     p.add_argument("--convLayout", default=None, metavar="FWD,DGRAD,WGRAD",
                    help="per-pass conv activation layouts (NHWC|NCHW "
                         "each), e.g. NHWC,NCHW,NCHW — install a "
@@ -525,7 +553,8 @@ def main(argv=None):
                         image_size=args.imageSize, classes=args.classes,
                         train_per_class=args.trainPerClass,
                         val_per_class=args.valPerClass,
-                        use_bf16=not args.f32, data_dir=data_dir)
+                        use_bf16=not args.f32, data_dir=data_dir,
+                        hard=args.ttaHard, val_every_iters=args.valEvery)
         return
     run(args.model, args.batchSize, args.iteration, args.dataType,
         use_bf16=not args.f32, data_parallel=args.dataParallel,

@@ -64,7 +64,7 @@ step "conv_probe_apply" 900 bash -c 'L=$(python scripts/apply_conv_probe.py /tmp
 # accuracy-vs-wall-clock on the chip (BASELINE's second metric) — r05:
 # CIFAR-10 scale (50k/10k @ 32x32, batch 128), the reference recipe
 # (models/resnet/README.md Training section)
-step "time_to_acc_cifar_scale" 3600 python -m bigdl_tpu.cli.perf -m resnet20_cifar --timeToAcc 0.91 -b 128 --imageSize 32 --maxEpoch 156 --trainPerClass 5000 --valPerClass 1000
+step "time_to_acc_cifar_scale" 3600 python -m bigdl_tpu.cli.perf -m resnet20_cifar --timeToAcc 0.91 -b 128 --imageSize 32 --maxEpoch 156 --trainPerClass 5000 --valPerClass 1000 --ttaHard --valEvery 195
 step "time_to_acc_resnet50" 2400 python -m bigdl_tpu.cli.perf -m resnet50 --timeToAcc 0.85 -b 64 --imageSize 224 --maxEpoch 15
 
 # the official bench line last
