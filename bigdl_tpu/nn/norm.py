@@ -143,10 +143,9 @@ def set_bn_stat_sample(module, k: Optional[int]):
     """Set ``stat_sample`` on every BatchNormalization in a module tree
     (post-construction — saves threading the knob through every model
     builder). Returns the module."""
-    if isinstance(module, BatchNormalization):
-        module.stat_sample = k
-    for ch in getattr(module, "children", lambda: ())() or ():
-        set_bn_stat_sample(ch, k)
+    for m in module.modules():
+        if isinstance(m, BatchNormalization):
+            m.stat_sample = k
     return module
 
 
@@ -154,10 +153,9 @@ def set_bn_fused(module, fused: bool = True):
     """Route every BatchNormalization's training stats through the
     single-read Pallas kernel (ops/bn_kernel.py; single-device jit —
     see the ``fused`` constructor note). Returns the module."""
-    if isinstance(module, BatchNormalization):
-        module.fused = fused
-    for ch in getattr(module, "children", lambda: ())() or ():
-        set_bn_fused(ch, fused)
+    for m in module.modules():
+        if isinstance(m, BatchNormalization):
+            m.fused = fused
     return module
 
 
