@@ -168,9 +168,16 @@ class Optimizer:
     def resume(self, checkpoint_dir: str) -> "Optimizer":
         """Load the newest model.<n>/state.<n> pair from a directory
         (either single-blob or orbax-sharded snapshots)."""
-        from bigdl_tpu.utils.file import isdir, latest_checkpoint
-        m = latest_checkpoint(checkpoint_dir, "model.")
-        s = latest_checkpoint(checkpoint_dir, "state.")
+        from bigdl_tpu.utils.file import (isdir, latest_checkpoint,
+                                          latest_checkpoint_pair)
+        # newest MATCHED pair: a kill between the model.<n> and state.<n>
+        # writes must not mix params from n with optimizer state from n-k
+        m, s = latest_checkpoint_pair(checkpoint_dir)
+        if m is None:
+            # accept a model-only snapshot (predict/eval-style dirs with
+            # no optimizer state at all)
+            m = latest_checkpoint(checkpoint_dir, "model.")
+            s = None
         if m and isdir(m):  # orbax checkpoints are directories
             from bigdl_tpu.utils.orbax_ckpt import restore_sharded
             blob = restore_sharded(m)

@@ -148,3 +148,39 @@ def latest_checkpoint(directory: str, prefix: str = "model.") -> str | None:
             if n > best_n:
                 best, best_n = join(f), n
     return best
+
+
+def latest_checkpoint_pair(directory: str):
+    """Newest iteration n for which BOTH ``model.n`` and ``state.n`` exist,
+    as ``(model_path, state_path)`` — ``(None, None)`` if none. An unclean
+    death (kill -9) can land between the two writes; pairing the newest of
+    each independently would silently mix params from iteration N with
+    optimizer state from N-k."""
+    if is_remote(directory):
+        fs, d = _fs_for(directory)
+        if not fs.isdir(d):
+            return None, None
+        scheme = directory.split("://", 1)[0]
+        names = [e.rsplit("/", 1)[-1] for e in fs.ls(d, detail=False)]
+        join = lambda f: f"{scheme}://{d.rstrip('/')}/{f}"
+    else:
+        if not os.path.isdir(directory):
+            return None, None
+        names = os.listdir(directory)
+        join = lambda f: os.path.join(directory, f)
+
+    def idxs(prefix):
+        out = set()
+        for f in names:
+            if f.startswith(prefix):
+                try:
+                    out.add(int(f[len(prefix):]))
+                except ValueError:
+                    pass
+        return out
+
+    common = idxs("model.") & idxs("state.")
+    if not common:
+        return None, None
+    n = max(common)
+    return join(f"model.{n}"), join(f"state.{n}")

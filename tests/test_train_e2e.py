@@ -207,3 +207,37 @@ def test_summary_jsonl(tmp_path):
     assert len(val) == 2 and all("top1_accuracy" in r for r in val)
     its = [r["iteration"] for r in train]
     assert its == sorted(its)
+
+
+def test_resume_uses_newest_matched_pair(tmp_path):
+    """kill -9 can land between the model.<n> and state.<n> writes; resume
+    must load the newest iteration where BOTH exist, never mix params
+    from n with optimizer state from n-k (soak finding, round 5)."""
+    from bigdl_tpu.utils.file import latest_checkpoint_pair, save_pytree
+
+    d = str(tmp_path)
+    blob10 = {"params": {"w": np.ones((2,)) * 10}, "mod_state": {}}
+    blob20 = {"params": {"w": np.ones((2,)) * 20}, "mod_state": {}}
+    save_pytree(blob10, os.path.join(d, "model.10"))
+    save_pytree({"m": np.zeros((2,))}, os.path.join(d, "state.10"))
+    save_pytree(blob20, os.path.join(d, "model.20"))  # state.20 missing
+
+    m, s = latest_checkpoint_pair(d)
+    assert m.endswith("model.10") and s.endswith("state.10")
+
+    x, y = _xor_data(32)
+    opt = Optimizer(Sequential(nn.Linear(2, 2)), BatchDataSet(x, y, 16),
+                    nn.ClassNLLCriterion(),
+                    end_when=Trigger.max_epoch(1))
+    opt.resume(d)
+    np.testing.assert_array_equal(opt._init_params["w"], np.ones((2,)) * 10)
+    assert opt._init_opt_state is not None
+
+    # model-only directory (eval-style) still resumes params
+    d2 = str(tmp_path / "modelonly")
+    save_pytree(blob20, os.path.join(d2, "model.20"))
+    opt2 = Optimizer(Sequential(nn.Linear(2, 2)), BatchDataSet(x, y, 16),
+                     nn.ClassNLLCriterion(), end_when=Trigger.max_epoch(1))
+    opt2.resume(d2)
+    np.testing.assert_array_equal(opt2._init_params["w"],
+                                  np.ones((2,)) * 20)
