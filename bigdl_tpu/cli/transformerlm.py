@@ -29,8 +29,13 @@ def main(argv=None):
     tr.add_argument("--dropout", type=float, default=0.0)
     tr.add_argument("--flash", action="store_true",
                     help="use the Pallas flash-attention kernel")
-    tr.add_argument("--remat", action="store_true",
-                    help="jax.checkpoint each block (HBM for FLOPs)")
+    tr.add_argument("--remat", nargs="?", const="full", default=False,
+                    choices=["full", "dots"],
+                    help="jax.checkpoint each block (HBM for FLOPs): "
+                         "'full' recomputes everything; 'dots' keeps "
+                         "matmul outputs resident and recomputes only "
+                         "bandwidth-bound intermediates (usually the "
+                         "better TPU point)")
     tr.add_argument("--bf16", action="store_true")
     tr.add_argument("--accumSteps", type=int, default=1)
     tr.add_argument("--packed", action="store_true",

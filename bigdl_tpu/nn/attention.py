@@ -495,9 +495,25 @@ class TransformerEncoderLayer(Module):
 class TransformerEncoder(Sequential):
     """Stack of encoder layers with optional remat.
 
-    ``remat=True`` wraps each layer in ``jax.checkpoint`` — the
-    HBM-for-FLOPs trade that long-context training needs.
+    ``remat`` wraps each layer in ``jax.checkpoint`` — the HBM-for-FLOPs
+    trade that long-context training needs. Accepts:
+
+    * ``False`` — no remat (default);
+    * ``True`` / ``"full"`` — save nothing, recompute the whole layer in
+      the backward (max HBM savings, ~1/3 extra FLOPs);
+    * ``"dots"`` — ``jax.checkpoint_policies.dots_with_no_batch_dims_
+      saveable``: matmul outputs stay resident, only elementwise/softmax
+      recompute. On TPU this is usually the better point: the MXU work
+      (the expensive part) is not redone, while the bandwidth-bound
+      intermediates (which XLA refuses to keep anyway once HBM is tight)
+      are. The reference has no analog — its graph holds every
+      intermediate by design (Scala Module.output fields).
     """
+
+    _REMAT_POLICIES = {
+        "full": None,   # jax.checkpoint default: nothing saveable
+        "dots": "dots_with_no_batch_dims_saveable",
+    }
 
     def __init__(self, num_layers: int, d_model: int, num_heads: int,
                  d_ff: Optional[int] = None, causal: bool = False,
@@ -515,18 +531,27 @@ class TransformerEncoder(Sequential):
             for _ in range(num_layers)
         ]
         super().__init__(*layers, name=name)
+        if remat is True:
+            remat = "full"
+        if remat and remat not in self._REMAT_POLICIES:
+            raise ValueError(f"remat must be False/True/'full'/'dots', "
+                             f"got {remat!r}")
         self.remat = remat
 
     def apply(self, params, state, x, *, training=False, rng=None):
         if not self.remat:
             return super().apply(params, state, x, training=training, rng=rng)
+        policy_name = self._REMAT_POLICIES[self.remat]
+        ckpt_kw = {}
+        if policy_name is not None:
+            ckpt_kw["policy"] = getattr(jax.checkpoint_policies, policy_name)
         new_state = {}
         for i, m in enumerate(self._modules):
             k = str(i)
             fn = jax.checkpoint(
                 lambda p, s, h, r, m=m: m.apply(p, s, h, training=training,
                                                 rng=r),
-                static_argnums=())
+                static_argnums=(), **ckpt_kw)
             r = None if rng is None else jax.random.fold_in(rng, i)
             x, s = fn(params[k], state[k], x, r)
             new_state[k] = s

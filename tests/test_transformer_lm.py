@@ -6,6 +6,7 @@ import math
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from bigdl_tpu import nn
 from bigdl_tpu.core import Sequential
@@ -348,3 +349,41 @@ def test_perplexity_through_optimizer_validation(tmp_path, caplog):
     import re
     ppl = float(re.search(r"PerplexityResult\(([\d.]+)", msgs[-1]).group(1))
     assert math.isfinite(ppl) and ppl > 1.0, ppl
+
+
+@pytest.mark.parametrize("remat", [True, "full", "dots"])
+def test_remat_policies_match_no_remat_gradients(remat):
+    """All remat modes are pure recompute schedules: loss and gradients
+    must equal the remat=False trace exactly (policy only changes what
+    XLA keeps resident)."""
+    import numpy as np
+
+    from bigdl_tpu import nn
+
+    def build(r):
+        m = nn.TransformerEncoder(num_layers=2, d_model=16, num_heads=2,
+                                  d_ff=32, causal=True, remat=r)
+        return m
+
+    x = jnp.asarray(np.random.RandomState(0).randn(2, 6, 16), jnp.float32)
+    m0, m1 = build(False), build(remat)
+    params = m0.init(jax.random.PRNGKey(0))
+    state = m0.init_state()
+
+    def loss(mod, p):
+        y, _ = mod.apply(p, state, x, training=False)
+        return jnp.sum(jnp.square(y))
+
+    l0, g0 = jax.value_and_grad(lambda p: loss(m0, p))(params)
+    l1, g1 = jax.value_and_grad(lambda p: loss(m1, p))(params)
+    np.testing.assert_allclose(float(l0), float(l1), rtol=1e-6)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(a, b, atol=1e-5), g0, g1)
+
+
+def test_remat_rejects_unknown_mode():
+    from bigdl_tpu import nn
+
+    with pytest.raises(ValueError, match="remat"):
+        nn.TransformerEncoder(num_layers=1, d_model=8, num_heads=2,
+                              remat="bogus")
