@@ -72,35 +72,54 @@ def _stats_kernel(x_ref, sum_ref, sq_ref, acc_ref):
 
     @pl.when(r == pl.num_programs(1) - 1)
     def _emit():
-        sum_ref[...] = acc_ref[0:1, :]
-        sq_ref[...] = acc_ref[1:2, :]
+        # output block is a full (8, cb) f32 tile — broadcast the row so
+        # lowering never depends on Mosaic's block-dim==array-dim escape
+        # for sub-minimum (1, cb) tiles (the escape the round-3 flash
+        # failure was about); the caller reads row 0
+        sum_ref[...] = jnp.broadcast_to(acc_ref[0:1, :], sum_ref.shape)
+        sq_ref[...] = jnp.broadcast_to(acc_ref[1:2, :], sq_ref.shape)
+
+
+_OUT_SUBLANES = 8  # full f32 min tile for the (sum, sumsq) outputs
+
+
+def _min_sublane(*dtypes) -> int:
+    """Mosaic's minimum sublane count across operand dtypes: 8 for 4-byte,
+    16 for 2-byte (bf16), 32 for 1-byte (pallas_guide.md tiling table)."""
+    need = 8
+    for d in dtypes:
+        need = max(need, {4: 8, 2: 16, 1: 32}.get(jnp.dtype(d).itemsize, 8))
+    return need
 
 
 def bn_stats(x2d: jax.Array) -> Tuple[jax.Array, jax.Array]:
     """Per-channel (sum, sum-of-squares) of a (rows, C) array in ONE HBM
     read, f32 accumulation regardless of input dtype. Requires rows %
-    {row block} == 0 and C % 128 == 0 (the NHWC ResNet shapes satisfy
-    both); callers fall back to jnp otherwise."""
+    {row block} == 0, rows % {dtype min sublane} == 0 and C % 128 == 0
+    (the NHWC ResNet shapes satisfy all); callers fall back to jnp
+    otherwise."""
     rows, c = x2d.shape
     rb = min(_ROW_BLOCK, rows)
     cb = min(_C_BLOCK, c)
-    # rows%8 / c%128 are Mosaic's sublane/lane minima — without them the
-    # call lowers in interpret mode but compile-fails on real TPU
-    if rows % rb or c % cb or rows % 8 or c % 128:
-        raise ValueError(f"bn_stats needs rows%{rb}==0, rows%8==0, "
-                         f"C%{cb}==0 and C%128==0, got {x2d.shape}")
+    ms = _min_sublane(x2d.dtype)
+    # rows%{ms} / c%128 are Mosaic's sublane/lane minima — without them
+    # the call lowers in interpret mode but compile-fails on real TPU
+    if rows % rb or c % cb or rows % ms or c % 128:
+        raise ValueError(f"bn_stats needs rows%{rb}==0, rows%{ms}==0 "
+                         f"(dtype {x2d.dtype}), C%{cb}==0 and C%128==0, "
+                         f"got {x2d.shape}")
     grid = (c // cb, rows // rb)
     out_shape = [
-        jax.ShapeDtypeStruct((1, c), jnp.float32),
-        jax.ShapeDtypeStruct((1, c), jnp.float32),
+        jax.ShapeDtypeStruct((_OUT_SUBLANES, c), jnp.float32),
+        jax.ShapeDtypeStruct((_OUT_SUBLANES, c), jnp.float32),
     ]
     s, sq = pl.pallas_call(
         _stats_kernel,
         grid=grid,
         in_specs=[pl.BlockSpec((rb, cb), lambda ci, ri: (ri, ci))],
         out_specs=[
-            pl.BlockSpec((1, cb), lambda ci, ri: (0, ci)),
-            pl.BlockSpec((1, cb), lambda ci, ri: (0, ci)),
+            pl.BlockSpec((_OUT_SUBLANES, cb), lambda ci, ri: (0, ci)),
+            pl.BlockSpec((_OUT_SUBLANES, cb), lambda ci, ri: (0, ci)),
         ],
         out_shape=out_shape,
         scratch_shapes=[_vmem_scratch((2, cb))],
@@ -123,8 +142,8 @@ def _bwd_kernel(dy_ref, xhat_ref, sdy_ref, sdyx_ref, acc_ref):
 
     @pl.when(r == pl.num_programs(1) - 1)
     def _emit():
-        sdy_ref[...] = acc_ref[0:1, :]
-        sdyx_ref[...] = acc_ref[1:2, :]
+        sdy_ref[...] = jnp.broadcast_to(acc_ref[0:1, :], sdy_ref.shape)
+        sdyx_ref[...] = jnp.broadcast_to(acc_ref[1:2, :], sdyx_ref.shape)
 
 
 def bn_bwd_stats(dy2d: jax.Array, xhat2d: jax.Array):
@@ -133,8 +152,10 @@ def bn_bwd_stats(dy2d: jax.Array, xhat2d: jax.Array):
     rows, c = dy2d.shape
     rb = min(_ROW_BLOCK, rows)
     cb = min(_C_BLOCK, c)
-    if rows % rb or c % cb or rows % 8 or c % 128:
-        raise ValueError(f"bn_bwd_stats needs rows%{rb}==0, rows%8==0, "
+    ms = _min_sublane(dy2d.dtype, xhat2d.dtype)
+    if rows % rb or c % cb or rows % ms or c % 128:
+        raise ValueError(f"bn_bwd_stats needs rows%{rb}==0, rows%{ms}==0 "
+                         f"(dtypes {dy2d.dtype}/{xhat2d.dtype}), "
                          f"C%{cb}==0 and C%128==0, got {dy2d.shape}")
     grid = (c // cb, rows // rb)
     sdy, sdyx = pl.pallas_call(
@@ -145,12 +166,12 @@ def bn_bwd_stats(dy2d: jax.Array, xhat2d: jax.Array):
             pl.BlockSpec((rb, cb), lambda ci, ri: (ri, ci)),
         ],
         out_specs=[
-            pl.BlockSpec((1, cb), lambda ci, ri: (0, ci)),
-            pl.BlockSpec((1, cb), lambda ci, ri: (0, ci)),
+            pl.BlockSpec((_OUT_SUBLANES, cb), lambda ci, ri: (0, ci)),
+            pl.BlockSpec((_OUT_SUBLANES, cb), lambda ci, ri: (0, ci)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((1, c), jnp.float32),
-            jax.ShapeDtypeStruct((1, c), jnp.float32),
+            jax.ShapeDtypeStruct((_OUT_SUBLANES, c), jnp.float32),
+            jax.ShapeDtypeStruct((_OUT_SUBLANES, c), jnp.float32),
         ],
         scratch_shapes=[_vmem_scratch((2, cb))],
         interpret=_interpret(),
@@ -158,8 +179,9 @@ def bn_bwd_stats(dy2d: jax.Array, xhat2d: jax.Array):
     return sdy[0], sdyx[0]
 
 
-def _tileable(rows: int, c: int) -> bool:
-    return rows % min(_ROW_BLOCK, rows) == 0 and rows % 8 == 0 \
+def _tileable(rows: int, c: int, *dtypes) -> bool:
+    ms = _min_sublane(*dtypes)
+    return rows % min(_ROW_BLOCK, rows) == 0 and rows % ms == 0 \
         and c % min(_C_BLOCK, c) == 0 and c % 128 == 0
 
 
@@ -177,7 +199,7 @@ def _fused_fwd(x, gamma, beta, eps):
     c = x.shape[-1]
     rows = x.size // c
     x2 = x.reshape(rows, c)
-    if _tileable(rows, c):
+    if _tileable(rows, c, x.dtype):
         s, sq = bn_stats(x2)
     else:  # jnp fallback, same math
         xf = x2.astype(jnp.float32)
@@ -204,7 +226,7 @@ def _fused_vjp_bwd(eps, res, cts):
     rows = x.size // c
     dy2 = dy.reshape(rows, c)
     xhat2 = ((x.reshape(rows, c).astype(jnp.float32) - mean) * inv)
-    if _tileable(rows, c):
+    if _tileable(rows, c, dy.dtype):   # xhat2 is f32; dy may be bf16
         # xhat stays f32 into the kernel (it upcasts per block anyway) so
         # dgamma precision matches the jnp fallback under mixed precision
         sdy, sdyx = bn_bwd_stats(dy2, xhat2)

@@ -178,6 +178,24 @@ def test_bn_kernel_block_specs_satisfy_mosaic_tiling():
         a0, a1 = ashape[-2], ashape[-1]
         assert b1 == a1 or b1 % 128 == 0, (bs, ashape)
         assert b0 == a0 or b0 % 8 == 0, (bs, ashape)
+        # round-5 hardening: no block relies on the block-dim==array-dim
+        # escape for sub-minimum f32 sublanes — every block is a full
+        # (>=8, >=128) tile outright (the escape is what the round-3
+        # flash lowering failure was about)
+        assert b0 % 8 == 0 and b1 % 128 == 0, (bs, ashape)
+
+
+def test_bn_stats_bf16_sublane_requirement():
+    """bf16 blocks need (16,128) min tiles (pallas_guide tiling table):
+    rows=8 is fine for f32 but must be rejected for bf16."""
+    ok_f32 = jnp.zeros((8, 128), jnp.float32)
+    s, sq = bn_stats(ok_f32)                       # lowers: 8 rows, f32
+    assert s.shape == (128,)
+    with pytest.raises(ValueError, match="rows%16"):
+        bn_stats(jnp.zeros((8, 128), jnp.bfloat16))
+    with pytest.raises(ValueError, match="rows%16"):
+        bn_bwd_stats(jnp.zeros((8, 128), jnp.bfloat16),
+                     jnp.zeros((8, 128), jnp.float32))
 
 
 @pytest.mark.tpu
