@@ -69,4 +69,8 @@ step "lenet_convergence" 1800 ./scripts/run_example.sh lenet /tmp/synth_mnist_fu
 step "time_to_acc_cifar_scale" 3600 python -m bigdl_tpu.cli.perf -m resnet20_cifar --timeToAcc 0.91 -b 128 --imageSize 32 --maxEpoch 156 --trainPerClass 5000 --valPerClass 1000 --ttaHard --valEvery 195
 step "time_to_acc_resnet50" 2400 python -m bigdl_tpu.cli.perf -m resnet50 --timeToAcc 0.85 -b 64 --imageSize 224 --maxEpoch 15
 
+# 8. sustained-training soak on chip (VERDICT r4 stretch item 9):
+# kill -9 mid-run + resume + steady-state verdict, ~35 min total
+step "soak_chip" 2700 python scripts/soak.py orchestrate --dir /tmp/soak_chip --batch 128 --ckpt-every 50 --phase1 1500 --phase2 480
+
 echo "r05b sweep complete -> $OUT" | tee -a "$OUT"
