@@ -22,15 +22,46 @@ def _add_platform_arg(p: argparse.ArgumentParser) -> None:
                         "effect before first jax device use)")
 
 
+def compile_cache_dir() -> Optional[str]:
+    """Resolve the persistent compile-cache dir: BIGDL_JAX_CACHE wins;
+    a user-managed JAX_COMPILATION_CACHE_DIR is left to jax itself (None
+    here = don't clobber it); otherwise a per-user cache path (not a
+    world-shared /tmp name another uid could pre-own or poison)."""
+    explicit = os.environ.get("BIGDL_JAX_CACHE")
+    if explicit:
+        return explicit
+    if os.environ.get("JAX_COMPILATION_CACHE_DIR"):
+        return None
+    return os.path.join(os.path.expanduser("~"), ".cache", "bigdl_jax")
+
+
+def enable_compile_cache() -> None:
+    """Point jax at the persistent compile cache: tunnel windows are
+    minutes long, so a re-run after a mid-window drop must not pay the
+    multi-minute TPU compile again (window-1 evidence: the cache works
+    under the axon backend)."""
+    cache = compile_cache_dir()
+    if cache is None:
+        return
+    import jax
+
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache)
+    except Exception:
+        pass  # older jax or read-only fs: compile as usual
+
+
 def apply_platform(args) -> None:
     """Honor --platform BEFORE any jax backend init. Uses the config API,
     not JAX_PLATFORMS (the env-var spelling hangs the axon plugin at
-    import in this environment)."""
+    import in this environment). Also enables the persistent compile
+    cache for every CLI."""
     platform = getattr(args, "platform", None)
     if platform:
         import jax
 
         jax.config.update("jax_platforms", platform)
+    enable_compile_cache()
 
 
 def add_train_args(p: argparse.ArgumentParser) -> None:

@@ -141,14 +141,10 @@ def run(model_name: str, batch: int, iterations: int, data_type: str,
     import jax.numpy as jnp
     import numpy as np
 
-    try:
-        # persistent compile cache: repeat benchmark runs (the capture
-        # sweeps re-measure the same configs) skip the 20-40s TPU compile
-        jax.config.update(
-            "jax_compilation_cache_dir",
-            os.environ.get("BIGDL_JAX_CACHE", "/tmp/bigdl_jax_cache"))
-    except Exception:
-        pass  # older jax or read-only fs: compile as usual
+    # persistent compile cache: repeat benchmark runs (the capture
+    # sweeps re-measure the same configs) skip the 20-40s TPU compile
+    from bigdl_tpu.cli.common import enable_compile_cache
+    enable_compile_cache()
 
     from bigdl_tpu import nn
     from bigdl_tpu.optim import SGD

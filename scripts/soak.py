@@ -55,6 +55,11 @@ def run(args):
 
     if args.cpu:
         jax.config.update("jax_platforms", "cpu")
+    # the whole point of this harness is kill -9 + re-spawn: the phase-2
+    # resume must load its TPU executable from the persistent cache, not
+    # re-pay the multi-minute compile out of the phase-2 budget
+    from bigdl_tpu.cli.common import enable_compile_cache
+    enable_compile_cache()
     from bigdl_tpu import nn
     from bigdl_tpu.dataset import RecordImageDataSet
     from bigdl_tpu.models import resnet_cifar
@@ -104,10 +109,27 @@ def orchestrate(args):
     if args.cpu:
         base.append("--cpu")
 
+    # If the sweep's step timeout SIGTERMs this orchestrator, the live
+    # training child must die too — an orphaned child would wedge the
+    # TPU device lock and block every later sweep step.
+    children = []
+
+    def _reap(signum, frame):
+        for c in children:
+            try:
+                c.kill()
+            except OSError:
+                pass
+        sys.exit(128 + signum)
+
+    signal.signal(signal.SIGTERM, _reap)
+    signal.signal(signal.SIGINT, _reap)
+
     os.makedirs(args.dir, exist_ok=True)
     _ensure_data(args.dir)        # dataset generation outside phase timing
     log1 = open(os.path.join(args.dir, "phase1.log"), "w")
     p = subprocess.Popen(base, stdout=log1, stderr=subprocess.STDOUT)
+    children.append(p)
     time.sleep(args.phase1)
     p.send_signal(signal.SIGKILL)      # uncleanly, mid-step by design
     p.wait()
@@ -117,7 +139,15 @@ def orchestrate(args):
     log2 = open(os.path.join(args.dir, "phase2.log"), "w")
     base[base.index("--minutes") + 1] = str(max(1.0, args.phase2 / 60.0))
     p2 = subprocess.Popen(base, stdout=log2, stderr=subprocess.STDOUT)
-    p2.wait(timeout=args.phase2 + 600)
+    children.append(p2)
+    try:
+        p2.wait(timeout=args.phase2 + 600)
+    except subprocess.TimeoutExpired:
+        # a wedged child (tunnel drop mid-step) must not outlive us and
+        # hold the TPU device lock; kill it and still emit the verdict
+        # from whatever rows landed
+        p2.kill()
+        p2.wait()
     rows2 = _read_train_rows(args.dir)
     new_rows = rows2[len(rows1):]
 
