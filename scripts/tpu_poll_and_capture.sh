@@ -8,11 +8,12 @@ cd "$(dirname "$0")/.."
 SWEEP="${1:-scripts/tpu_capture2.sh}"
 # Window 1 of round 5 lasted ~2.5 min: with the old 180 s sleep + 120 s
 # probe the worst-case detection latency (~5 min) could miss a whole
-# window. A live tunnel answers backend init in ~10-15 s, so a 45 s
-# probe timeout is ample and a 45 s sleep keeps worst-case detection
-# under ~90 s. A hung probe is killed by timeout — polling is free.
+# window. A warm tunnel answers backend init in ~10-15 s, but a COLD
+# libtpu init can take ~60 s — keep a 90 s probe timeout (so a cold
+# window is never misread as down) with a 45 s sleep: worst-case
+# detection ~135 s. A hung probe is killed by timeout — polling is free.
 while true; do
-  if timeout 45 python -c "
+  if timeout 90 python -c "
 import jax
 assert jax.default_backend() == 'tpu', jax.default_backend()
 print('tpu up:', jax.devices()[0].device_kind)
