@@ -363,7 +363,7 @@ def _make_class_image_tree(root: str, classes: int, per_class: int,
     ``hard=True`` encodes the class as a SUBTLE MEAN-CHROMA DIRECTION:
     every class shares the same gray luminance; class c tints the image
     toward hue angle 2*pi*c/classes with per-pixel amplitude ``lift``
-    (default 7) under noise sigma ``noise`` (default 35) — per-pixel SNR
+    (default 8) under noise sigma ``noise`` (default 35) — per-pixel SNR
     ~0.2, so the net must learn to pool chroma over the whole image.
 
     Why mean chroma: it is the only signal family that survives the

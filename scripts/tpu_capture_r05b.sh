@@ -66,7 +66,7 @@ if [ ! -f /tmp/synth_mnist_full/train-images-idx3-ubyte ]; then
   step "make_synth_mnist" 1200 python scripts/make_synth_mnist.py /tmp/synth_mnist_full 20000 4000
 fi
 step "lenet_convergence" 1800 ./scripts/run_example.sh lenet /tmp/synth_mnist_full -b 128 --maxEpoch 20 --learningRate 0.1
-step "time_to_acc_cifar_scale" 3600 python -m bigdl_tpu.cli.perf -m resnet20_cifar --timeToAcc 0.91 -b 128 --imageSize 32 --maxEpoch 156 --trainPerClass 5000 --valPerClass 1000 --ttaHard --valEvery 195
+step "time_to_acc_cifar_scale" 3600 python -m bigdl_tpu.cli.perf -m resnet20_cifar --timeToAcc 0.91 -b 128 --imageSize 32 --maxEpoch 156 --trainPerClass 5000 --valPerClass 1000 --ttaHard --ttaLift 7 --valEvery 65
 step "time_to_acc_resnet50" 2400 python -m bigdl_tpu.cli.perf -m resnet50 --timeToAcc 0.85 -b 64 --imageSize 224 --maxEpoch 15
 
 # 8. sustained-training soak on chip (VERDICT r4 stretch item 9):

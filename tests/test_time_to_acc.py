@@ -69,3 +69,37 @@ def test_summary_rows_carry_wall_clock(tmp_path):
         assert rows and all("wall_s" in r for r in rows), fname
         assert all(a["wall_s"] <= b["wall_s"]
                    for a, b in zip(rows, rows[1:])), fname
+
+
+def test_hard_grade_chroma_is_luma_orthogonal(tmp_path):
+    """The hard grade's class signal must be invisible to the JPEG luma
+    channel and uniform in magnitude across classes (PERF.md §8.1.1:
+    luma leakage made ang≈±90° classes separable from luminance alone).
+    Checks the generated JPEGs themselves: per-class mean Rec.601 luma
+    spread stays within noise while mean chroma separates classes."""
+    from bigdl_tpu.cli.perf import _make_class_image_tree, resolve_grade
+    from PIL import Image
+    import os
+
+    root = str(tmp_path / "tree")
+    _make_class_image_tree(root, classes=4, per_class=24, size=32,
+                           seed=0, hard=True)
+    lift, noise = resolve_grade(True, None, None)
+    lumas, chromas = [], []
+    for c in range(4):
+        d = os.path.join(root, f"class{c:03d}")
+        px = np.stack([np.asarray(Image.open(os.path.join(d, f)),
+                                  np.float32)
+                       for f in sorted(os.listdir(d))])
+        mean_rgb = px.mean(axis=(0, 1, 2))          # (3,)
+        lumas.append(mean_rgb @ np.array([0.299, 0.587, 0.114]))
+        chromas.append(mean_rgb - mean_rgb.mean())
+    # luma spread across classes << the chroma signal amplitude
+    assert np.ptp(lumas) < 0.35 * lift, lumas
+    # chroma means must separate classes: pairwise distances all
+    # comfortably above the sample-noise floor
+    chromas = np.stack(chromas)
+    for i in range(4):
+        for j in range(i + 1, 4):
+            assert np.linalg.norm(chromas[i] - chromas[j]) > 0.5 * lift, (
+                i, j, chromas)
