@@ -62,6 +62,15 @@ def apply_platform(args) -> None:
 
         jax.config.update("jax_platforms", platform)
     enable_compile_cache()
+    spec = getattr(args, "convLayout", None)
+    if spec:
+        # explicit per-pass conv layouts (or 'auto'/'default') — wins
+        # over the measured-decision auto-install the Optimizer does
+        from bigdl_tpu.ops.conv2d import install_layout_spec
+        try:
+            install_layout_spec(spec)
+        except ValueError as e:
+            raise SystemExit(str(e))
 
 
 def add_train_args(p: argparse.ArgumentParser) -> None:
@@ -77,6 +86,15 @@ def add_train_args(p: argparse.ArgumentParser) -> None:
     p.add_argument("--maxEpoch", type=int, default=5)
     p.add_argument("--checkpoint", default=None,
                    help="dir for model.<n>/state.<n> snapshots")
+    p.add_argument("--convLayout", default=None,
+                   metavar="FWD,DGRAD,WGRAD",
+                   help="per-pass conv activation layouts (NHWC|NCHW "
+                        "each, or 'auto'/'default'). Unset = 'auto': "
+                        "the measured probe decision shipped for this "
+                        "device kind (ops/conv2d.MEASURED_DECISIONS, "
+                        "+1.1%% ResNet-50 train throughput on TPU v5 "
+                        "lite), no-op on unmeasured devices; 'default' "
+                        "forces all-NHWC")
     p.add_argument("--model", default=None,
                    help="checkpoint dir to resume model from")
     p.add_argument("--overWriteCheckpoint", action="store_true")

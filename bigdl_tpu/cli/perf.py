@@ -131,6 +131,16 @@ def _record_batches(source: str, batch: int, n_threads: int = 0):
             yield mb
 
 
+def _annotate_conv_layouts(out: dict) -> None:
+    """Stamp the active non-default conv layout policy into a result dict
+    — shared by run() and run_time_to_acc() so their JSON provenance
+    cannot drift apart."""
+    from bigdl_tpu.ops.conv2d import conv_layouts_if_nondefault
+    cl = conv_layouts_if_nondefault()
+    if cl:
+        out["conv_layouts"] = cl
+
+
 def run(model_name: str, batch: int, iterations: int, data_type: str,
         use_bf16: bool = True, data_parallel: bool = False,
         data_source: str | None = None, inner_steps: int = 1,
@@ -145,6 +155,11 @@ def run(model_name: str, batch: int, iterations: int, data_type: str,
     # sweeps re-measure the same configs) skip the 20-40s TPU compile
     from bigdl_tpu.cli.common import enable_compile_cache
     enable_compile_cache()
+
+    # shipped conv-layout decision for this device (no-op if the CLI
+    # installed an explicit --convLayout, or the device is unmeasured)
+    from bigdl_tpu.ops.conv2d import maybe_install_auto
+    maybe_install_auto()
 
     from bigdl_tpu import nn
     from bigdl_tpu.optim import SGD
@@ -320,6 +335,7 @@ def run(model_name: str, batch: int, iterations: int, data_type: str,
         "step_gflops_hlo": round(flops_hlo / 1e9, 3),
         "device": getattr(jax.devices()[0], "device_kind", "unknown"),
     }
+    _annotate_conv_layouts(out)
     if flops_error is not None:
         out["flops_analytic_error"] = flops_error
     if flops_analytic and flops_hlo:
@@ -531,6 +547,7 @@ def run_time_to_acc(model_name: str, batch: int, target: float,
         "curve": [{"wall_s": r.get("wall_s"),
                    "top1": r.get("top1_accuracy")} for r in curve],
     }
+    _annotate_conv_layouts(out)
     print(json.dumps(out))
     return out
 
@@ -594,20 +611,21 @@ def main(argv=None):
                         "recipe value 1e-4)")
     p.add_argument("--convLayout", default=None, metavar="FWD,DGRAD,WGRAD",
                    help="per-pass conv activation layouts (NHWC|NCHW "
-                        "each), e.g. NHWC,NCHW,NCHW — install a "
-                        "scripts/conv_bwd_probe.py decision (see "
-                        "scripts/apply_conv_probe.py) before compiling")
+                        "each, or 'auto'/'default') — e.g. a "
+                        "scripts/conv_bwd_probe.py decision via "
+                        "scripts/apply_conv_probe.py. Unset = 'auto': "
+                        "the measured decision shipped for this device "
+                        "kind (ops/conv2d.MEASURED_DECISIONS), no-op on "
+                        "unmeasured devices; 'default' forces all-NHWC")
     from bigdl_tpu.cli.common import _add_platform_arg, apply_platform
     _add_platform_arg(p)
     args = p.parse_args(argv)
     apply_platform(args)
     if args.convLayout:
-        from bigdl_tpu.ops import set_conv_pass_layouts
-        parts = args.convLayout.upper().split(",")
-        if len(parts) != 3:
-            raise SystemExit("--convLayout wants FWD,DGRAD,WGRAD")
-        print("conv pass layouts:",
-              set_conv_pass_layouts(*parts), flush=True)
+        # apply_platform already installed the spec (SystemExit on a bad
+        # one); just surface what's active for the capture logs
+        from bigdl_tpu.ops.conv2d import get_conv_pass_layouts
+        print("conv pass layouts:", get_conv_pass_layouts(), flush=True)
     if args.timeToAcc is not None:
         data_dir = None
         if args.data and args.data.startswith("record:"):

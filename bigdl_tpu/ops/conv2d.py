@@ -38,11 +38,28 @@ import jax.numpy as jnp
 from jax import lax
 
 __all__ = ["conv2d", "set_conv_pass_layouts", "get_conv_pass_layouts",
-           "decide_from_probe"]
+           "decide_from_probe", "resolve_layout_spec",
+           "install_layout_spec", "maybe_install_auto",
+           "MEASURED_DECISIONS"]
 
 _PASSES = ("fwd", "dgrad", "wgrad")
 _DEFAULT = {"fwd": "NHWC", "dgrad": "NHWC", "wgrad": "NHWC"}
 _POLICY: Dict[str, str] = dict(_DEFAULT)
+# True once a caller installed a policy explicitly (CLI flag or API call);
+# maybe_install_auto() then leaves the policy alone
+_EXPLICIT = False
+
+# Probe decisions measured on real hardware, shipped as the framework
+# default for matching devices. Provenance: round-5 window-2 on-chip
+# probe + same-window end-to-end A/B (PERF.md §8.2, CONV_PROBE_r05.jsonl)
+# — on TPU v5 lite the filter-grad pass prefers NCHW (aggregate wgrad
+# 0.26 ms NHWC vs 0.15 ms NCHW across the ResNet-50 shape set; the stem's
+# wgrad alone is 7x: 0.146 vs 0.021 ms) and the decision measured
+# +1.1% end-to-end train throughput on ResNet-50 b128 (2,634.8 ->
+# 2,662.7 img/s). Unlisted devices resolve to the all-NHWC default.
+MEASURED_DECISIONS: Dict[str, Dict[str, str]] = {
+    "TPU v5 lite": {"fwd": "NHWC", "dgrad": "NHWC", "wgrad": "NCHW"},
+}
 
 
 def set_conv_pass_layouts(fwd: str = "NHWC", dgrad: str = "NHWC",
@@ -50,10 +67,73 @@ def set_conv_pass_layouts(fwd: str = "NHWC", dgrad: str = "NHWC",
     """Install the per-pass activation layouts (each "NHWC" or "NCHW").
     Call before jit-compiling the train step; layouts are trace-time
     constants. Returns the installed policy."""
+    global _EXPLICIT
     for v in (fwd, dgrad, wgrad):
         if v not in ("NHWC", "NCHW"):
             raise ValueError(f"layout must be NHWC or NCHW, got {v!r}")
     _POLICY.update(fwd=fwd, dgrad=dgrad, wgrad=wgrad)
+    _EXPLICIT = True
+    return dict(_POLICY)
+
+
+def reset_conv_pass_layouts() -> Dict[str, str]:
+    """Restore the all-NHWC default AND clear the explicit flag, so a
+    subsequent :func:`maybe_install_auto` resolves again (tests; a
+    library user who wants plain all-NHWC should instead install it
+    explicitly via ``set_conv_pass_layouts()``)."""
+    global _EXPLICIT
+    _POLICY.update(_DEFAULT)
+    _EXPLICIT = False
+    return dict(_POLICY)
+
+
+def resolve_layout_spec(spec: str, device=None) -> Dict[str, str]:
+    """Resolve a ``--convLayout`` value to a per-pass dict (not installed).
+
+    ``"default"`` is all-NHWC; ``"auto"`` looks this device's kind up in
+    :data:`MEASURED_DECISIONS` (all-NHWC when absent, so auto is safe on
+    any backend); ``"FWD,DGRAD,WGRAD"`` is explicit. Raises ValueError on
+    a malformed spec."""
+    low = (spec or "auto").strip().lower()
+    if low == "default":
+        return dict(_DEFAULT)
+    if low == "auto":
+        if device is None:
+            try:
+                device = jax.devices()[0]
+            except Exception:
+                return dict(_DEFAULT)
+        return dict(MEASURED_DECISIONS.get(
+            getattr(device, "device_kind", ""), _DEFAULT))
+    parts = spec.strip().upper().split(",")
+    if len(parts) != 3 or any(p not in ("NHWC", "NCHW") for p in parts):
+        raise ValueError("convLayout spec wants FWD,DGRAD,WGRAD "
+                         "(NHWC|NCHW each), 'auto' or 'default'; "
+                         f"got {spec!r}")
+    return dict(zip(_PASSES, parts))
+
+
+def install_layout_spec(spec: str, device=None) -> Dict[str, str]:
+    """Resolve ``spec`` and install it as an explicit policy (wins over
+    any later :func:`maybe_install_auto`). Returns the installed dict."""
+    return set_conv_pass_layouts(**resolve_layout_spec(spec, device))
+
+
+def conv_layouts_if_nondefault() -> "Dict[str, str] | None":
+    """The active policy when it differs from all-NHWC, else None —
+    result-JSON provenance helper for the perf/TTA harnesses."""
+    return None if _POLICY == _DEFAULT else dict(_POLICY)
+
+
+def maybe_install_auto(device=None) -> Dict[str, str]:
+    """Install this device's measured decision unless a policy was already
+    installed explicitly. Called by the training entry points (Optimizer,
+    perf harness) right before compiling, when the backend is known —
+    this is how a shipped probe decision becomes the framework default
+    without overriding a user's ``--convLayout``. Returns the active
+    policy."""
+    if not _EXPLICIT:
+        _POLICY.update(resolve_layout_spec("auto", device))
     return dict(_POLICY)
 
 

@@ -196,6 +196,12 @@ class Optimizer:
 
     # ---------------------------------------------------------------- build
     def _build_step(self):
+        # shipped conv-layout decision for this device (PERF.md §8.2;
+        # no-op when a --convLayout/API policy is already installed or
+        # the device kind has no measured row)
+        from bigdl_tpu.ops.conv2d import maybe_install_auto
+        maybe_install_auto()
+
         model, criterion, opt = self.model, self.criterion, self.optim_method
 
         dtype = self.compute_dtype

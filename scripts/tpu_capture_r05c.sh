@@ -1,0 +1,82 @@
+#!/usr/bin/env bash
+# Round-5 window-2 follow-on sweep. Window 2 (03:47Z+) measured every
+# individual lever on chip: conv-layout decision +1.1%, s2d +1.5%,
+# innerSteps=10 +1.6%, fused-BN -46% (negative, twice). This sweep
+# captures what r05b cannot: the COMBINED best config (r05b's only
+# combined step uses the now-known-negative fbn), then finishes any
+# long-tail step r05b hasn't already banked. Steps are probe-gated like
+# r05b and additionally skip-if-banked: a step whose "=== end NAME rc=0"
+# already appears in the repo log is not re-run, so a tunnel drop +
+# re-fire resumes instead of restarting.
+set -uo pipefail
+cd "$(dirname "$0")/.."
+OUT="${OUT:-/tmp/tpu_capture_r05.log}"
+REPO_LOG="${REPO_LOG:-TPU_CAPTURE_r05.log}"
+LAYOUT="NHWC,NHWC,NCHW"   # decision from conv_probe_apply, window 2
+# seed OUT from the banked repo log when /tmp was cleaned (reboot), so
+# the per-step cp back to REPO_LOG never clobbers banked results and
+# skip-if-banked keeps working
+if [ -f "$REPO_LOG" ] && { [ ! -f "$OUT" ] || [ "$(wc -c <"$REPO_LOG")" -gt "$(wc -c <"$OUT")" ]; }; then
+  cp -f "$REPO_LOG" "$OUT"
+fi
+trap 'cp -f "$OUT" "$REPO_LOG" 2>/dev/null || true' EXIT
+
+probe() {
+  timeout 90 python - <<'EOF' >/dev/null 2>&1
+import jax, jax.numpy as jnp
+assert jax.default_backend() == "tpu"
+(jnp.ones((128, 128)) @ jnp.ones((128, 128))).block_until_ready()
+EOF
+}
+
+step() {
+  local name="$1" tmo="$2"; shift 2
+  if grep -q "=== end $name rc=0" "$REPO_LOG" "$OUT" 2>/dev/null; then
+    echo "=== skip $name: already banked" ; return 0
+  fi
+  if ! probe; then
+    echo "=== ABORT before $name: tunnel dead ($(date -u +%H:%M:%SZ)); re-arming poller" | tee -a "$OUT"
+    cp -f "$OUT" "$REPO_LOG" 2>/dev/null || true
+    exec bash scripts/tpu_poll_and_capture.sh scripts/tpu_capture_r05c.sh
+  fi
+  echo "=== $name ($(date -u +%H:%M:%SZ))" | tee -a "$OUT"
+  timeout "$tmo" "$@" 2>&1 | tail -30 | tee -a "$OUT"
+  echo "=== end $name rc=$?" | tee -a "$OUT"
+  cp -f "$OUT" "$REPO_LOG" 2>/dev/null || true
+}
+
+# 1. combined-lever A/Bs (all individually positive in window 2).
+# NOTE: perf.run now AUTO-INSTALLS the measured decision on v5lite when
+# --convLayout is omitted — layout-free control arms must pin
+# '--convLayout default' explicitly or they silently run with $LAYOUT.
+step "perf_rn50_s2d_layout" 900 python -m bigdl_tpu.cli.perf -m resnet50_s2d -b 128 -i 20 --dataType random --convLayout "$LAYOUT"
+step "perf_rn50_layout_inner10" 900 python -m bigdl_tpu.cli.perf -m resnet50 -b 128 -i 4 --innerSteps 10 --dataType random --convLayout "$LAYOUT"
+step "perf_rn50_s2d_inner10" 900 python -m bigdl_tpu.cli.perf -m resnet50_s2d -b 128 -i 4 --innerSteps 10 --dataType random --convLayout default
+step "perf_rn50_best_combo" 900 python -m bigdl_tpu.cli.perf -m resnet50_s2d -b 128 -i 4 --innerSteps 10 --dataType random --convLayout "$LAYOUT"
+step "perf_rn50_best_combo_b256" 900 python -m bigdl_tpu.cli.perf -m resnet50_s2d -b 256 -i 4 --innerSteps 10 --dataType random --convLayout "$LAYOUT"
+
+# 2. long tail, exactly r05b's set, skipped when already banked
+step "perf_resnet50_bnss_b128" 900 python -m bigdl_tpu.cli.perf -m resnet50_bnss -b 128 -i 20 --dataType random
+step "flash_bench" 1800 python scripts/flash_bench.py 4 8 64
+for B in 64 256 512; do
+  step "perf_resnet50_b$B" 900 python -m bigdl_tpu.cli.perf -m resnet50 -b "$B" -i 20 --dataType random
+done
+step "perf_transformer_lm_rope_b32" 900 python -m bigdl_tpu.cli.perf -m transformer_lm_rope -b 32 -i 10 --dataType random
+step "bench_pipe" 2400 env BENCH_TPU_TIMEOUT=2000 BENCH_COMPANIONS=0 python bench.py resnet50_pipe 128 20
+# data prep is HOST-side (no device, no probe) and must key on the data
+# files, not the banked log — after a /tmp wipe the banked "rc=0" would
+# otherwise skip regeneration and starve the training steps
+if [ ! -f /tmp/synth_mnist_full/train-images-idx3-ubyte ]; then
+  echo "=== make_synth_mnist host-side ($(date -u +%H:%M:%SZ))" | tee -a "$OUT"
+  timeout 1200 python scripts/make_synth_mnist.py /tmp/synth_mnist_full 20000 4000 2>&1 | tail -5 | tee -a "$OUT"
+fi
+step "lenet_convergence" 1800 ./scripts/run_example.sh lenet /tmp/synth_mnist_full -b 128 --maxEpoch 20 --learningRate 0.1
+step "time_to_acc_cifar_scale" 3600 python -m bigdl_tpu.cli.perf -m resnet20_cifar --timeToAcc 0.91 -b 128 --imageSize 32 --maxEpoch 156 --trainPerClass 5000 --valPerClass 1000 --ttaHard --ttaLift 7 --valEvery 65
+step "time_to_acc_resnet50" 2400 python -m bigdl_tpu.cli.perf -m resnet50 --timeToAcc 0.85 -b 64 --imageSize 224 --maxEpoch 15
+# _ensure_data is idempotent (returns fast when the shards exist) — run
+# it unconditionally host-side for the same /tmp-wipe reason
+echo "=== soak_data_prep host-side ($(date -u +%H:%M:%SZ))" | tee -a "$OUT"
+timeout 1500 python -c "import sys; sys.path.insert(0, '.'); from scripts.soak import _ensure_data; print(_ensure_data('/tmp/soak_chip'))" 2>&1 | tail -3 | tee -a "$OUT"
+step "soak_chip" 3300 python scripts/soak.py orchestrate --dir /tmp/soak_chip --batch 128 --ckpt-every 50 --phase1 1500 --phase2 480
+
+echo "r05c sweep complete -> $OUT" | tee -a "$OUT"

@@ -197,8 +197,8 @@ class TestConvLayoutPolicy:
     assignment, never the math."""
 
     def teardown_method(self):
-        from bigdl_tpu.ops import set_conv_pass_layouts
-        set_conv_pass_layouts()  # restore default
+        from bigdl_tpu.ops.conv2d import reset_conv_pass_layouts
+        reset_conv_pass_layouts()  # default + clear the explicit flag
 
     def _loss_and_grads(self, mod, params, x):
         def loss(p, xx):
@@ -273,6 +273,70 @@ class TestConvLayoutPolicy:
         assert d == {"fwd": "NHWC", "dgrad": "NCHW", "wgrad": "NHWC"}
         with pytest.raises(ValueError, match="no probe rows"):
             decide_from_probe(["not json", ""])
+
+
+class TestShippedLayoutDecision:
+    """The measured probe decision ships as the framework default
+    (ops/conv2d.MEASURED_DECISIONS, window-2 provenance in PERF.md §8.2):
+    'auto' resolves per device kind, explicit installs win over auto."""
+
+    class _Dev:
+        def __init__(self, kind):
+            self.device_kind = kind
+
+    def teardown_method(self):
+        from bigdl_tpu.ops.conv2d import reset_conv_pass_layouts
+        reset_conv_pass_layouts()
+
+    def test_resolve_spec(self):
+        from bigdl_tpu.ops.conv2d import resolve_layout_spec
+
+        assert resolve_layout_spec("default") == {
+            "fwd": "NHWC", "dgrad": "NHWC", "wgrad": "NHWC"}
+        assert resolve_layout_spec("nhwc,nchw,nchw") == {
+            "fwd": "NHWC", "dgrad": "NCHW", "wgrad": "NCHW"}
+        # the measured v5e decision: wgrad-NCHW
+        assert resolve_layout_spec(
+            "auto", self._Dev("TPU v5 lite")) == {
+            "fwd": "NHWC", "dgrad": "NHWC", "wgrad": "NCHW"}
+        # unmeasured device -> safe no-op default
+        assert resolve_layout_spec(
+            "auto", self._Dev("TPU v9 colossal")) == {
+            "fwd": "NHWC", "dgrad": "NHWC", "wgrad": "NHWC"}
+        with pytest.raises(ValueError, match="convLayout spec"):
+            resolve_layout_spec("NHWC,NCHW")
+
+    def test_auto_install_and_explicit_precedence(self):
+        from bigdl_tpu.ops.conv2d import (get_conv_pass_layouts,
+                                          maybe_install_auto,
+                                          reset_conv_pass_layouts,
+                                          set_conv_pass_layouts)
+
+        reset_conv_pass_layouts()
+        # auto install resolves the measured decision for the device
+        pol = maybe_install_auto(self._Dev("TPU v5 lite"))
+        assert pol["wgrad"] == "NCHW"
+        assert get_conv_pass_layouts() == pol
+        # an explicit install (CLI --convLayout / API) wins over a later
+        # auto attempt — the Optimizer must not stomp user choices
+        set_conv_pass_layouts("NCHW", "NCHW", "NCHW")
+        pol = maybe_install_auto(self._Dev("TPU v5 lite"))
+        assert pol == {"fwd": "NCHW", "dgrad": "NCHW", "wgrad": "NCHW"}
+        # ...including an explicit request for the all-NHWC default
+        reset_conv_pass_layouts()
+        set_conv_pass_layouts()
+        pol = maybe_install_auto(self._Dev("TPU v5 lite"))
+        assert pol == {"fwd": "NHWC", "dgrad": "NHWC", "wgrad": "NHWC"}
+
+    def test_install_layout_spec_auto_on_cpu_is_noop(self):
+        # 'auto' on an unmeasured device resolves to default: training
+        # paths unchanged (fake device, not the ambient backend — this
+        # suite also runs unfiltered on the TPU capture host)
+        from bigdl_tpu.ops.conv2d import (install_layout_spec,
+                                          is_default_policy)
+
+        install_layout_spec("auto", self._Dev("cpu"))
+        assert is_default_policy()
 
 
 def test_decide_from_probe_rejects_truncated_coverage():
