@@ -86,6 +86,15 @@ def add_train_args(p: argparse.ArgumentParser) -> None:
     p.add_argument("--maxEpoch", type=int, default=5)
     p.add_argument("--checkpoint", default=None,
                    help="dir for model.<n>/state.<n> snapshots")
+    p.add_argument("--stepsPerDispatch", type=int, default=1,
+                   help="scan K optimizer steps over K prefetched batches "
+                        "inside one jitted program — amortizes the "
+                        "~2.5-3.5 ms per-dispatch overhead of the "
+                        "tunneled runtime (+1.6%% ResNet-50 throughput "
+                        "at K=10, PERF.md §8.2). Update math and RNG "
+                        "sequence identical to K=1; iteration-counted "
+                        "triggers fire at the next dispatch boundary. "
+                        "Single-device only")
     p.add_argument("--convLayout", default=None,
                    metavar="FWD,DGRAD,WGRAD",
                    help="per-pass conv activation layouts (NHWC|NCHW "
@@ -178,7 +187,8 @@ def build_optimizer(model, dataset, criterion, args, schedule=None,
                     optim_method=optim_method,
                     end_when=Trigger.max_epoch(args.maxEpoch),
                     strategy=build_strategy(args), seed=args.seed,
-                    log_every=args.logEvery)
+                    log_every=args.logEvery,
+                    steps_per_dispatch=getattr(args, "stepsPerDispatch", 1))
     if args.checkpoint:
         os.makedirs(args.checkpoint, exist_ok=True)
         opt.set_checkpoint(Trigger.every_epoch(), args.checkpoint,

@@ -25,6 +25,7 @@ from typing import Any, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from bigdl_tpu.core.module import Module
 from bigdl_tpu.core.criterion import Criterion
@@ -59,7 +60,8 @@ class Optimizer:
                  end_when: Optional[Trigger] = None,
                  strategy=None, seed: int = 42, log_every: int = 1,
                  compute_dtype=None, accum_steps: int = 1,
-                 nan_check: bool = True, aux_loss_weight: float = 0.01):
+                 nan_check: bool = True, aux_loss_weight: float = 0.01,
+                 steps_per_dispatch: int = 1):
         self.model = model
         self.dataset = dataset
         self.criterion = criterion
@@ -84,6 +86,26 @@ class Optimizer:
         # added to the criterion loss with this weight (Switch Transformer's
         # 0.01 default). Set 0.0 to disable.
         self.aux_loss_weight = aux_loss_weight
+        # steps_per_dispatch > 1: lax.scan K optimizer steps over K
+        # prefetched batches inside ONE jitted program, amortizing the
+        # per-dispatch host->device overhead (~2.5-3.5 ms through the
+        # tunneled runtime; measured +1.6% ResNet-50 throughput at K=10,
+        # PERF.md §8.2). Update math and the per-step RNG sequence are
+        # IDENTICAL to K dispatches (keys are pre-split host-side);
+        # iteration-counted triggers fire at the first dispatch boundary
+        # at or after their threshold (Trigger.several_iteration is
+        # crossing-based). Single-device path only: under a distributed
+        # strategy the per-dispatch overhead is already pipelined by the
+        # multi-controller runtime and batches arrive pre-sharded.
+        self.steps_per_dispatch = int(steps_per_dispatch)
+        if self.steps_per_dispatch < 1:
+            raise ValueError(
+                f"steps_per_dispatch must be >= 1, got {steps_per_dispatch}")
+        if self.steps_per_dispatch > 1 and strategy is not None:
+            raise ValueError(
+                "steps_per_dispatch > 1 is a single-device dispatch "
+                "amortization; it cannot be combined with a distributed "
+                "strategy (whose runtime pipelines dispatch already)")
         self._val_trigger = None
         self._val_dataset = None
         self._val_methods: Sequence[ValidationMethod] = ()
@@ -292,8 +314,26 @@ class Optimizer:
                     "kernel would replicate sharded activations under the "
                     "%d-device mesh (jnp stats path used instead)",
                     unfused, n_dev)
-            return self.strategy.compile_step(train_step)
-        return jax.jit(train_step, donate_argnums=(0, 1, 2))
+            return self.strategy.compile_step(train_step), None
+        step = jax.jit(train_step, donate_argnums=(0, 1, 2))
+        chunk = None
+        if self.steps_per_dispatch > 1:
+            # K steps scanned inside one program over K stacked batches +
+            # K pre-split rng keys; returns the LAST step's loss (what K
+            # sequential dispatches would have left in driver["loss"])
+            def chunk_step(params, mod_state, opt_state, xs, ys, keys):
+                def body(carry, inp):
+                    p, m, o = carry
+                    xb, yb, kb = inp
+                    p, m, o, loss = train_step(p, m, o, xb, yb, kb)
+                    return (p, m, o), loss
+
+                (p, m, o), losses = jax.lax.scan(
+                    body, (params, mod_state, opt_state), (xs, ys, keys))
+                return p, m, o, losses[-1]
+
+            chunk = jax.jit(chunk_step, donate_argnums=(0, 1, 2))
+        return step, chunk
 
     def _build_eval(self):
         from bigdl_tpu.optim.validator import build_eval_fn
@@ -313,11 +353,11 @@ class Optimizer:
             params, mod_state, opt_state = self.strategy.place(
                 params, mod_state, opt_state)
 
-        step_fn = self._build_step()
+        step_fn, chunk_fn = self._build_step()
         eval_fn = self._build_eval() if self._val_methods else None
 
-        driver = {"epoch": 1, "iteration": 0, "epoch_finished": False,
-                  "loss": float("inf")}
+        driver = {"epoch": 1, "iteration": 0, "prev_iteration": 0,
+                  "epoch_finished": False, "loss": float("inf")}
         wall_start = time.time()
         self._wall_start = wall_start
         records_this_epoch = 0
@@ -325,71 +365,124 @@ class Optimizer:
         last_log_t = time.time()
         fetch_accum = 0.0
 
+        def after_dispatch(n_rec, n_iters, t0, loss):
+            """Advance counters and emit the log point after one dispatch
+            (one step, or a steps_per_dispatch chunk of n_iters steps)."""
+            nonlocal last_log_t, fetch_accum, records_this_epoch
+            prev_it = driver["iteration"]
+            driver["prev_iteration"] = prev_it
+            driver["iteration"] = prev_it + n_iters
+            # keep `loss` a device array between log points so dispatch
+            # N+1 can be enqueued while N still runs on device
+            driver["loss"] = loss
+            records_this_epoch += n_rec
+            # crossing-based (== modulo for n_iters=1): a chunk that jumps
+            # the counter past a multiple of log_every still logs
+            if driver["iteration"] // self.log_every != prev_it // self.log_every:
+                loss_f = float(loss)
+                driver["loss"] = loss_f
+                if self.nan_check and not math.isfinite(loss_f):
+                    raise FloatingPointError(
+                        f"loss became {loss_f} at iteration "
+                        f"{driver['iteration']} (epoch "
+                        f"{driver['epoch']}) — NaN guard tripped; last "
+                        f"checkpoint is the recovery point")
+                dt = time.time() - t0
+                # both counters cover the SAME interval (since the last
+                # log point), so their sums are comparable: host wall
+                # time = batch fetch + compute/dispatch/device wait
+                now = time.time()
+                self.metrics.add("get batch time", fetch_accum)
+                self.metrics.add("computing time",
+                                 (now - last_log_t) - fetch_accum)
+                last_log_t, fetch_accum = now, 0.0
+                logger.info(
+                    "Train %d in %.4fs. Throughput is %.1f "
+                    "records/second. Loss is %.4f",
+                    n_rec, dt, n_rec / max(dt, 1e-9), loss_f)
+                self._summary_write("train", {
+                    "iteration": driver["iteration"],
+                    "epoch": driver["epoch"],
+                    "loss": loss_f,
+                    "records_per_second": n_rec / max(dt, 1e-9)})
+                # reference logs metrics.summary() at debug each
+                # iteration (DistriOptimizer.scala:245); guard so the
+                # string is only built when it will be emitted
+                if logger.isEnabledFor(logging.DEBUG):
+                    logger.debug("%s", self.metrics.summary())
+
+        def _shape_sig(b):
+            bx, by = b
+            return (np.shape(bx), tuple(
+                np.shape(l) for l in jax.tree_util.tree_leaves(by)))
+
+        K = self.steps_per_dispatch
         while not self.end_when(driver):
             driver["epoch_finished"] = False
             epoch_start = time.time()
             records_this_epoch = 0
             opt_state = self.optim_method.set_epoch(opt_state, driver["epoch"])
             data_iter = iter(self.dataset)
-            while True:
+            pending = None  # batch fetched but shape-incompatible w/ chunk
+            epoch_done = False
+            while not epoch_done:
+                # fetch one dispatch group: a single batch (K=1), or up to
+                # K same-shape batches to scan inside one program
                 t_fetch = time.time()
-                batch = next(data_iter, _end)
-                if batch is _end:
-                    break
+                buf = []
+                while len(buf) < K:
+                    b = pending if pending is not None else next(
+                        data_iter, _end)
+                    pending = None
+                    if b is _end:
+                        epoch_done = True
+                        break
+                    if buf and _shape_sig(b) != _shape_sig(buf[0]):
+                        pending = b  # ragged tail: flush, retry next group
+                        break
+                    buf.append(b)
                 fetch_accum += time.time() - t_fetch
-                t0 = time.time()
-                x, y = batch
-                if self.strategy is not None:
-                    x, y = self.strategy.shard_batch(x, y)
-                else:
-                    # target may be a pytree (e.g. Mixup's (y_a, y_b, lam))
-                    x = jnp.asarray(x)
-                    y = jax.tree_util.tree_map(jnp.asarray, y)
-                rng, k_step = jax.random.split(rng)
-                params, mod_state, opt_state, loss = step_fn(
-                    params, mod_state, opt_state, x, y, k_step)
-                n = len(x)
-                driver["iteration"] += 1
-                # keep `loss` a device array between log points so step N+1
-                # can dispatch while step N still runs on device
-                driver["loss"] = loss
-                records_this_epoch += n
-                if driver["iteration"] % self.log_every == 0:
-                    loss_f = float(loss)
-                    driver["loss"] = loss_f
-                    if self.nan_check and not math.isfinite(loss_f):
-                        raise FloatingPointError(
-                            f"loss became {loss_f} at iteration "
-                            f"{driver['iteration']} (epoch "
-                            f"{driver['epoch']}) — NaN guard tripped; last "
-                            f"checkpoint is the recovery point")
-                    dt = time.time() - t0
-                    # both counters cover the SAME interval (since the last
-                    # log point), so their sums are comparable: host wall
-                    # time = batch fetch + compute/dispatch/device wait
-                    now = time.time()
-                    self.metrics.add("get batch time", fetch_accum)
-                    self.metrics.add("computing time",
-                                     (now - last_log_t) - fetch_accum)
-                    last_log_t, fetch_accum = now, 0.0
-                    logger.info(
-                        "Train %d in %.4fs. Throughput is %.1f "
-                        "records/second. Loss is %.4f",
-                        n, dt, n / max(dt, 1e-9), loss_f)
-                    self._summary_write("train", {
-                        "iteration": driver["iteration"],
-                        "epoch": driver["epoch"],
-                        "loss": loss_f,
-                        "records_per_second": n / max(dt, 1e-9)})
-                    # reference logs metrics.summary() at debug each
-                    # iteration (DistriOptimizer.scala:245); guard so the
-                    # string is only built when it will be emitted
-                    if logger.isEnabledFor(logging.DEBUG):
-                        logger.debug("%s", self.metrics.summary())
-                self._maybe_validate(eval_fn, params, mod_state, driver)
-                self._maybe_checkpoint(params, mod_state, opt_state, driver)
-                if self.end_when(driver):
+                if not buf:
                     break
+                if chunk_fn is not None and len(buf) == K:
+                    t0 = time.time()
+                    xs = jnp.stack([jnp.asarray(bx) for bx, _ in buf])
+                    ys = jax.tree_util.tree_map(
+                        lambda *ls: jnp.stack([jnp.asarray(l) for l in ls]),
+                        *[by for _, by in buf])
+                    keys = []
+                    for _ in range(K):  # same host key sequence as K=1
+                        rng, k_step = jax.random.split(rng)
+                        keys.append(k_step)
+                    params, mod_state, opt_state, loss = chunk_fn(
+                        params, mod_state, opt_state, xs, ys,
+                        jnp.stack(keys))
+                    after_dispatch(sum(len(bx) for bx, _ in buf), K, t0,
+                                   loss)
+                    self._maybe_validate(eval_fn, params, mod_state, driver)
+                    self._maybe_checkpoint(params, mod_state, opt_state,
+                                           driver)
+                    if self.end_when(driver):
+                        break
+                    continue
+                for x, y in buf:  # K == 1, or a ragged/short group
+                    t0 = time.time()
+                    if self.strategy is not None:
+                        x, y = self.strategy.shard_batch(x, y)
+                    else:
+                        # target may be a pytree (Mixup's (y_a, y_b, lam))
+                        x = jnp.asarray(x)
+                        y = jax.tree_util.tree_map(jnp.asarray, y)
+                    rng, k_step = jax.random.split(rng)
+                    params, mod_state, opt_state, loss = step_fn(
+                        params, mod_state, opt_state, x, y, k_step)
+                    after_dispatch(len(x), 1, t0, loss)
+                    self._maybe_validate(eval_fn, params, mod_state, driver)
+                    self._maybe_checkpoint(params, mod_state, opt_state,
+                                           driver)
+                    if self.end_when(driver):
+                        epoch_done = True
+                        break
             driver["epoch"] += 1
             driver["epoch_finished"] = True
             self.dataset.shuffle()

@@ -28,9 +28,20 @@ class Trigger:
 
     @staticmethod
     def several_iteration(n: int) -> "Trigger":
-        """Fires every n iterations (reference Trigger.severalIteration :47)."""
-        return Trigger(lambda s: s["iteration"] > 0 and s["iteration"] % n == 0,
-                       f"severalIteration({n})")
+        """Fires every n iterations (reference Trigger.severalIteration :47).
+
+        Crossing-based, not modulo-based: fires when the iteration counter
+        crosses a multiple of ``n`` since the previous dispatch
+        (``prev_iteration`` in the driver state). With one step per
+        dispatch this is exactly the reference's ``iteration % n == 0``;
+        with ``steps_per_dispatch > 1`` the counter advances in chunks and
+        a modulo test would skip fires whenever the chunk size does not
+        divide ``n``."""
+        def fn(s):
+            it = s["iteration"]
+            prev = s.get("prev_iteration", it - 1)
+            return it > 0 and it // n != prev // n
+        return Trigger(fn, f"severalIteration({n})")
 
     @staticmethod
     def max_epoch(n: int) -> "Trigger":
