@@ -49,7 +49,8 @@ PARTIAL_LOG = os.environ.get(
                  "BENCH_PARTIAL.jsonl"))
 
 
-def child(backend: str, model: str, batch: int, iters: int) -> None:
+def child(backend: str, model: str, batch: int, iters: int,
+          inner: int = 1) -> None:
     """Run one benchmark and print the perf dict as a JSON line."""
     import jax
 
@@ -102,7 +103,7 @@ def child(backend: str, model: str, batch: int, iters: int) -> None:
         data_source = f"record:{shard_dir}"
 
     out = perf.run(model, batch, iters, "random", use_bf16=True,
-                   data_source=data_source)
+                   data_source=data_source, inner_steps=inner)
     if data_source is not None:
         out["model"] += "_pipe"
         out["data_source"] = "record-shards (generated, ~120KB JPEGs)"
@@ -111,10 +112,10 @@ def child(backend: str, model: str, batch: int, iters: int) -> None:
 
 
 def _attempt(backend: str, model: str, batch: int, iters: int,
-             timeout: int):
+             timeout: int, inner: int = 1):
     """Spawn the child benchmark; return (result_dict | None, error | None)."""
     cmd = [sys.executable, os.path.abspath(__file__), "--child", backend,
-           model, str(batch), str(iters)]
+           model, str(batch), str(iters), str(inner)]
     try:
         proc = subprocess.run(
             cmd, capture_output=True, text=True, timeout=timeout,
@@ -269,27 +270,35 @@ def main() -> None:
             # companion configs ride inside the same JSON line (the
             # driver records one line; these are the VERDICT-requested
             # transformer_lm and train-from-storage datapoints)
-            for cname, cmodel, cb, ci in (
-                    ("transformer_lm", "transformer_lm", 32, 10),
+            for cname, cmodel, cb, ci, cinner in (
+                    ("transformer_lm", "transformer_lm", 32, 10, 1),
                     # MXU-sized LM config (VERDICT r3 weak #5: no clean
                     # chip MFU datapoint existed for it)
-                    ("transformer_lm_1k", "transformer_lm_1k", 16, 10),
-                    # round-4 lever: single-read Pallas BN stats
-                    ("resnet50_fbn", "resnet50_fbn", batch, iters),
-                    ("resnet50_pipe", "resnet50_pipe", batch, iters),
+                    ("transformer_lm_1k", "transformer_lm_1k", 16, 10, 1),
+                    # best measured single-chip config (PERF.md §8.2
+                    # combination matrix: NO combination beat the best
+                    # single lever): 10 chained steps per dispatch on the
+                    # plain model, 2,677.7 img/s in window 2
+                    ("resnet50_best", "resnet50", batch, 4, 10),
+                    # round-4 lever: single-read Pallas BN stats —
+                    # measured NEGATIVE on chip (−46%, PERF.md §8.2);
+                    # kept as a companion so regressions/fixes show up
+                    ("resnet50_fbn", "resnet50_fbn", batch, iters, 1),
+                    ("resnet50_pipe", "resnet50_pipe", batch, iters, 1),
                     # accuracy-vs-wall-clock (BASELINE's second metric)
-                    ("time_to_acc", "time_to_acc", 128, 0)):
+                    ("time_to_acc", "time_to_acc", 128, 0, 1)):
                 cres, cerr = _attempt("default", cmodel, cb, ci,
                                       int(os.environ.get(
                                           "BENCH_COMPANION_TIMEOUT",
-                                          "600")))
+                                          "600")),
+                                      inner=cinner)
                 if cres is not None:
                     companions[cname] = {
                         k: cres.get(k) for k in (
                             "images_per_second_per_chip", "mfu_pct",
-                            "tokens_per_second", "batch", "seconds",
-                            "time_to_acc_s", "target_top1", "reached",
-                            "final_top1")
+                            "tokens_per_second", "batch", "iterations",
+                            "inner_steps", "seconds", "time_to_acc_s",
+                            "target_top1", "reached", "final_top1")
                         if cres.get(k) is not None}
                     if cres.get("backend") == "tpu":
                         _partial(cname, cres)
@@ -308,6 +317,7 @@ def main() -> None:
 
 if __name__ == "__main__":
     if len(sys.argv) > 1 and sys.argv[1] == "--child":
-        child(sys.argv[2], sys.argv[3], int(sys.argv[4]), int(sys.argv[5]))
+        child(sys.argv[2], sys.argv[3], int(sys.argv[4]), int(sys.argv[5]),
+              int(sys.argv[6]) if len(sys.argv) > 6 else 1)
     else:
         main()

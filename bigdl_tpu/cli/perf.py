@@ -157,9 +157,20 @@ def run(model_name: str, batch: int, iterations: int, data_type: str,
     enable_compile_cache()
 
     # shipped conv-layout decision for this device (no-op if the CLI
-    # installed an explicit --convLayout, or the device is unmeasured)
-    from bigdl_tpu.ops.conv2d import maybe_install_auto
-    maybe_install_auto()
+    # installed an explicit --convLayout, or the device is unmeasured).
+    # Guarded to the plain path: the window-2 combination matrix
+    # (PERF.md §8.2) measured the decision POSITIVE alone (+1.1%) but
+    # NEGATIVE chained with inner-stepping (2,630 vs 2,678 img/s) or the
+    # s2d stem (2,579 vs 2,674) — the levers reclaim the same XLA
+    # scheduling slack and interfere when composed. inner_steps is
+    # normalized to 1 further down for data_source/strategy runs —
+    # mirror that here so those (plain-dispatch) runs still get the
+    # decision
+    _eff_inner = (1 if (data_source is not None or data_parallel)
+                  else inner_steps)
+    if _eff_inner == 1 and not model_name.endswith("_s2d"):
+        from bigdl_tpu.ops.conv2d import maybe_install_auto
+        maybe_install_auto()
 
     from bigdl_tpu import nn
     from bigdl_tpu.optim import SGD

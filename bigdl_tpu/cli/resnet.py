@@ -68,6 +68,13 @@ def _build_model(args):
             raise SystemExit(
                 f"--depth {args.depth} invalid for imagenet; pick one of "
                 f"{sorted(_IMAGENET_CFG)}")
+        if getattr(args, "s2d", False) and not getattr(args, "convLayout",
+                                                       None):
+            # s2d + the shipped layout decision interfere (2,579 vs
+            # 2,674 img/s, PERF.md §8.2 combination matrix): pin the
+            # all-NHWC default unless the user chose layouts explicitly
+            from bigdl_tpu.ops.conv2d import install_layout_spec
+            install_layout_spec("default")
         return resnet(args.depth, args.classNum,
                       s2d_stem=getattr(args, "s2d", False))
     if getattr(args, "s2d", False):

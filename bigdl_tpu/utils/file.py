@@ -184,3 +184,33 @@ def latest_checkpoint_pair(directory: str):
         return None, None
     n = max(common)
     return join(f"model.{n}"), join(f"state.{n}")
+
+
+def orphaned_snapshots(directory: str, newer_than: int):
+    """Snapshot paths (``model.n`` / ``state.n``) with ``n > newer_than``
+    — after an unclean death these are by construction unmatched (else
+    :func:`latest_checkpoint_pair` would have returned them) and a
+    resumed run whose counters continue past ``newer_than`` will want to
+    overwrite exactly these names."""
+    if is_remote(directory):
+        fs, d = _fs_for(directory)
+        if not fs.isdir(d):
+            return []
+        scheme = directory.split("://", 1)[0]
+        names = [e.rsplit("/", 1)[-1] for e in fs.ls(d, detail=False)]
+        join = lambda f: f"{scheme}://{d.rstrip('/')}/{f}"
+    else:
+        if not os.path.isdir(directory):
+            return []
+        names = os.listdir(directory)
+        join = lambda f: os.path.join(directory, f)
+    out = []
+    for f in names:
+        for prefix in ("model.", "state."):
+            if f.startswith(prefix):
+                try:
+                    if int(f[len(prefix):]) > newer_than:
+                        out.append(join(f))
+                except ValueError:
+                    pass
+    return out

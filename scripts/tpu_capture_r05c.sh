@@ -60,7 +60,12 @@ step "perf_rn50_best_combo_b256" 900 python -m bigdl_tpu.cli.perf -m resnet50_s2
 # now with Optimizer steps_per_dispatch=8 — the tiny model is dispatch-
 # dominated through the tunnel, so the wall-clock delta isolates the
 # lever through the ACTUAL Optimizer loop users run (not the perf
-# harness's --innerSteps analog)
+# harness's --innerSteps analog). Data prep is host-side and keyed on
+# the files (a banked rc=0 must not skip regeneration after a /tmp wipe)
+if [ ! -f /tmp/synth_mnist_full/train-images-idx3-ubyte ]; then
+  echo "=== make_synth_mnist host-side ($(date -u +%H:%M:%SZ))" | tee -a "$OUT"
+  timeout 1200 python scripts/make_synth_mnist.py /tmp/synth_mnist_full 20000 4000 2>&1 | tail -5 | tee -a "$OUT"
+fi
 step "lenet_convergence_spd8" 1800 ./scripts/run_example.sh lenet /tmp/synth_mnist_full -b 128 --maxEpoch 20 --learningRate 0.1 --stepsPerDispatch 8
 
 # 2. long tail, exactly r05b's set, skipped when already banked

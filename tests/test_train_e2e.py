@@ -241,3 +241,84 @@ def test_resume_uses_newest_matched_pair(tmp_path):
     opt2.resume(d2)
     np.testing.assert_array_equal(opt2._init_params["w"],
                                   np.ones((2,)) * 20)
+
+
+def test_resume_continues_iteration_and_epoch_numbering(tmp_path):
+    """Resume must CONTINUE the epoch/iteration counters (reference
+    semantics: cumulative maxEpoch/maxIteration, ascending checkpoint
+    names) — the round-5 soak exposed phase-2 counters restarting at 0,
+    which made pre-kill vs post-resume progress incomparable."""
+    x, y = _xor_data(64)
+    ds = BatchDataSet(x, y, batch_size=16, shuffle=False)  # 4 iters/epoch
+
+    def mk(end):
+        return Optimizer(Sequential(nn.Linear(2, 8), nn.Tanh(),
+                                    nn.Linear(8, 2), nn.LogSoftMax()),
+                         ds, nn.ClassNLLCriterion(),
+                         optim_method=SGD(learning_rate=0.2), end_when=end)
+
+    ck = str(tmp_path / "ck")
+    os.makedirs(ck)
+    opt = mk(Trigger.max_epoch(2))  # 8 iterations, ckpt at 4 and 8
+    opt.set_checkpoint(Trigger.every_epoch(), ck)
+    opt.optimize()
+    assert os.path.exists(os.path.join(ck, "model.8"))
+
+    # cumulative max_iteration: resumed at 8, runs 4 more, writes model.12
+    opt2 = mk(Trigger.max_iteration(12))
+    opt2.set_checkpoint(Trigger.every_epoch(), ck)
+    opt2.resume(ck)
+    assert opt2._resume_driver == {"epoch": 3, "iteration": 8}
+    opt2.optimize()
+    assert os.path.exists(os.path.join(ck, "model.12"))
+    assert not os.path.exists(os.path.join(ck, "model.4.1"))
+
+    # cumulative max_epoch: already past -> resumes and stops immediately
+    opt3 = mk(Trigger.max_epoch(2))
+    opt3.resume(ck)
+    t3 = opt3.optimize()
+    assert t3.params is not None
+
+    # pre-driver-blob snapshots: iteration falls back to the filename
+    import numpy as _np
+    from bigdl_tpu.utils.file import save_pytree as _sp
+    legacy = str(tmp_path / "legacy")
+    _sp({"params": {"w": _np.ones(2)}, "mod_state": {}},
+        os.path.join(legacy, "model.40"))
+    _sp({"m": _np.zeros(2)}, os.path.join(legacy, "state.40"))
+    opt4 = mk(Trigger.max_iteration(41))
+    opt4.resume(legacy)
+    assert opt4._resume_driver == {"iteration": 40}
+
+
+def test_resume_overwrites_orphaned_snapshot(tmp_path):
+    """A kill between the model.<n> and state.<n> writes leaves an
+    unmatched model.<n>; with counters resuming, the checkpoint trigger
+    re-reaches exactly that name — it must be overwritten (it is
+    unusable by construction), not raise FileExistsError (review r5)."""
+    from bigdl_tpu.utils.file import load_pytree as _lp, save_pytree as _sp
+
+    x, y = _xor_data(64)
+    ds = BatchDataSet(x, y, batch_size=16, shuffle=False)  # 4 iters/epoch
+
+    def mk(end):
+        return Optimizer(Sequential(nn.Linear(2, 4), nn.LogSoftMax()),
+                         ds, nn.ClassNLLCriterion(),
+                         optim_method=SGD(learning_rate=0.1), end_when=end)
+
+    ck = str(tmp_path / "ck")
+    os.makedirs(ck)
+    opt = mk(Trigger.max_epoch(1))
+    opt.set_checkpoint(Trigger.every_epoch(), ck)
+    opt.optimize()  # model.4/state.4
+    # orphan from a simulated kill mid-write: model.8 without state.8
+    _sp({"params": {"w": np.zeros(2)}, "mod_state": {}},
+        os.path.join(ck, "model.8"))
+
+    opt2 = mk(Trigger.max_epoch(2))
+    opt2.set_checkpoint(Trigger.every_epoch(), ck)
+    opt2.resume(ck)
+    assert os.path.join(ck, "model.8") in opt2._resume_orphans
+    opt2.optimize()  # reaches iteration 8 again -> overwrites the orphan
+    blob = _lp(os.path.join(ck, "model.8"))
+    assert "driver" in blob and blob["driver"]["iteration"] == 8
