@@ -100,6 +100,14 @@ def build_model(name: str, class_num: int = 1000):
             max_len=1024, pos_encoding="rope", num_kv_heads=4,
             attn_impl=("flash" if jax.default_backend() == "tpu"
                        else None)),
+        # head-dim A/B: same d_model/layers/FLOPs, 8 heads of 128 instead
+        # of 16 of 64 — the MXU contracts over the head dim in both
+        # attention matmuls, and 64 lanes half-fills its 128-wide tiles
+        "transformer_lm_1k_hd128": lambda: models.transformer_lm(
+            _LM_VOCAB, d_model=1024, num_layers=12, num_heads=8,
+            max_len=1024, pos_encoding="rope", num_kv_heads=2,
+            attn_impl=("flash" if jax.default_backend() == "tpu"
+                       else None)),
     }
     if name not in table:
         raise SystemExit(f"unknown model {name}; choose from {list(table)}")
@@ -107,7 +115,8 @@ def build_model(name: str, class_num: int = 1000):
             "resnet20_cifar": (32, 32, 3),
             "transformer_lm": (512,),
             "transformer_lm_rope": (512,),
-            "transformer_lm_1k": (1024,)}.get(name, (224, 224, 3))
+            "transformer_lm_1k": (1024,),
+            "transformer_lm_1k_hd128": (1024,)}.get(name, (224, 224, 3))
     return table[name](), size
 
 

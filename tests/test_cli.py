@@ -122,10 +122,14 @@ def test_capture_scripts_reference_valid_perf_models():
 
     from bigdl_tpu.cli.perf import build_model
 
+    import glob as _glob
+
     names = set()
-    for script in ("scripts/tpu_capture.sh", "scripts/tpu_capture2.sh"):
-        for line in open(os.path.join(os.path.dirname(__file__), "..",
-                                      script)):
+    scripts = sorted(_glob.glob(os.path.join(
+        os.path.dirname(__file__), "..", "scripts", "tpu_capture*.sh")))
+    assert len(scripts) >= 2
+    for script in scripts:
+        for line in open(script):
             m = re.search(r"cli\.perf -m (\S+)", line)
             if m:
                 names.add(m.group(1))
