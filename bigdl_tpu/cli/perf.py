@@ -55,6 +55,19 @@ def _bn_fused(m):
     return set_bn_fused(m)
 
 
+def _lm(*, num_kv_heads=2, pos_encoding="rope", **kw):
+    """Shared LM-config plumbing for the perf model zoo (vocab + the
+    backend-conditional flash selection live in ONE place)."""
+    import jax
+
+    from bigdl_tpu import models
+
+    return models.transformer_lm(
+        _LM_VOCAB, pos_encoding=pos_encoding, num_kv_heads=num_kv_heads,
+        attn_impl=("flash" if jax.default_backend() == "tpu" else None),
+        **kw)
+
+
 def build_model(name: str, class_num: int = 1000):
     import jax
 
@@ -80,34 +93,34 @@ def build_model(name: str, class_num: int = 1000):
         "resnet20_cifar": lambda: models.resnet_cifar(
             20, class_num if class_num != 1000 else 10),
         "lenet5": lambda: models.lenet5(10),
-        # long-context flagship: 32k vocab, 512-token causal LM. The Pallas
-        # kernel only off-interpret on TPU; elsewhere the dense path keeps
-        # CPU benchmark runs fast.
-        "transformer_lm": lambda: models.transformer_lm(
-            _LM_VOCAB, d_model=512, num_layers=8, num_heads=8, max_len=512,
-            attn_impl=("flash" if jax.default_backend() == "tpu"
-                       else None)),
+        # causal LMs, 32k vocab. _lm fills the shared plumbing: the
+        # Pallas flash kernel only off-interpret on TPU; elsewhere the
+        # dense path keeps CPU benchmark runs fast.
+        "transformer_lm": lambda: _lm(
+            d_model=512, num_layers=8, num_heads=8, max_len=512,
+            pos_encoding="sinusoidal", num_kv_heads=None),
         # modern-config A/B: RoPE + grouped-query (2 kv heads)
-        "transformer_lm_rope": lambda: models.transformer_lm(
-            _LM_VOCAB, d_model=512, num_layers=8, num_heads=8, max_len=512,
-            pos_encoding="rope", num_kv_heads=2,
-            attn_impl=("flash" if jax.default_backend() == "tpu"
-                       else None)),
-        # larger config at 1k context: matmuls big enough that MFU reflects
-        # the MXU, not dispatch/embedding overhead
-        "transformer_lm_1k": lambda: models.transformer_lm(
-            _LM_VOCAB, d_model=1024, num_layers=12, num_heads=16,
-            max_len=1024, pos_encoding="rope", num_kv_heads=4,
-            attn_impl=("flash" if jax.default_backend() == "tpu"
-                       else None)),
+        "transformer_lm_rope": lambda: _lm(
+            d_model=512, num_layers=8, num_heads=8, max_len=512),
+        # larger config at 1k context: matmuls big enough that MFU
+        # reflects the MXU, not dispatch/embedding overhead
+        "transformer_lm_1k": lambda: _lm(
+            d_model=1024, num_layers=12, num_heads=16, max_len=1024,
+            num_kv_heads=4),
         # head-dim A/B: same d_model/layers/FLOPs, 8 heads of 128 instead
         # of 16 of 64 — the MXU contracts over the head dim in both
-        # attention matmuls, and 64 lanes half-fills its 128-wide tiles
-        "transformer_lm_1k_hd128": lambda: models.transformer_lm(
-            _LM_VOCAB, d_model=1024, num_layers=12, num_heads=8,
-            max_len=1024, pos_encoding="rope", num_kv_heads=2,
-            attn_impl=("flash" if jax.default_backend() == "tpu"
-                       else None)),
+        # attention matmuls, and 64 lanes half-fills its 128-wide tiles.
+        # Measured +60% tok/s on chip (PERF.md §8.2): size heads to 128.
+        "transformer_lm_1k_hd128": lambda: _lm(
+            d_model=1024, num_layers=12, num_heads=8, max_len=1024),
+        # long-context flagship: 16k tokens END-TO-END through the
+        # training step on one chip — flash-only territory (dense
+        # attention needs a 17 GB score matrix from seq 8k up and
+        # OOM-fails, PERF.md §8.2); remat='dots' keeps the MXU outputs
+        # resident and recomputes the bandwidth-bound intermediates
+        "transformer_lm_16k": lambda: _lm(
+            d_model=1024, num_layers=12, num_heads=8, max_len=16384,
+            remat="dots"),
     }
     if name not in table:
         raise SystemExit(f"unknown model {name}; choose from {list(table)}")
@@ -116,7 +129,8 @@ def build_model(name: str, class_num: int = 1000):
             "transformer_lm": (512,),
             "transformer_lm_rope": (512,),
             "transformer_lm_1k": (1024,),
-            "transformer_lm_1k_hd128": (1024,)}.get(name, (224, 224, 3))
+            "transformer_lm_1k_hd128": (1024,),
+            "transformer_lm_16k": (16384,)}.get(name, (224, 224, 3))
     return table[name](), size
 
 

@@ -403,6 +403,41 @@ def _dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref, *rest,
         dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
 
 
+def _live_block_pairs(sq, sk, bq, bk, causal, q_offset) -> int:
+    """Exact number of (q-block, k-block) grid pairs whose matmuls run per
+    (b, h) — the Python-side mirror of the kernels' ``live`` predicate
+    (fully-future K blocks are skipped under causal). Segment masking is
+    data-dependent and not reflected here."""
+    n_q, n_k = sq // bq, sk // bk
+    if not causal:
+        return n_q * n_k
+    total = 0
+    for j in range(n_q):
+        q_end = q_offset + (j + 1) * bq - 1
+        total += min(n_k, max(0, q_end // bk + 1))
+    return total
+
+
+def _attn_cost(bh, n_pairs, bq, bk, d, dtype_bytes, units):
+    """Author-declared ALGORITHMIC cost for one attention Pallas kernel
+    (consumed by ``utils/flops.py``, which prefers it over grid x
+    kernel-body counting): ``units`` matmuls of 2*bq*bk*d FLOPs per live
+    block pair — the forward's qk+pv, the dq kernel's dP+dQ, the dkv
+    kernel's dV+dK. The backward kernels' score RECOMPUTATION is
+    deliberately excluded, per the module convention flops.py states for
+    remat (algorithmic FLOPs, not executed): a dense-autodiff backward
+    reuses stored P and performs exactly these four units, so MFU
+    numerators stay comparable across attention implementations. Block
+    skipping IS reflected (n_pairs is causal-aware), so causal MFU is no
+    longer flattered by counting masked work."""
+    from jax.experimental import pallas as pl
+
+    return pl.CostEstimate(
+        flops=int(2 * units * bh * n_pairs * bq * bk * d),
+        transcendentals=int(bh * n_pairs * bq * bk),
+        bytes_accessed=int(dtype_bytes * bh * n_pairs * (bq + 2 * bk) * d))
+
+
 def _pad_to(x, mult, axis):
     pad = (-x.shape[axis]) % mult
     if pad == 0:
@@ -488,9 +523,12 @@ def _flash_fwd(q, k, v, causal: bool, block_q: int, block_k: int,
                          lambda i, j, kk: (i // h, 0, kk)),
         ]
         args += [qs3, ks3]
+    n_pairs = _live_block_pairs(sq, sk, bq, bk, causal, s_k - s_q)
     out, lse = pl.pallas_call(
         kernel,
         grid=(b * h, sq // bq, sk // bk),
+        cost_estimate=_attn_cost(b * h, n_pairs, bq, bk, d,
+                                 q.dtype.itemsize, units=2),
         in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, bq, d), lambda i, j, kk: (i, j, 0)),
@@ -561,11 +599,14 @@ def _flash_bwd(q, k, v, o, lse, g, causal: bool, block_q: int,
                          lambda i, j, kk: (i // h, 0, kk)),
         ]
         dq_args += [qs3, ks3]
+    n_pairs = _live_block_pairs(sq, sk, bq, bk, causal, q_offset)
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, block_k=bk, scale=scale,
                           causal=causal, block_q=bq, q_offset=q_offset,
                           has_seg=has_seg),
         grid=(b * h, sq // bq, sk // bk),
+        cost_estimate=_attn_cost(b * h, n_pairs, bq, bk, d,
+                                 qf.dtype.itemsize, units=2),
         in_specs=dq_specs,
         out_specs=pl.BlockSpec((1, bq, d), lambda i, j, kk: (i, j, 0)),
         out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
@@ -595,6 +636,8 @@ def _flash_bwd(q, k, v, o, lse, g, causal: bool, block_q: int,
                           causal=causal, block_k=bk, q_offset=q_offset,
                           has_seg=has_seg),
         grid=(b * h, sk // bk, sq // bq),
+        cost_estimate=_attn_cost(b * h, n_pairs, bq, bk, d,
+                                 kf.dtype.itemsize, units=2),
         in_specs=dkv_specs,
         out_specs=[
             pl.BlockSpec((1, bk, d), lambda i, j, qq: (i, j, 0)),

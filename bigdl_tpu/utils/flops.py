@@ -90,6 +90,26 @@ def jaxpr_flops(jaxpr) -> float:
         elif name == "while":
             # trip count is dynamic; count the body once (lower bound)
             mult = 1.0
+        elif name == "pallas_call":
+            # Without special handling the kernel jaxpr is counted ONCE
+            # though it runs once per grid program — flash attention's
+            # seq^2 inner products were invisible and long-context MFU
+            # wildly undercounted (found at seq 16k: analytic step FLOPs
+            # equalled the 1k config's). Preference order:
+            #  1. an author-declared CostEstimate (our flash kernels set
+            #     ALGORITHMIC flops: causal-skip-aware, backward score
+            #     recomputation excluded — comparable to dense autodiff);
+            #  2. grid-size x kernel-body as a fallback for kernels
+            #     without an estimate (counts recomputation and masked
+            #     grid cells as written).
+            ce = eqn.params.get("cost_estimate")
+            if ce is not None and getattr(ce, "flops", 0):
+                total += float(ce.flops)
+                continue
+            gm = eqn.params.get("grid_mapping")
+            grid = getattr(gm, "grid", ()) or ()
+            if all(isinstance(g, int) for g in grid):
+                mult = _prod(grid) if grid else 1.0
         for sub in _sub_jaxprs(eqn.params):
             total += mult * jaxpr_flops(sub)
     return total
