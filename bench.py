@@ -278,7 +278,8 @@ def main() -> None:
                     # TPU-first head shape: same d_model/FLOPs with 8
                     # heads of 128 instead of 16 of 64 — the MXU
                     # contracts over the head dim, and 64 lanes half-fill
-                    # its tiles (+60% tok/s on chip, PERF.md §8.2)
+                    # its tiles (+24% tok/s on chip at the shipped
+                    # 512-wide flash blocks; 53.7% MFU, PERF.md §8.2)
                     ("transformer_lm_1k_hd128", "transformer_lm_1k_hd128",
                      16, 10, 1),
                     # best measured single-chip config (PERF.md §8.2

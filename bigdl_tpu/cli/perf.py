@@ -110,7 +110,8 @@ def build_model(name: str, class_num: int = 1000):
         # head-dim A/B: same d_model/layers/FLOPs, 8 heads of 128 instead
         # of 16 of 64 — the MXU contracts over the head dim in both
         # attention matmuls, and 64 lanes half-fills its 128-wide tiles.
-        # Measured +60% tok/s on chip (PERF.md §8.2): size heads to 128.
+        # Measured +24% tok/s on chip at 512-wide flash blocks; 53.7%
+        # MFU — past the 50% north star (PERF.md §8.2).
         "transformer_lm_1k_hd128": lambda: _lm(
             d_model=1024, num_layers=12, num_heads=8, max_len=1024),
         # long-context flagship: 16k tokens END-TO-END through the

@@ -711,7 +711,7 @@ _flash_seg.defvjp(_flash_seg_vjp_fwd, _flash_seg_vjp_bwd)
 def flash_attention(q, k, v, *, causal: bool = False,
                     mask: Optional[jax.Array] = None,
                     segments: Optional[jax.Array] = None,
-                    block_q: int = 128, block_k: int = 128):
+                    block_q: int = 512, block_k: int = 512):
     """(b, h, s, d) attention via the Pallas online-softmax kernel.
 
     ``segments``: (b, s) int document ids for packed rows (see
@@ -750,4 +750,13 @@ def flash_attention(q, k, v, *, causal: bool = False,
                                        block_k=block_k)
         return _dense.dot_product_attention(q, k, v, causal=causal,
                                             mask=mask)
+    # a 512 default block_k must never demote a 128-tileable length to
+    # the dense fallback (e.g. seq 768): clamp down to the largest
+    # standard block that tiles s_k, mirroring the segments branch
+    s_q, s_k = q.shape[-2], k.shape[-2]
+    if not _tileable(s_q, s_k, block_k):
+        for cand in (256, 128):
+            if cand < block_k and _tileable(s_q, s_k, cand):
+                block_k = cand
+                break
     return _flash(q, k, v, causal, block_q, block_k)

@@ -46,25 +46,33 @@ def main():
                 return jnp.sum(fn(q, q, q).astype(jnp.float32))
 
             try:
-                # CHAINED timing: each call consumes the previous result
-                # (output shape == q shape), so neither the dispatch
-                # queue nor any runtime-level result caching can
-                # pipeline/elide executions — un-chained same-args loops
-                # measured impossible >1000 TF/s through this tunnel
+                # Timing rules for this tunnel (PERF.md §8.2, learned
+                # the hard way): (a) chain each call on the previous
+                # result so executions cannot be elided/pipelined;
+                # (b) sync by FETCHING a value to host — through axon,
+                # block_until_ready acks before device completion and
+                # "timed" impossible >1000 TF/s. float(sum(...)) is the
+                # only trustworthy barrier (flash_bench's pattern).
+                def _sync(x):
+                    leaf = jax.tree_util.tree_leaves(x)[0]
+                    return float(jnp.sum(leaf.astype(jnp.float32)))
+
                 fwd = jax.jit(fn)
-                cur = jax.block_until_ready(fwd(q, q, q))
+                cur = fwd(q, q, q)
+                _sync(cur)
                 t0 = time.perf_counter()
                 for _ in range(5):
                     cur = fwd(cur, q, q)
-                jax.block_until_ready(cur)
+                _sync(cur)
                 f_ms = (time.perf_counter() - t0) / 5 * 1e3
 
                 g = jax.jit(jax.value_and_grad(loss))
-                _, gq = jax.block_until_ready(g(q))
+                _, gq = g(q)
+                _sync(gq)
                 t0 = time.perf_counter()
                 for _ in range(5):
                     _, gq = g(gq)
-                jax.block_until_ready(gq)
+                _sync(gq)
                 fb_ms = (time.perf_counter() - t0) / 5 * 1e3
                 print(json.dumps({
                     "seq": seq, "bq": bq, "bk": bk,

@@ -22,25 +22,8 @@ fi
 trap 'cp -f "$OUT" "$REPO_LOG" 2>/dev/null || true' EXIT
 
 probe() {
-  # Beyond backend-up: the tunnel has a DEGRADED half-alive mode (seen
-  # 07:00Z window 2) where backend init and tiny matmuls succeed but a
-  # fresh-input round trip takes seconds and completion futures resolve
-  # without executing — timing measured there is garbage in both
-  # directions. Require a sane timed round trip (2nd iteration, so
-  # compile/cold-start is excluded) before any capture step runs.
-  timeout 120 python - <<'EOF' >/dev/null 2>&1
-import time
-import jax, jax.numpy as jnp, numpy as np
-assert jax.default_backend() == "tpu"
-f = jax.jit(lambda a: a @ a)
-for i in range(2):
-    a = jnp.asarray(np.full((2048, 2048), 1.0 + i, np.float32))
-    jax.block_until_ready(a)
-    t0 = time.perf_counter()
-    jax.block_until_ready(f(a))
-    dt = time.perf_counter() - t0
-assert dt < 1.0, f"degraded tunnel: 2048^3 fresh-input round trip {dt:.2f}s"
-EOF
+  # shared health gate — see scripts/tpu_health_probe.py
+  timeout 120 python scripts/tpu_health_probe.py >/dev/null 2>&1
 }
 
 step() {
@@ -82,10 +65,11 @@ if [ ! -f /tmp/synth_mnist_full/train-images-idx3-ubyte ]; then
 fi
 step "lenet_convergence_spd8" 1800 ./scripts/run_example.sh lenet /tmp/synth_mnist_full -b 128 --maxEpoch 20 --learningRate 0.1 --stepsPerDispatch 8
 
-# 1c. flash block-size sweep (chained, fresh-input timing): the kernel's
-# absolute TF/s bounds the LM path; the 07:00Z attempt hit the degraded
-# tunnel and produced garbage — re-run in a healthy window
-step "flash_block_sweep_4k" 1500 bash -c "python scripts/flash_block_sweep.py 4096 4 8 128 | tee /tmp/flash_blocks_r05.jsonl"
+# 1c. flash block-size sweep: the kernel's absolute TF/s bounds the LM
+# path. v2: the first attempts timed with block_until_ready, which acks
+# early through axon (perf.py:344 documents the trap) — rows were
+# impossible and discarded; the sweep now syncs by host value fetch
+step "flash_block_sweep_4k_v2" 1500 bash -c "python scripts/flash_block_sweep.py 4096 4 8 128 | tee /tmp/flash_blocks_r05.jsonl"
 
 # 2. long tail, exactly r05b's set, skipped when already banked
 step "perf_resnet50_bnss_b128" 900 python -m bigdl_tpu.cli.perf -m resnet50_bnss -b 128 -i 20 --dataType random

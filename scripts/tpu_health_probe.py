@@ -1,0 +1,43 @@
+"""Shared TPU tunnel health probe (exit 0 = healthy) — the ONE copy of
+the gate both `tpu_poll_and_capture.sh` and the capture sweeps run.
+
+Health means more than backend-up: time one RESIDENT-input chained
+matmul synced by a host VALUE FETCH. The tunnel's two measurement traps
+(PERF.md §8.2): ``block_until_ready`` acks before device completion
+(async timings read impossibly fast), and fresh-input timing is
+dominated by the tunnel's tens-of-MB/s upload bandwidth. A resident
+chained compute + scalar fetch measures the device; more than 2 s for
+a 2048^3 (healthy: milliseconds + fetch latency) means the link is
+unusable for capture work.
+"""
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+def main() -> int:
+    if jax.default_backend() != "tpu":
+        print(f"backend={jax.default_backend()}", file=sys.stderr)
+        return 1
+    f = jax.jit(lambda a: a @ a)
+    a = jnp.full((2048, 2048), 0.5, jnp.float32)
+    cur = f(a)
+    float(jnp.sum(cur))  # warmup incl. compile
+    t0 = time.perf_counter()
+    cur = f(cur)
+    float(jnp.sum(cur))
+    dt = time.perf_counter() - t0
+    if dt >= 2.0:
+        print(f"unhealthy: {dt:.2f}s resident 2048^3 + fetch",
+              file=sys.stderr)
+        return 1
+    print(f"tpu up (healthy, {dt * 1e3:.0f} ms):",
+          jax.devices()[0].device_kind)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

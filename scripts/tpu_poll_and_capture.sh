@@ -12,26 +12,12 @@ SWEEP="${1:-scripts/tpu_capture2.sh}"
 # libtpu init can take ~60 s — keep a 90 s probe timeout (so a cold
 # window is never misread as down) with a 45 s sleep: worst-case
 # detection ~135 s. A hung probe is killed by timeout — polling is free.
-# The probe also rejects the DEGRADED half-alive tunnel mode (07:00Z,
-# window 2): backend init succeeds but a fresh-input matmul round trip
-# takes seconds and completions resolve without executing — firing a
-# sweep there burns the steps on garbage timing. Second iteration timed
-# so compile/cold-start doesn't count.
+# Health semantics live in scripts/tpu_health_probe.py (the ONE copy,
+# shared with the sweeps' per-step gate): resident-input chained matmul
+# + host value fetch, rejecting both tunnel measurement traps
+# (early-acking block_until_ready, upload-bandwidth-bound fresh inputs).
 while true; do
-  if timeout 120 python -c "
-import time
-import jax, jax.numpy as jnp, numpy as np
-assert jax.default_backend() == 'tpu', jax.default_backend()
-f = jax.jit(lambda a: a @ a)
-for i in range(2):
-    a = jnp.asarray(np.full((2048, 2048), 1.0 + i, np.float32))
-    jax.block_until_ready(a)
-    t0 = time.perf_counter()
-    jax.block_until_ready(f(a))
-    dt = time.perf_counter() - t0
-assert dt < 1.0, f'degraded: {dt:.2f}s round trip'
-print('tpu up (healthy):', jax.devices()[0].device_kind)
-" 2>/dev/null; then
+  if timeout 120 python scripts/tpu_health_probe.py 2>/dev/null; then
     exec bash "$SWEEP"
   fi
   sleep 45
