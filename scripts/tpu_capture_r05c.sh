@@ -95,4 +95,13 @@ echo "=== soak_data_prep host-side ($(date -u +%H:%M:%SZ))" | tee -a "$OUT"
 timeout 1500 python -c "import sys; sys.path.insert(0, '.'); from scripts.soak import _ensure_data; print(_ensure_data('/tmp/soak_chip'))" 2>&1 | tail -3 | tee -a "$OUT"
 step "soak_chip" 3300 python scripts/soak.py orchestrate --dir /tmp/soak_chip --batch 128 --ckpt-every 50 --phase1 1500 --phase2 480
 
+# 3. LM rows under the shipped 512-wide flash blocks (the step names
+# above banked the 128-block values; these are the durable-log copies
+# of the post-block-change measurements in PERF.md §8.2)
+step "perf_lm_b32_512blk" 900 python -m bigdl_tpu.cli.perf -m transformer_lm -b 32 -i 5 --dataType random
+step "perf_lm_1k_512blk" 900 python -m bigdl_tpu.cli.perf -m transformer_lm_1k -b 16 -i 5 --dataType random
+step "perf_lm_1k_hd128_512blk" 900 python -m bigdl_tpu.cli.perf -m transformer_lm_1k_hd128 -b 16 -i 5 --dataType random
+step "perf_lm_16k_512blk" 900 python -m bigdl_tpu.cli.perf -m transformer_lm_16k -b 1 -i 5 --dataType random
+step "bench_main_512blk" 2400 python bench.py
+
 echo "r05c sweep complete -> $OUT" | tee -a "$OUT"
