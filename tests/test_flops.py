@@ -116,26 +116,34 @@ def test_flash_attention_flops_counted_via_declared_cost():
     from bigdl_tpu.ops.attention_kernel import _live_block_pairs
     from bigdl_tpu.utils.flops import fn_flops
 
+    # blocks pinned explicitly: the skip-discount expectations below are
+    # block-granular, and the shipped DEFAULT block size (512, equal to
+    # many test seqs) legitimately carries no causal discount at all
+    bq = bk = 128
     b, h, s, d = 2, 4, 512, 64
     q = jnp.ones((b, h, s, d), jnp.float32)
     unit = 2.0 * b * h * s * s * d  # one full-seq (s,s)x(s,d) matmul
 
-    full = fn_flops(lambda q: flash_attention(q, q, q, causal=False), q)
+    def attn(q, causal):
+        return flash_attention(q, q, q, causal=causal,
+                               block_q=bq, block_k=bk)
+
+    full = fn_flops(lambda q: attn(q, False), q)
     np.testing.assert_allclose(full, 2 * unit, rtol=1e-6)  # qk + pv
 
     # causal: block-skip-aware — strictly between half and full, and
     # exactly the declared live-pair count (proves the CostEstimate path
     # is active, not the dense fallback, which would count full s^2)
-    causal = fn_flops(lambda q: flash_attention(q, q, q, causal=True), q)
+    causal = fn_flops(lambda q: attn(q, True), q)
     assert 0.5 * full < causal < full
-    pairs = _live_block_pairs(s, s, 128, 128, True, 0)
+    pairs = _live_block_pairs(s, s, bq, bk, True, 0)
     np.testing.assert_allclose(
-        causal, 2 * (2.0 * b * h * pairs * 128 * 128 * d), rtol=1e-6)
+        causal, 2 * (2.0 * b * h * pairs * bq * bk * d), rtol=1e-6)
 
     # fwd+bwd: 2 units fwd + 4 units bwd (dq kernel dP+dQ, dkv kernel
     # dV+dK) = 3x the forward count; recomputation must NOT inflate it
     def loss(q):
-        return jnp.sum(flash_attention(q, q, q, causal=False))
+        return jnp.sum(attn(q, False))
 
     fwdbwd = fn_flops(lambda q: jax.value_and_grad(loss)(q), q)
     np.testing.assert_allclose(fwdbwd, 3 * full, rtol=1e-6)
