@@ -122,6 +122,12 @@ def build_model(name: str, class_num: int = 1000):
         "transformer_lm_16k": lambda: _lm(
             d_model=1024, num_layers=12, num_heads=8, max_len=16384,
             remat="dots"),
+        # 32k: double the 16k flagship — the flash kernel is
+        # compiled-verified at this length (flash_bench; dense needs a
+        # 68 GB score matrix), full-recompute remat for the activations
+        "transformer_lm_32k": lambda: _lm(
+            d_model=1024, num_layers=12, num_heads=8, max_len=32768,
+            remat="full"),
     }
     if name not in table:
         raise SystemExit(f"unknown model {name}; choose from {list(table)}")
@@ -131,7 +137,8 @@ def build_model(name: str, class_num: int = 1000):
             "transformer_lm_rope": (512,),
             "transformer_lm_1k": (1024,),
             "transformer_lm_1k_hd128": (1024,),
-            "transformer_lm_16k": (16384,)}.get(name, (224, 224, 3))
+            "transformer_lm_16k": (16384,),
+            "transformer_lm_32k": (32768,)}.get(name, (224, 224, 3))
     return table[name](), size
 
 

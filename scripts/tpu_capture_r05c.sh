@@ -102,6 +102,7 @@ step "perf_lm_b32_512blk" 900 python -m bigdl_tpu.cli.perf -m transformer_lm -b 
 step "perf_lm_1k_512blk" 900 python -m bigdl_tpu.cli.perf -m transformer_lm_1k -b 16 -i 5 --dataType random
 step "perf_lm_1k_hd128_512blk" 900 python -m bigdl_tpu.cli.perf -m transformer_lm_1k_hd128 -b 16 -i 5 --dataType random
 step "perf_lm_16k_512blk" 900 python -m bigdl_tpu.cli.perf -m transformer_lm_16k -b 1 -i 5 --dataType random
+step "perf_lm_32k_512blk" 900 python -m bigdl_tpu.cli.perf -m transformer_lm_32k -b 1 -i 3 --dataType random
 step "bench_main_512blk" 2400 python bench.py
 
 echo "r05c sweep complete -> $OUT" | tee -a "$OUT"
