@@ -47,12 +47,38 @@ def _conv(x, w, stride, layout):
         dimension_numbers=_DIMSPEC[layout])
 
 
+def _sync(x):
+    # host value fetch = the only true device barrier through the axon
+    # tunnel; block_until_ready acks before completion there and timed
+    # impossible >1000 TF/s (PERF.md §8.2 measurement contract). This is
+    # why the probe's historical ABSOLUTE TF/s rows read above physical
+    # peak — only the NHWC-vs-NCHW relatives were meaningful (and those
+    # were validated end-to-end by the same-window perf A/B).
+    leaf = jax.tree_util.tree_leaves(x)[0]
+    float(jnp.sum(leaf.astype(jnp.float32)))
+
+
 def _time(fn, args, iters):
-    out = jax.block_until_ready(fn(*args))
+    """Per-op device time with dispatch amortized: `iters` copies of the
+    op run INSIDE one jitted program (inputs perturbed per copy so XLA
+    cannot CSE them into one), one value-fetch sync at the end. A
+    per-call loop would measure the tunnel's ~2.5-3 ms dispatch floor,
+    not the sub-millisecond convs (PERF.md §3 measures ceilings the
+    same way)."""
+    x, w = args
+
+    def repeated(x, w):
+        acc = None
+        for i in range(iters):
+            eps = jnp.asarray(i * 1e-6, x.dtype)  # keep the conv dtype
+            y = fn(x + eps, w)
+            acc = y if acc is None else acc + y
+        return acc
+
+    r = jax.jit(repeated)
+    _sync(r(x, w))  # compile + warmup
     t0 = time.perf_counter()
-    for _ in range(iters):
-        out = fn(*args)
-    jax.block_until_ready(out)
+    _sync(r(x, w))
     return (time.perf_counter() - t0) / iters
 
 
