@@ -282,6 +282,9 @@ def main() -> None:
                     # 512-wide flash blocks; 53.7% MFU, PERF.md §8.2)
                     ("transformer_lm_1k_hd128", "transformer_lm_1k_hd128",
                      16, 10, 1),
+                    # long-context flagship: 16k tokens end-to-end on one
+                    # chip (28.4k tok/s, 38% MFU on v5e — PERF.md §8.2)
+                    ("transformer_lm_16k", "transformer_lm_16k", 1, 3, 1),
                     # best measured single-chip config (PERF.md §8.2
                     # combination matrix: NO combination beat the best
                     # single lever): 10 chained steps per dispatch on the
