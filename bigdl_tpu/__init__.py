@@ -6,7 +6,7 @@ its Engine thread pools dissolve into the compiler, and its Spark
 BlockManager all-reduce becomes ICI/DCN collectives (see bigdl_tpu.parallel).
 """
 
-__version__ = "0.4.0"
+__version__ = "0.5.0"
 
 from bigdl_tpu import core, nn
 
