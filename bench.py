@@ -285,6 +285,10 @@ def main() -> None:
                     # long-context flagship: 16k tokens end-to-end on one
                     # chip (28.4k tok/s, 38% MFU on v5e — PERF.md §8.2)
                     ("transformer_lm_16k", "transformer_lm_16k", 1, 3, 1),
+                    # beyond-reference vision family: best vision MFU in
+                    # the repo (48.7% on v5e — the patchify conv feeds
+                    # the MXU where the resnet stem starves it)
+                    ("vit_b16", "vit_b16", 64, 10, 1),
                     # best measured single-chip config (PERF.md §8.2
                     # combination matrix: NO combination beat the best
                     # single lever): 10 chained steps per dispatch on the

@@ -93,6 +93,15 @@ def build_model(name: str, class_num: int = 1000):
         "resnet20_cifar": lambda: models.resnet_cifar(
             20, class_num if class_num != 1000 else 10),
         "lenet5": lambda: models.lenet5(10),
+        # beyond-reference vision family: patchify conv (3*16*16 = 768
+        # contraction vs the resnet stem's MXU-starved 3-channel 7x7),
+        # 128-wide heads, flash on TPU — see models/vit.py
+        "vit_b16": lambda: models.vit_b16(
+            class_num, attn_impl=("flash" if jax.default_backend() ==
+                                  "tpu" else None)),
+        "vit_s16": lambda: models.vit_s16(
+            class_num, attn_impl=("flash" if jax.default_backend() ==
+                                  "tpu" else None)),
         # causal LMs, 32k vocab. _lm fills the shared plumbing: the
         # Pallas flash kernel only off-interpret on TPU; elsewhere the
         # dense path keeps CPU benchmark runs fast.

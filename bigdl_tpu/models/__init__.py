@@ -14,6 +14,7 @@ from bigdl_tpu.models.autoencoder import autoencoder
 from bigdl_tpu.models.rnn import (
     simple_rnn, lstm_classifier, birnn_classifier, text_cnn,
 )
+from bigdl_tpu.models.vit import ViT, vit, vit_b16, vit_s16
 from bigdl_tpu.models.transformer_lm import (
     TransformerLM, transformer_lm, packed_lm_targets, PackedNLLCriterion,
 )

@@ -1,0 +1,108 @@
+"""Vision Transformer — beyond-reference model family, assembled entirely
+from the framework's own pieces (the reference's newest vision model is
+Inception v2, models/inception/Inception_v2.scala; ViT is the modern
+counterpart users expect a complete framework to ship).
+
+Design choices, TPU-first per the measured sizing rules (PERF.md §8.2):
+
+* patchify = ``SpatialConvolution(3, d_model, p, p, stride p)`` — one
+  stride-p conv IS the per-patch linear projection, and at p=16 its
+  contraction (3*16*16 = 768) fills the MXU far better than the ResNet
+  stem's 3-channel 7x7 (measured at 3.6% of peak, PERF.md §3);
+* ``head_dim = 128`` by default (``num_heads = d_model // 128``);
+* mean pooling over patch tokens instead of a class token (keeps every
+  shape static and batch-major; GAP heads match CLS within noise at
+  this scale) and sinusoidal positions from the existing
+  :class:`~bigdl_tpu.nn.PositionalEncoding` table;
+* pre-LN encoder blocks — the framework's :class:`TransformerEncoder`
+  verbatim, so flash attention, ``remat``, GQA, and the Megatron TP
+  param specs all apply to ViT for free.
+
+Output is per-class log-probabilities (``LogSoftMax`` tail), matching
+every other model family and ``ClassNLLCriterion``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu import nn
+from bigdl_tpu.core.module import Module
+
+__all__ = ["ViT", "vit", "vit_b16", "vit_s16"]
+
+
+class ViT(Module):
+    def __init__(self, class_num: int = 1000, image_size: int = 224,
+                 patch_size: int = 16, d_model: int = 768,
+                 num_layers: int = 12, num_heads: Optional[int] = None,
+                 d_ff: Optional[int] = None, dropout: float = 0.0,
+                 attn_impl=None, remat: bool = False,
+                 name: Optional[str] = None):
+        super().__init__(name or "ViT")
+        if image_size % patch_size:
+            raise ValueError(f"image_size {image_size} not divisible by "
+                             f"patch_size {patch_size}")
+        if num_heads is None:
+            # measured TPU rule: 128-wide heads (PERF.md §8.2)
+            num_heads = max(1, d_model // 128)
+        self.d_model = d_model
+        self.n_patches = (image_size // patch_size) ** 2
+        self.patch = nn.SpatialConvolution(
+            3, d_model, patch_size, patch_size, patch_size, patch_size,
+            0, 0)
+        self.pos = nn.PositionalEncoding(d_model, self.n_patches)
+        self.encoder = nn.TransformerEncoder(
+            num_layers, d_model, num_heads, d_ff, causal=False,
+            dropout=dropout, attn_impl=attn_impl, remat=remat)
+        self.ln = nn.LayerNorm(d_model)
+        self.head = nn.Linear(d_model, class_num)
+
+    def children(self):
+        return (self.patch, self.pos, self.encoder, self.ln, self.head)
+
+    def init(self, rng):
+        ks = jax.random.split(rng, 3)
+        return {"patch": self.patch.init(ks[0]),
+                "encoder": self.encoder.init(ks[1]),
+                "ln": self.ln.init(jax.random.fold_in(rng, 2)),
+                "head": self.head.init(ks[2])}
+
+    def init_state(self):
+        return {"encoder": self.encoder.init_state()}
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        # x: (b, h, w, 3) NHWC -> (b, n_patches, d_model) tokens
+        t = self.patch.forward(params["patch"], x)
+        b, gh, gw, d = t.shape
+        t = t.reshape(b, gh * gw, d)
+        t = self.pos.forward({}, t)
+        t, enc_state = self.encoder.apply(
+            params["encoder"], state["encoder"], t,
+            training=training, rng=rng)
+        if isinstance(t, (tuple, list)):
+            t = t[0]
+        t = self.ln.forward(params["ln"], t)
+        t = jnp.mean(t, axis=1)  # GAP over patch tokens
+        logits = self.head.forward(params["head"], t)
+        return (jax.nn.log_softmax(logits.astype(jnp.float32), -1),
+                {"encoder": enc_state})
+
+
+def vit(class_num: int = 1000, **kw) -> ViT:
+    return ViT(class_num, **kw)
+
+
+def vit_b16(class_num: int = 1000, **kw) -> ViT:
+    """ViT-Base/16: 12 layers, d 768, 6 heads of 128 (86M params)."""
+    kw.setdefault("patch_size", 16)
+    return ViT(class_num, d_model=768, num_layers=12, **kw)
+
+
+def vit_s16(class_num: int = 1000, **kw) -> ViT:
+    """ViT-Small/16: 12 layers, d 384, 3 heads of 128 (22M params)."""
+    kw.setdefault("patch_size", 16)
+    return ViT(class_num, d_model=384, num_layers=12, **kw)

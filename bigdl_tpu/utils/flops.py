@@ -54,7 +54,20 @@ def _eqn_flops(eqn) -> float:
         dn = eqn.params["dimension_numbers"]
         k_spatial = _prod(kernel[d] for d in dn.rhs_spec[2:])
         cin_per_group = float(kernel[dn.rhs_spec[1]])
-        return 2.0 * _prod(out_shape) * cin_per_group * k_spatial
+        macs = _prod(out_shape) * cin_per_group * k_spatial
+        # input dilation (the autodiff dgrad of a STRIDED conv) inserts
+        # stride-1 zeros between input elements; only 1/prod(lhs_dilation)
+        # of kernel taps hit data, the rest multiply structural zeros.
+        # Without this the ViT patchify's (stride-16) backward counted
+        # 256x its real MACs and inflated MFU past the physical ceiling
+        # (caught by the HLO cross-check, PERF.md §8.2). The algorithmic
+        # invariant this restores: dgrad MACs == wgrad MACs == fwd MACs
+        # (transposes of the same linear map have identical nnz).
+        ld = eqn.params.get("lhs_dilation") or ()
+        d = _prod(ld)
+        if d > 1:
+            macs /= d
+        return 2.0 * macs
     return 0.0
 
 

@@ -147,3 +147,30 @@ def test_flash_attention_flops_counted_via_declared_cost():
 
     fwdbwd = fn_flops(lambda q: jax.value_and_grad(loss)(q), q)
     np.testing.assert_allclose(fwdbwd, 3 * full, rtol=1e-6)
+
+
+def test_strided_conv_backward_counts_true_macs():
+    """dgrad/wgrad are transposes of the forward linear map — identical
+    MAC counts. The dgrad of a STRIDED conv lowers as an input-dilated
+    conv whose structural zeros must not be counted (found via the ViT
+    patchify: stride-16 backward counted 256x real MACs and pushed MFU
+    past the physical ceiling)."""
+    b, s, p, d = 2, 32, 8, 24  # stride-p patchify, 3->d channels
+
+    def conv(x, w):
+        return jax.lax.conv_general_dilated(
+            x, w, (p, p), "VALID",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+    x = jnp.zeros((b, s, s, 3))
+    w = jnp.zeros((p, p, 3, d))
+    fwd = fn_flops(conv, x, w)
+    assert fwd == 2 * b * (s // p) ** 2 * d * 3 * p * p
+
+    def loss(x, w):
+        return jnp.sum(conv(x, w) ** 2)
+
+    total = fn_flops(jax.grad(loss, argnums=(0, 1)), x, w)
+    # fwd (inside grad) + dgrad + wgrad = 3x fwd, within a few % for
+    # boundary effects
+    assert abs(total - 3 * fwd) / (3 * fwd) < 0.05, (total, 3 * fwd)
