@@ -60,3 +60,35 @@ def test_vit_learns_synthetic_classes():
     (res,) = val.test(trained.params, trained.mod_state, [Top1Accuracy()])
     acc, _ = res.result()
     assert acc > 0.9, f"ViT synthetic accuracy {acc}"
+
+
+def test_vit_composes_with_data_parallel():
+    """The new family must ride the same SPMD strategies as every other
+    model: DataParallel over the 8-device CPU mesh trains and matches a
+    single-device run of the same seed/batches (the test_parallel.py
+    equivalence bar)."""
+    from bigdl_tpu.parallel import DataParallel, local_mesh
+
+    rng = np.random.RandomState(2)
+    n = 128
+    y = rng.randint(0, 2, n).astype(np.int32)
+    x = rng.randn(n, 16, 16, 3).astype(np.float32) * 0.1
+    x[y == 1, 8:, 8:] += 1.0
+
+    def run(strategy):
+        m = ViT(2, image_size=16, patch_size=8, d_model=32, num_layers=1,
+                num_heads=1)
+        opt = Optimizer(m, BatchDataSet(x, y, 32, shuffle=False),
+                        nn.ClassNLLCriterion(),
+                        optim_method=SGD(learning_rate=0.1),
+                        end_when=Trigger.max_epoch(2), seed=3,
+                        log_every=100, strategy=strategy)
+        t = opt.optimize()
+        return jax.tree_util.tree_map(np.asarray,
+                                      jax.device_get(t.params))
+
+    single = run(None)
+    dp = run(DataParallel(local_mesh()))
+    for a, b in zip(jax.tree_util.tree_leaves(single),
+                    jax.tree_util.tree_leaves(dp)):
+        np.testing.assert_allclose(a, b, atol=2e-5)
