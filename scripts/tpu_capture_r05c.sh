@@ -104,5 +104,8 @@ step "perf_lm_1k_hd128_512blk" 900 python -m bigdl_tpu.cli.perf -m transformer_l
 step "perf_lm_16k_512blk" 900 python -m bigdl_tpu.cli.perf -m transformer_lm_16k -b 1 -i 5 --dataType random
 step "perf_lm_32k_512blk" 900 python -m bigdl_tpu.cli.perf -m transformer_lm_32k -b 1 -i 3 --dataType random
 step "bench_main_512blk" 2400 python bench.py
+# 4. ViT family (landed late round 5) + corrected-numerator headline
+step "perf_vit_b16_b64" 900 python -m bigdl_tpu.cli.perf -m vit_b16 -b 64 -i 10 --dataType random
+step "perf_resnet50_corrected_basis" 900 python -m bigdl_tpu.cli.perf -m resnet50 -b 128 -i 20 --dataType random
 
 echo "r05c sweep complete -> $OUT" | tee -a "$OUT"
