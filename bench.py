@@ -50,7 +50,7 @@ PARTIAL_LOG = os.environ.get(
 
 
 def child(backend: str, model: str, batch: int, iters: int,
-          inner: int = 1) -> None:
+          inner: int = 1, autotune: str = "off") -> None:
     """Run one benchmark and print the perf dict as a JSON line."""
     import jax
 
@@ -103,7 +103,8 @@ def child(backend: str, model: str, batch: int, iters: int,
         data_source = f"record:{shard_dir}"
 
     out = perf.run(model, batch, iters, "random", use_bf16=True,
-                   data_source=data_source, inner_steps=inner)
+                   data_source=data_source, inner_steps=inner,
+                   autotune=autotune)
     if data_source is not None:
         out["model"] += "_pipe"
         out["data_source"] = "record-shards (generated, ~120KB JPEGs)"
@@ -112,10 +113,10 @@ def child(backend: str, model: str, batch: int, iters: int,
 
 
 def _attempt(backend: str, model: str, batch: int, iters: int,
-             timeout: int, inner: int = 1):
+             timeout: int, inner: int = 1, autotune: str = "off"):
     """Spawn the child benchmark; return (result_dict | None, error | None)."""
     cmd = [sys.executable, os.path.abspath(__file__), "--child", backend,
-           model, str(batch), str(iters), str(inner)]
+           model, str(batch), str(iters), str(inner), autotune]
     try:
         proc = subprocess.run(
             cmd, capture_output=True, text=True, timeout=timeout,
@@ -270,49 +271,63 @@ def main() -> None:
             # companion configs ride inside the same JSON line (the
             # driver records one line; these are the VERDICT-requested
             # transformer_lm and train-from-storage datapoints)
-            for cname, cmodel, cb, ci, cinner in (
-                    ("transformer_lm", "transformer_lm", 32, 10, 1),
+            for cname, cmodel, cb, ci, cinner, ctune in (
+                    ("transformer_lm", "transformer_lm", 32, 10, 1, "off"),
                     # MXU-sized LM config (VERDICT r3 weak #5: no clean
                     # chip MFU datapoint existed for it)
-                    ("transformer_lm_1k", "transformer_lm_1k", 16, 10, 1),
+                    ("transformer_lm_1k", "transformer_lm_1k", 16, 10, 1,
+                     "off"),
                     # TPU-first head shape: same d_model/FLOPs with 8
                     # heads of 128 instead of 16 of 64 — the MXU
                     # contracts over the head dim, and 64 lanes half-fill
                     # its tiles (+24% tok/s on chip at the shipped
                     # 512-wide flash blocks; 53.7% MFU, PERF.md §8.2)
                     ("transformer_lm_1k_hd128", "transformer_lm_1k_hd128",
-                     16, 10, 1),
+                     16, 10, 1, "off"),
                     # long-context flagship: 16k tokens end-to-end on one
                     # chip (28.4k tok/s, 38% MFU on v5e — PERF.md §8.2)
-                    ("transformer_lm_16k", "transformer_lm_16k", 1, 3, 1),
+                    ("transformer_lm_16k", "transformer_lm_16k", 1, 3, 1,
+                     "off"),
                     # beyond-reference vision family: best vision MFU in
                     # the repo (48.7% on v5e — the patchify conv feeds
                     # the MXU where the resnet stem starves it)
-                    ("vit_b16", "vit_b16", 64, 10, 1),
+                    ("vit_b16", "vit_b16", 64, 10, 1, "off"),
                     # best measured single-chip config (PERF.md §8.2
                     # combination matrix: NO combination beat the best
                     # single lever): 10 chained steps per dispatch on the
                     # plain model, 2,677.7 img/s in window 2
-                    ("resnet50_best", "resnet50", batch, 4, 10),
+                    ("resnet50_best", "resnet50", batch, 4, 10, "off"),
+                    # ISSUE 1 tentpole A/B: measure-mode autotune (conv
+                    # pass layouts + flash blocks + BN row block, persisted
+                    # to ~/.cache/bigdl_tpu/autotune) vs the default rows
+                    # above — the headline resnet50 and the transformer_lm
+                    # companion are the untuned halves of the comparison
+                    ("resnet50_tuned", "resnet50", batch, iters, 1,
+                     "measure"),
+                    ("transformer_lm_tuned", "transformer_lm", 32, 10, 1,
+                     "measure"),
                     # round-4 lever: single-read Pallas BN stats —
                     # measured NEGATIVE on chip (−46%, PERF.md §8.2);
                     # kept as a companion so regressions/fixes show up
-                    ("resnet50_fbn", "resnet50_fbn", batch, iters, 1),
-                    ("resnet50_pipe", "resnet50_pipe", batch, iters, 1),
+                    ("resnet50_fbn", "resnet50_fbn", batch, iters, 1,
+                     "off"),
+                    ("resnet50_pipe", "resnet50_pipe", batch, iters, 1,
+                     "off"),
                     # accuracy-vs-wall-clock (BASELINE's second metric)
-                    ("time_to_acc", "time_to_acc", 128, 0, 1)):
+                    ("time_to_acc", "time_to_acc", 128, 0, 1, "off")):
                 cres, cerr = _attempt("default", cmodel, cb, ci,
                                       int(os.environ.get(
                                           "BENCH_COMPANION_TIMEOUT",
                                           "600")),
-                                      inner=cinner)
+                                      inner=cinner, autotune=ctune)
                 if cres is not None:
                     companions[cname] = {
                         k: cres.get(k) for k in (
                             "images_per_second_per_chip", "mfu_pct",
                             "tokens_per_second", "batch", "iterations",
                             "inner_steps", "seconds", "time_to_acc_s",
-                            "target_top1", "reached", "final_top1")
+                            "target_top1", "reached", "final_top1",
+                            "autotune")
                         if cres.get(k) is not None}
                     if cres.get("backend") == "tpu":
                         _partial(cname, cres)
@@ -332,6 +347,7 @@ def main() -> None:
 if __name__ == "__main__":
     if len(sys.argv) > 1 and sys.argv[1] == "--child":
         child(sys.argv[2], sys.argv[3], int(sys.argv[4]), int(sys.argv[5]),
-              int(sys.argv[6]) if len(sys.argv) > 6 else 1)
+              int(sys.argv[6]) if len(sys.argv) > 6 else 1,
+              sys.argv[7] if len(sys.argv) > 7 else "off")
     else:
         main()

@@ -22,6 +22,19 @@ def _add_platform_arg(p: argparse.ArgumentParser) -> None:
                         "effect before first jax device use)")
 
 
+def add_autotune_arg(p: argparse.ArgumentParser) -> None:
+    p.add_argument("--autotune", default="off",
+                   choices=["off", "cached", "measure"],
+                   help="per-shape kernel autotuner (bigdl_tpu.tuning): "
+                        "conv pass layouts, flash-attention block sizes, "
+                        "BN stats row block. 'cached' = read persisted "
+                        "decisions (~/.cache/bigdl_tpu/autotune/"
+                        "<device>.json), never measure; 'measure' = time "
+                        "candidates on cache miss and persist the winner "
+                        "(off-TPU this dry-records the defaults without "
+                        "timing); 'off' = shipped defaults")
+
+
 def compile_cache_dir() -> Optional[str]:
     """Resolve the persistent compile-cache dir: BIGDL_JAX_CACHE wins;
     a user-managed JAX_COMPILATION_CACHE_DIR is left to jax itself (None
@@ -62,6 +75,13 @@ def apply_platform(args) -> None:
 
         jax.config.update("jax_platforms", platform)
     enable_compile_cache()
+    mode = getattr(args, "autotune", None)
+    if mode:
+        from bigdl_tpu import tuning
+        try:
+            tuning.set_mode(mode)
+        except ValueError as e:
+            raise SystemExit(str(e))
     spec = getattr(args, "convLayout", None)
     if spec:
         # explicit per-pass conv layouts (or 'auto'/'default') — wins
@@ -109,6 +129,7 @@ def add_train_args(p: argparse.ArgumentParser) -> None:
     p.add_argument("--overWriteCheckpoint", action="store_true")
     p.add_argument("--dataParallel", action="store_true",
                    help="shard the batch over all visible devices")
+    add_autotune_arg(p)
     p.add_argument("--seed", type=int, default=42)
     p.add_argument("--logEvery", type=int, default=10)
     p.add_argument("--summary", default=None, metavar="DIR",
@@ -183,12 +204,22 @@ def build_optimizer(model, dataset, criterion, args, schedule=None,
                 "lamb": lambda: LAMB(learning_rate=lr, weight_decay=wd,
                                      schedule=sched),
             }[name]()
+    strategy = build_strategy(args)
+    k = int(getattr(args, "stepsPerDispatch", 1) or 1)
+    if k > 1 and strategy is not None:
+        # same clean exit as the other CLI validation errors (ADVICE r5
+        # #5) instead of the Optimizer constructor's raw ValueError
+        raise SystemExit(
+            "--stepsPerDispatch > 1 is a single-device dispatch "
+            "amortization; it cannot be combined with --dataParallel "
+            "over multiple devices (whose runtime pipelines dispatch "
+            "already)")
     opt = Optimizer(model, dataset, criterion,
                     optim_method=optim_method,
                     end_when=Trigger.max_epoch(args.maxEpoch),
-                    strategy=build_strategy(args), seed=args.seed,
+                    strategy=strategy, seed=args.seed,
                     log_every=args.logEvery,
-                    steps_per_dispatch=getattr(args, "stepsPerDispatch", 1))
+                    steps_per_dispatch=k)
     if args.checkpoint:
         os.makedirs(args.checkpoint, exist_ok=True)
         opt.set_checkpoint(Trigger.every_epoch(), args.checkpoint,

@@ -181,10 +181,45 @@ def _annotate_conv_layouts(out: dict) -> None:
         out["conv_layouts"] = cl
 
 
+def _annotate_autotune(out: dict) -> None:
+    """Stamp the run's tuning provenance (mode + per-key decision or
+    'default') into a result dict — ISSUE 1 acceptance: every perf JSON
+    line says which decisions it ran under."""
+    from bigdl_tpu import tuning
+    ann = tuning.annotation()
+    if ann is not None:
+        out["autotune"] = ann
+
+
 def run(model_name: str, batch: int, iterations: int, data_type: str,
         use_bf16: bool = True, data_parallel: bool = False,
         data_source: str | None = None, inner_steps: int = 1,
-        profile_dir: str | None = None):
+        profile_dir: str | None = None, autotune: str | None = None):
+    """Throughput harness entry. ``autotune`` optionally installs the
+    tuning mode (the CLI does it via --autotune/apply_platform; bench.py
+    children pass it directly). The conv layout policy is snapshotted and
+    restored so back-to-back runs in one process stay independent
+    (ADVICE r5 #1)."""
+    from bigdl_tpu import tuning
+    from bigdl_tpu.ops import conv2d
+
+    if autotune is not None:
+        tuning.set_mode(autotune)
+    tuning.reset_decisions()
+    snap = conv2d.policy_snapshot()
+    try:
+        return _run_timed(model_name, batch, iterations, data_type,
+                          use_bf16=use_bf16, data_parallel=data_parallel,
+                          data_source=data_source, inner_steps=inner_steps,
+                          profile_dir=profile_dir)
+    finally:
+        conv2d.restore_policy(snap)
+
+
+def _run_timed(model_name: str, batch: int, iterations: int, data_type: str,
+               use_bf16: bool = True, data_parallel: bool = False,
+               data_source: str | None = None, inner_steps: int = 1,
+               profile_dir: str | None = None):
     import os
 
     import jax
@@ -196,21 +231,22 @@ def run(model_name: str, batch: int, iterations: int, data_type: str,
     from bigdl_tpu.cli.common import enable_compile_cache
     enable_compile_cache()
 
-    # shipped conv-layout decision for this device (no-op if the CLI
-    # installed an explicit --convLayout, or the device is unmeasured).
-    # Guarded to the plain path: the window-2 combination matrix
-    # (PERF.md §8.2) measured the decision POSITIVE alone (+1.1%) but
-    # NEGATIVE chained with inner-stepping (2,630 vs 2,678 img/s) or the
-    # s2d stem (2,579 vs 2,674) — the levers reclaim the same XLA
-    # scheduling slack and interfere when composed. inner_steps is
+    # conv-layout decision for this device AND run configuration. The
+    # window-2 combination matrix (PERF.md §8.2) measured the shipped
+    # decision POSITIVE alone (+1.1%) but NEGATIVE chained with
+    # inner-stepping (2,630 vs 2,678 img/s) or the s2d stem (2,579 vs
+    # 2,674) — so those configurations resolve their own autotune keys
+    # (default all-NHWC until measured) instead of skipping installation
+    # and inheriting whatever an earlier run left behind. inner_steps is
     # normalized to 1 further down for data_source/strategy runs —
     # mirror that here so those (plain-dispatch) runs still get the
     # decision
     _eff_inner = (1 if (data_source is not None or data_parallel)
                   else inner_steps)
-    if _eff_inner == 1 and not model_name.endswith("_s2d"):
-        from bigdl_tpu.ops.conv2d import maybe_install_auto
-        maybe_install_auto()
+    from bigdl_tpu import tuning
+    tuning.install_conv_layouts(
+        "s2d" if model_name.endswith("_s2d")
+        else ("inner" if _eff_inner > 1 else "plain"))
 
     from bigdl_tpu import nn
     from bigdl_tpu.optim import SGD
@@ -387,6 +423,7 @@ def run(model_name: str, batch: int, iterations: int, data_type: str,
         "device": getattr(jax.devices()[0], "device_kind", "unknown"),
     }
     _annotate_conv_layouts(out)
+    _annotate_autotune(out)
     if flops_error is not None:
         out["flops_analytic_error"] = flops_error
     if flops_analytic and flops_hlo:
@@ -511,6 +548,9 @@ def run_time_to_acc(model_name: str, batch: int, target: float,
     import jax
     import jax.numpy as jnp
 
+    from bigdl_tpu import tuning
+    tuning.reset_decisions()  # annotate only THIS run's consulted keys
+
     from bigdl_tpu import nn
     from bigdl_tpu.dataset import RecordImageDataSet, write_image_shards
     from bigdl_tpu.optim import (Optimizer, SGD, Top1Accuracy, Trigger)
@@ -599,6 +639,7 @@ def run_time_to_acc(model_name: str, batch: int, target: float,
                    "top1": r.get("top1_accuracy")} for r in curve],
     }
     _annotate_conv_layouts(out)
+    _annotate_autotune(out)
     print(json.dumps(out))
     return out
 
@@ -668,8 +709,10 @@ def main(argv=None):
                         "the measured decision shipped for this device "
                         "kind (ops/conv2d.MEASURED_DECISIONS), no-op on "
                         "unmeasured devices; 'default' forces all-NHWC")
-    from bigdl_tpu.cli.common import _add_platform_arg, apply_platform
+    from bigdl_tpu.cli.common import (_add_platform_arg, add_autotune_arg,
+                                      apply_platform)
     _add_platform_arg(p)
+    add_autotune_arg(p)
     args = p.parse_args(argv)
     apply_platform(args)
     if args.convLayout:

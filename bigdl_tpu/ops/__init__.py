@@ -15,12 +15,13 @@ from bigdl_tpu.ops.bn_kernel import bn_stats, bn_bwd_stats, fused_bn_train
 from bigdl_tpu.ops.conv2d import (MEASURED_DECISIONS, decide_from_probe,
                                   get_conv_pass_layouts,
                                   install_layout_spec, maybe_install_auto,
-                                  resolve_layout_spec,
-                                  set_conv_pass_layouts)
+                                  policy_snapshot, resolve_layout_spec,
+                                  restore_policy, set_conv_pass_layouts)
 
 __all__ = ["flash_attention", "blockwise_attention",
            "bn_stats", "bn_bwd_stats", "fused_bn_train",
            "set_conv_pass_layouts", "get_conv_pass_layouts",
            "decide_from_probe", "resolve_layout_spec",
            "install_layout_spec", "maybe_install_auto",
+           "policy_snapshot", "restore_policy",
            "MEASURED_DECISIONS"]

@@ -468,6 +468,44 @@ def _tileable(s_q, s_k, block_k) -> bool:
     return s_k % bk == 0
 
 
+_DEFAULT_BLOCK = 512  # per-program tile default (best at the benchmarked
+# 1k/16k shapes, PERF.md §8.2); mid sequences clamp down, the autotuner
+# overrides per shape
+
+
+def _clamp_block(block: int, s: int) -> int:
+    """Largest standard tiling <= ``block`` that divides ``s`` (falling
+    through 256/128), else min(block, s) — applied to BOTH block dims so
+    a mid sequence like s=768 runs 256-blocks instead of padding 768→1024
+    and burning ~33% extra q-block work (ADVICE r5 #2; block_k already
+    clamped this way since round 5)."""
+    b = min(block, max(8, s))
+    if s % b:
+        for cand in (256, 128):
+            if cand < b and s % cand == 0:
+                return cand
+    return b
+
+
+def _resolve_blocks(s_q: int, s_k: int, d: int, causal: bool, dtype,
+                    block_q: "int | None", block_k: "int | None"
+                    ) -> "tuple[int, int]":
+    """Static block-size resolution: explicit arguments win; otherwise
+    consult the autotuner (bigdl_tpu.tuning, a no-op in off mode) and
+    fall back to the 512 defaults. Both dims are then clamped to a
+    standard tiling that divides their sequence."""
+    if block_q is None or block_k is None:
+        tuned = None
+        from bigdl_tpu import tuning
+        if tuning.get_mode() != "off":
+            tuned = tuning.flash_blocks(s_q, s_k, d, causal, dtype)
+        if block_q is None:
+            block_q = tuned[0] if tuned else _DEFAULT_BLOCK
+        if block_k is None:
+            block_k = tuned[1] if tuned else _DEFAULT_BLOCK
+    return _clamp_block(block_q, s_q), _clamp_block(block_k, s_k)
+
+
 def _seg_arrays(segments, sq, sk, bq):
     """Segment ids in the kernels' tileable layouts: q ids (b, sq, 8)
     lane-replicated (padded rows get id 0), kv ids (b, 8, sk)
@@ -711,7 +749,8 @@ _flash_seg.defvjp(_flash_seg_vjp_fwd, _flash_seg_vjp_bwd)
 def flash_attention(q, k, v, *, causal: bool = False,
                     mask: Optional[jax.Array] = None,
                     segments: Optional[jax.Array] = None,
-                    block_q: int = 512, block_k: int = 512):
+                    block_q: Optional[int] = None,
+                    block_k: Optional[int] = None):
     """(b, h, s, d) attention via the Pallas online-softmax kernel.
 
     ``segments``: (b, s) int document ids for packed rows (see
@@ -721,11 +760,19 @@ def flash_attention(q, k, v, *, causal: bool = False,
     route to :func:`blockwise_attention` (same O(seq) memory,
     XLA-fused); richer masks fall back to the dense path; ragged key
     lengths fall back inside the custom_vjp.
+
+    ``block_q``/``block_k``: per-program tile sizes. ``None`` (default)
+    asks the autotuner (bigdl_tpu.tuning) for this shape's measured
+    decision and falls back to 512; explicit values are honored as
+    before. Either way both dims clamp to a standard tiling that divides
+    the sequence (no padded q blocks for mid sequences like 768).
     """
+    s_q, s_k = q.shape[-2], k.shape[-2]
+    block_q, block_k = _resolve_blocks(s_q, s_k, q.shape[-1], causal,
+                                       q.dtype, block_q, block_k)
     if segments is not None:
         if mask is not None:
             raise ValueError("segments and mask are mutually exclusive")
-        s_q, s_k = q.shape[-2], k.shape[-2]
         # the kv-segment block is (1, 8, bk), so Mosaic additionally
         # needs bk lane-aligned: a multiple of 128 or the whole s_k.
         # Clamp small block_k up to 128 when that still tiles; otherwise
@@ -750,13 +797,9 @@ def flash_attention(q, k, v, *, causal: bool = False,
                                        block_k=block_k)
         return _dense.dot_product_attention(q, k, v, causal=causal,
                                             mask=mask)
-    # a 512 default block_k must never demote a 128-tileable length to
-    # the dense fallback (e.g. seq 768): clamp down to the largest
-    # standard block that tiles s_k, mirroring the segments branch
-    s_q, s_k = q.shape[-2], k.shape[-2]
-    if not _tileable(s_q, s_k, block_k):
-        for cand in (256, 128):
-            if cand < block_k and _tileable(s_q, s_k, cand):
-                block_k = cand
-                break
+    # _resolve_blocks already clamped both dims to standard tilings that
+    # divide their sequence (so a 512 default never demotes a
+    # 128-tileable length like 768 to the dense fallback, and q no
+    # longer pads 768→1024); genuinely ragged lengths still fall back
+    # inside the custom_vjp
     return _flash(q, k, v, causal, block_q, block_k)

@@ -52,9 +52,23 @@ def _interpret() -> bool:
 
 
 # row-block height per grid step; 512 f32 lanes × C_BLOCK channels of x
-# plus two f32 scratch rows stay far under VMEM
+# plus two f32 scratch rows stay far under VMEM. The autotuner
+# (bigdl_tpu.tuning) can override per (rows, C, dtype) shape; 512 is the
+# shipped default
 _ROW_BLOCK = 512
 _C_BLOCK = 128
+
+
+def _resolve_row_block(rows: int, c: int, *dtypes) -> int:
+    """Effective row-block height: the autotuner's measured decision for
+    this (rows, C, dtype) when one exists (no-op in off mode), else the
+    shipped default clamped to the array."""
+    from bigdl_tpu import tuning
+    if tuning.get_mode() != "off":
+        tuned = tuning.bn_row_block(rows, c, dtypes[0])
+        if tuned:
+            return min(tuned, rows)
+    return min(_ROW_BLOCK, rows)
 
 
 def _stats_kernel(x_ref, sum_ref, sq_ref, acc_ref):
@@ -92,14 +106,15 @@ def _min_sublane(*dtypes) -> int:
     return need
 
 
-def bn_stats(x2d: jax.Array) -> Tuple[jax.Array, jax.Array]:
+def bn_stats(x2d: jax.Array,
+             row_block: "int | None" = None) -> Tuple[jax.Array, jax.Array]:
     """Per-channel (sum, sum-of-squares) of a (rows, C) array in ONE HBM
     read, f32 accumulation regardless of input dtype. Requires rows %
     {row block} == 0, rows % {dtype min sublane} == 0 and C % 128 == 0
     (the NHWC ResNet shapes satisfy all); callers fall back to jnp
-    otherwise."""
+    otherwise. ``row_block=None`` resolves through the autotuner."""
     rows, c = x2d.shape
-    rb = min(_ROW_BLOCK, rows)
+    rb = row_block or _resolve_row_block(rows, c, x2d.dtype)
     cb = min(_C_BLOCK, c)
     ms = _min_sublane(x2d.dtype)
     # rows%{ms} / c%128 are Mosaic's sublane/lane minima — without them
@@ -146,11 +161,13 @@ def _bwd_kernel(dy_ref, xhat_ref, sdy_ref, sdyx_ref, acc_ref):
         sdyx_ref[...] = jnp.broadcast_to(acc_ref[1:2, :], sdyx_ref.shape)
 
 
-def bn_bwd_stats(dy2d: jax.Array, xhat2d: jax.Array):
+def bn_bwd_stats(dy2d: jax.Array, xhat2d: jax.Array,
+                 row_block: "int | None" = None):
     """(Σdy, Σ(dy·x̂)) per channel — the two reductions of the BN backward
-    — in one pass over each operand."""
+    — in one pass over each operand. ``row_block=None`` resolves through
+    the autotuner."""
     rows, c = dy2d.shape
-    rb = min(_ROW_BLOCK, rows)
+    rb = row_block or _resolve_row_block(rows, c, dy2d.dtype, xhat2d.dtype)
     cb = min(_C_BLOCK, c)
     ms = _min_sublane(dy2d.dtype, xhat2d.dtype)
     if rows % rb or c % cb or rows % ms or c % 128:
@@ -180,8 +197,12 @@ def bn_bwd_stats(dy2d: jax.Array, xhat2d: jax.Array):
 
 
 def _tileable(rows: int, c: int, *dtypes) -> bool:
+    # routing uses the RESOLVED row block, so a tuned decision (e.g. 256
+    # for rows=768, which the 512 default cannot tile) widens the set of
+    # shapes that take the single-read kernel instead of the jnp fallback
     ms = _min_sublane(*dtypes)
-    return rows % min(_ROW_BLOCK, rows) == 0 and rows % ms == 0 \
+    return rows % _resolve_row_block(rows, c, *dtypes) == 0 \
+        and rows % ms == 0 \
         and c % min(_C_BLOCK, c) == 0 and c % 128 == 0
 
 

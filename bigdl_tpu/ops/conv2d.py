@@ -40,6 +40,7 @@ from jax import lax
 __all__ = ["conv2d", "set_conv_pass_layouts", "get_conv_pass_layouts",
            "decide_from_probe", "resolve_layout_spec",
            "install_layout_spec", "maybe_install_auto",
+           "policy_snapshot", "restore_policy",
            "MEASURED_DECISIONS"]
 
 _PASSES = ("fwd", "dgrad", "wgrad")
@@ -125,15 +126,50 @@ def conv_layouts_if_nondefault() -> "Dict[str, str] | None":
     return None if _POLICY == _DEFAULT else dict(_POLICY)
 
 
-def maybe_install_auto(device=None) -> Dict[str, str]:
-    """Install this device's measured decision unless a policy was already
-    installed explicitly. Called by the training entry points (Optimizer,
-    perf harness) right before compiling, when the backend is known —
-    this is how a shipped probe decision becomes the framework default
-    without overriding a user's ``--convLayout``. Returns the active
+def maybe_install_auto(device=None, guarded: bool = False,
+                       policy: "Dict[str, str] | None" = None
+                       ) -> Dict[str, str]:
+    """Install this device's measured decision (or an explicit ``policy``
+    dict from the autotuner) unless a policy was already installed
+    explicitly. Called by the training entry points (Optimizer, perf
+    harness) right before compiling, when the backend is known — this is
+    how a shipped probe decision becomes the framework default without
+    overriding a user's ``--convLayout``.
+
+    ``guarded=True`` marks a run configuration where the measured
+    decision is known-negative (inner-stepping, the s2d stem — PERF.md
+    §8.2 combination matrix): the all-NHWC default is INSTALLED, not
+    merely skipped, so a K=1 run followed by a K>1 run in one process
+    keeps plain-path semantics (ADVICE r5 #1). Returns the active
     policy."""
     if not _EXPLICIT:
-        _POLICY.update(resolve_layout_spec("auto", device))
+        if guarded:
+            _POLICY.update(_DEFAULT)
+        elif policy is not None:
+            for v in policy.values():
+                if v not in ("NHWC", "NCHW"):
+                    raise ValueError(
+                        f"layout must be NHWC or NCHW, got {v!r}")
+            _POLICY.update({p: policy[p] for p in _PASSES})
+        else:
+            _POLICY.update(resolve_layout_spec("auto", device))
+    return dict(_POLICY)
+
+
+def policy_snapshot() -> Tuple[Dict[str, str], bool]:
+    """Capture (policy, explicit-flag) so a harness can restore the
+    pre-run state afterwards — the per-run isolation half of the ADVICE
+    r5 #1 fix (one process running K=1 then K>1 must not leak the
+    measured layout into the guarded run)."""
+    return dict(_POLICY), _EXPLICIT
+
+
+def restore_policy(snap: Tuple[Dict[str, str], bool]) -> Dict[str, str]:
+    """Restore a :func:`policy_snapshot`."""
+    global _EXPLICIT
+    pol, explicit = snap
+    _POLICY.update({p: pol[p] for p in _PASSES})
+    _EXPLICIT = bool(explicit)
     return dict(_POLICY)
 
 

@@ -41,6 +41,21 @@ logger = logging.getLogger("bigdl_tpu")
 __all__ = ["Optimizer", "TrainedModel"]
 
 
+def _canon_ckpt_path(p: str) -> str:
+    """Spelling-insensitive checkpoint path identity (ADVICE r5 #3): a
+    trailing slash or relative-vs-absolute difference between the
+    resume() dir and the set_checkpoint() dir must not disable the
+    orphan-overwrite allowance (which would kill resume with
+    FileExistsError at the first re-reached snapshot name). Remote URLs
+    only get redundant slashes collapsed — abspath would mangle the
+    scheme."""
+    p = str(p)
+    if "://" in p:
+        scheme, rest = p.split("://", 1)
+        return scheme + "://" + "/".join(s for s in rest.split("/") if s)
+    return os.path.abspath(os.path.normpath(p))
+
+
 class TrainedModel:
     """What optimize() returns: the module description plus trained pytrees."""
 
@@ -242,7 +257,11 @@ class Optimizer:
             if it is not None:
                 from bigdl_tpu.utils.file import orphaned_snapshots
                 d = os.path.dirname(str(model_path).rstrip("/"))
-                orphans = set(orphaned_snapshots(d, it))
+                # canonicalized so the later membership test is immune to
+                # trailing-slash / relative-vs-absolute spelling drift
+                # between resume() and set_checkpoint() (ADVICE r5 #3)
+                orphans = {_canon_ckpt_path(o)
+                           for o in orphaned_snapshots(d, it)}
                 if orphans:
                     logger.warning(
                         "resume: %d unmatched snapshot file(s) newer than "
@@ -253,14 +272,17 @@ class Optimizer:
 
     # ---------------------------------------------------------------- build
     def _build_step(self):
-        # shipped conv-layout decision for this device (PERF.md §8.2;
-        # no-op when a --convLayout/API policy is already installed or
-        # the device kind has no measured row). Plain-dispatch path only:
-        # the decision measured +1.1% alone but negative chained with
-        # multi-step dispatch (window-2 combination matrix)
-        if self.steps_per_dispatch == 1:
-            from bigdl_tpu.ops.conv2d import maybe_install_auto
-            maybe_install_auto()
+        # conv-layout decision for this device AND dispatch configuration
+        # (PERF.md §8.2/§9; no-op when a --convLayout/API policy is
+        # already installed). The measured decision is positive on the
+        # plain path but negative chained with multi-step dispatch
+        # (window-2 combination matrix), so the K>1 variant resolves its
+        # own key — installing the all-NHWC default until a measurement
+        # exists, instead of skipping and leaking a previous K=1 install
+        # (ADVICE r5 #1)
+        from bigdl_tpu import tuning
+        tuning.install_conv_layouts(
+            "inner" if self.steps_per_dispatch > 1 else "plain")
 
         model, criterion, opt = self.model, self.criterion, self.optim_method
 
@@ -379,6 +401,18 @@ class Optimizer:
 
     # -------------------------------------------------------------- optimize
     def optimize(self) -> TrainedModel:
+        # per-run conv-policy isolation (ADVICE r5 #1): _build_step
+        # installs a layout decision for THIS run's dispatch config; the
+        # pre-run policy comes back afterwards so a later run in the same
+        # process starts clean
+        from bigdl_tpu.ops.conv2d import policy_snapshot, restore_policy
+        snap = policy_snapshot()
+        try:
+            return self._optimize()
+        finally:
+            restore_policy(snap)
+
+    def _optimize(self) -> TrainedModel:
         rng = jax.random.PRNGKey(self.seed)
         rng, k_init = jax.random.split(rng)
         params = (self._init_params if self._init_params is not None
@@ -603,7 +637,8 @@ class Optimizer:
         n = driver["iteration"]
         target = os.path.join(self._ckpt_path, f"model.{n}")
         overwrite = (getattr(self, "_ckpt_overwrite", False)
-                     or target in getattr(self, "_resume_orphans", ()))
+                     or _canon_ckpt_path(target)
+                     in getattr(self, "_resume_orphans", ()))
         if file_exists(target) and not overwrite:
             raise FileExistsError(
                 f"{target} exists; pass overwrite=True to set_checkpoint "

@@ -1,0 +1,21 @@
+"""Autotuning subsystem: measured, cached per-shape kernel decisions.
+
+See :mod:`bigdl_tpu.tuning.autotune` for the design; CLI surface is
+``--autotune {off,cached,measure}`` (cli/common.py), consumers are
+ops/conv2d.py (per-pass layouts), ops/attention_kernel.py (flash block
+sizes) and ops/bn_kernel.py (stats row block).
+"""
+
+from bigdl_tpu.tuning.autotune import (MODES, annotation, bn_row_block,
+                                       dry_run, flash_blocks, get_cache,
+                                       get_mode, install_conv_layouts,
+                                       make_key, reset, reset_decisions,
+                                       set_mode)
+from bigdl_tpu.tuning.cache import (CACHE_VERSION, AutotuneCache, cache_dir,
+                                    cache_path, device_kind, device_slug)
+
+__all__ = ["MODES", "set_mode", "get_mode", "dry_run", "make_key",
+           "flash_blocks", "bn_row_block", "install_conv_layouts",
+           "annotation", "reset", "reset_decisions", "get_cache",
+           "AutotuneCache", "CACHE_VERSION", "cache_dir", "cache_path",
+           "device_kind", "device_slug"]

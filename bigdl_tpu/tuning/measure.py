@@ -1,0 +1,170 @@
+"""Candidate timing for the autotuner — chip mode only.
+
+Every routine here follows the tunnel timing rules learned in round 5
+(PERF.md §8.2, scripts/flash_block_sweep.py): chain each timed call on the
+previous result so executions cannot be elided or pipelined, and sync by
+FETCHING a scalar to host — through the axon runtime ``block_until_ready``
+acks before device completion and "times" impossible TF/s numbers.
+
+These functions never run in dry mode (``autotune.dry_run()`` gates them),
+so they may assume a real backend; candidate order is deterministic and a
+candidate only wins on a strictly lower time, keeping ties stable across
+runs.
+"""
+
+from __future__ import annotations
+
+import functools
+import time
+from typing import Dict, List, Sequence, Tuple
+
+__all__ = ["time_fn", "measure_flash_blocks", "measure_bn_row_block",
+           "measure_conv_layouts", "CONV_PROBE_SHAPES"]
+
+_WARMUP = 1
+_ITERS = 3
+
+
+def _sync(x) -> float:
+    """Host-fetch barrier (the only trustworthy sync through the tunnel)."""
+    import jax
+    import jax.numpy as jnp
+
+    leaf = jax.tree_util.tree_leaves(x)[0]
+    return float(jnp.sum(leaf.astype(jnp.float32)))
+
+
+def time_fn(fn, *args, iters: int = _ITERS) -> float:
+    """Milliseconds per call of ``fn(*args)``: compile+warmup outside the
+    timed region, then ``iters`` chained calls closed by a host fetch.
+    ``fn`` must return something tree-like whose first leaf has the shape
+    of ``args[0]`` so calls can chain; non-chainable fns are re-invoked
+    on the original args (still sync-fetched each sequence end)."""
+    cur = fn(*args)
+    _sync(cur)  # compile + warmup
+    chain = (getattr(cur, "shape", None) == getattr(args[0], "shape", None)
+             and getattr(cur, "dtype", None) == getattr(args[0], "dtype",
+                                                        None))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        cur = fn(cur, *args[1:]) if chain else fn(*args)
+    _sync(cur)
+    return (time.perf_counter() - t0) / iters * 1e3
+
+
+def _pick(timed: Sequence[Tuple[dict, float]]) -> Tuple[dict, float]:
+    """First strictly-fastest candidate in presentation order (stable under
+    exact ties, so re-measuring identical timings re-picks identically)."""
+    best, best_ms = timed[0]
+    for cfg, ms in timed[1:]:
+        if ms < best_ms:
+            best, best_ms = cfg, ms
+    return best, best_ms
+
+
+def measure_flash_blocks(s_q: int, s_k: int, d: int, causal: bool,
+                         dtype, candidates: Sequence[Tuple[int, int]]
+                         ) -> Tuple[dict, float]:
+    """Time fwd+bwd of the flash kernel per (block_q, block_k) candidate on
+    a small fixed (b=1, h=8) problem of the target sequence geometry."""
+    import jax
+    import jax.numpy as jnp
+
+    from bigdl_tpu.ops.attention_kernel import _flash
+
+    key = jax.random.PRNGKey(0)
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (1, 8, s_q, d), dtype)
+    k = jax.random.normal(kk, (1, 8, s_k, d), dtype)
+    v = jax.random.normal(kv, (1, 8, s_k, d), dtype)
+
+    timed: List[Tuple[dict, float]] = []
+    for bq, bk in candidates:
+        def loss(q_, k_, v_, bq=bq, bk=bk):
+            return jnp.sum(_flash(q_, k_, v_, causal, bq, bk)
+                           .astype(jnp.float32))
+
+        g = jax.jit(jax.grad(loss, argnums=0))
+        ms = time_fn(g, q, k, v)
+        timed.append(({"block_q": bq, "block_k": bk}, ms))
+    return _pick(timed)
+
+
+def measure_bn_row_block(rows: int, c: int, dtype,
+                         candidates: Sequence[int]) -> Tuple[dict, float]:
+    """Time the single-read BN stats kernel per row-block candidate on the
+    exact (rows, C) shape being tuned."""
+    import jax
+    import jax.numpy as jnp
+
+    from bigdl_tpu.ops.bn_kernel import bn_stats
+
+    x = jax.random.normal(jax.random.PRNGKey(0), (rows, c), dtype)
+    timed: List[Tuple[dict, float]] = []
+    for rb in candidates:
+        fn = jax.jit(functools.partial(bn_stats, row_block=rb))
+        # bn_stats returns (sum, sumsq), not x-shaped: time_fn re-invokes
+        ms = time_fn(fn, x)
+        timed.append(({"row_block": rb}, ms))
+    return _pick(timed)
+
+
+# Representative conv shape set: the distinct ResNet-50 b32 bottleneck
+# geometries (n, h, w, cin, cout, kh, kw, stride) — a scaled-down version
+# of scripts/conv_bwd_probe.py's sweep so one measure pass stays cheap.
+# Total ms across the set approximates one step's conv time, so summing is
+# the right weighting for a single global per-pass decision.
+CONV_PROBE_SHAPES: Tuple[Tuple[int, int, int, int, int, int, int, int], ...] = (
+    (32, 224, 224, 3, 64, 7, 7, 2),    # stem (the measured 7x wgrad case)
+    (32, 56, 56, 64, 64, 1, 1, 1),
+    (32, 56, 56, 64, 64, 3, 3, 1),
+    (32, 28, 28, 128, 128, 3, 3, 1),
+    (32, 14, 14, 256, 256, 3, 3, 1),
+    (32, 7, 7, 512, 512, 3, 3, 1),
+)
+
+
+def measure_conv_layouts(dtype) -> Tuple[dict, float]:
+    """Per-pass independent layout decision (the generalized form of
+    scripts/conv_bwd_probe.py + ops/conv2d.decide_from_probe): time each
+    of fwd/dgrad/wgrad under NHWC and NCHW across the shape set and pick
+    the per-pass minimum of the totals. Returns ({'fwd'|'dgrad'|'wgrad':
+    layout}, total_best_ms)."""
+    import jax
+    import jax.numpy as jnp
+
+    from bigdl_tpu.ops.conv2d import _conv_in_layout
+
+    totals = {p: {"NHWC": 0.0, "NCHW": 0.0}
+              for p in ("fwd", "dgrad", "wgrad")}
+    for n, h, w, cin, cout, kh, kw, stride in CONV_PROBE_SHAPES:
+        kx, kw_ = jax.random.split(jax.random.PRNGKey(0))
+        x = jax.random.normal(kx, (n, h, w, cin), dtype)
+        wgt = jax.random.normal(kw_, (kh, kw, cin, cout), dtype)
+        pad = ((kh // 2, kh // 2), (kw // 2, kw // 2))
+        for layout in ("NHWC", "NCHW"):
+            conv = functools.partial(
+                _conv_in_layout, stride=(stride, stride), padding=pad,
+                rhs_dilation=(1, 1), groups=1, layout=layout)
+            y = conv(x, wgt)
+            dy = jnp.ones_like(y)
+
+            fwd = jax.jit(lambda x_, w_=wgt: conv(x_, w_))
+            totals["fwd"][layout] += time_fn(fwd, x)
+
+            dgrad = jax.jit(lambda dy_, x_=x, w_=wgt: jax.linear_transpose(
+                lambda xx: conv(xx, w_), x_)(dy_)[0])
+            totals["dgrad"][layout] += time_fn(dgrad, dy)
+
+            wgrad = jax.jit(lambda dy_, x_=x, w_=wgt: jax.linear_transpose(
+                lambda ww: conv(x_, ww), w_)(dy_)[0])
+            totals["wgrad"][layout] += time_fn(wgrad, dy)
+
+    decision: Dict[str, str] = {}
+    best_total = 0.0
+    for p, per in totals.items():
+        # NHWC wins ties: deterministic, and it is the framework default
+        lay = "NCHW" if per["NCHW"] < per["NHWC"] else "NHWC"
+        decision[p] = lay
+        best_total += per[lay]
+    return decision, best_total

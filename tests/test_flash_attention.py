@@ -522,23 +522,28 @@ def test_segment_padding_rows_agree_across_paths(causal):
 
 
 def test_default_blocks_clamp_for_mid_sequences():
-    """Raising the default block size to 512 must not demote a
-    128-tileable sequence (768, 1920, ...) to the dense fallback
-    (review r5): the kernel path must still be taken, proven by the
-    causal FLOPs count matching the 128-block live-pair formula (the
-    dense path would count full s^2)."""
-    from bigdl_tpu.ops.attention_kernel import _live_block_pairs
+    """The 512 defaults must not demote a 128-tileable sequence (768,
+    1920, ...) to the dense fallback, and — ADVICE r5 #2 — block_q must
+    clamp the same way block_k does, so s=768 runs three real 256-blocks
+    instead of padding q 768→1024 (~33% extra q-block work whose padded
+    rows the declared CostEstimate used to count). Proven by the causal
+    FLOPs count matching the UNPADDED 256-block live-pair formula (the
+    dense path would count full s^2; the old padded geometry would count
+    q rows 768..1023)."""
+    from bigdl_tpu.ops.attention_kernel import (_clamp_block,
+                                                _live_block_pairs)
     from bigdl_tpu.utils.flops import fn_flops
 
     b, h, s, d = 1, 2, 768, 64
+    assert _clamp_block(512, s) == 256  # both dims, same rule
     q = jnp.ones((b, h, s, d), jnp.float32)
     got = fn_flops(lambda q: flash_attention(q, q, q, causal=True), q)
-    # kernel geometry: q padded 768 -> 1024 (block_q 512), block_k
-    # clamped 512 -> 128 (768 % 512 != 0); the declared-cost count must
-    # match this padded live-pair formula EXACTLY — the dense fallback
-    # would instead count the unpadded full-s^2 qk+pv (3.02e8 != this)
-    pairs = _live_block_pairs(1024, s, 512, 128, True, 0)
-    expect = 2 * (2.0 * b * h * pairs * 512 * 128 * d)
+    pairs = _live_block_pairs(s, s, 256, 256, True, 0)
+    expect = 2 * (2.0 * b * h * pairs * 256 * 256 * d)
     np.testing.assert_allclose(got, expect, rtol=1e-6)
     dense_count = 2 * (2.0 * b * h * s * s * d)
     assert abs(got - dense_count) / dense_count > 0.05
+    # padded-geometry count (the pre-fix behavior) must NOT match either
+    padded = 2 * (2.0 * b * h * _live_block_pairs(1024, s, 512, 128,
+                                                  True, 0) * 512 * 128 * d)
+    assert abs(got - padded) / padded > 0.05
