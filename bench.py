@@ -311,6 +311,13 @@ def main() -> None:
                     # kept as a companion so regressions/fixes show up
                     ("resnet50_fbn", "resnet50_fbn", batch, iters, 1,
                      "off"),
+                    # ISSUE 2 tentpole: the FULL fused BN block (stats+
+                    # apply+absorbed-ReLU fwd, reductions+dx bwd in one
+                    # kernel each, PERF.md §10) — the headline resnet50
+                    # and the _fbn row above are the default/stats legs
+                    # of the fused-vs-stats-vs-default A/B
+                    ("resnet50_fba", "resnet50_fba", batch, iters, 1,
+                     "off"),
                     ("resnet50_pipe", "resnet50_pipe", batch, iters, 1,
                      "off"),
                     # accuracy-vs-wall-clock (BASELINE's second metric)
@@ -327,7 +334,7 @@ def main() -> None:
                             "tokens_per_second", "batch", "iterations",
                             "inner_steps", "seconds", "time_to_acc_s",
                             "target_top1", "reached", "final_top1",
-                            "autotune")
+                            "autotune", "bn_fused")
                         if cres.get(k) is not None}
                     if cres.get("backend") == "tpu":
                         _partial(cname, cres)

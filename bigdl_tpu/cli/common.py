@@ -35,6 +35,31 @@ def add_autotune_arg(p: argparse.ArgumentParser) -> None:
                         "timing); 'off' = shipped defaults")
 
 
+def add_fused_bn_arg(p: argparse.ArgumentParser) -> None:
+    """--fusedBN [off|stats|apply]: Pallas BN for training-mode batch
+    norm. Bare ``--fusedBN`` keeps the historical meaning (the stats
+    kernel) so existing invocations/scripts are unchanged."""
+    p.add_argument("--fusedBN", nargs="?", const="stats", default=None,
+                   choices=["off", "stats", "apply"],
+                   help="Pallas BN path (ops/bn_kernel.py; single-device "
+                        "jit, auto-disabled under SPMD): 'stats' = "
+                        "single-read stats kernel (measured −46%% on "
+                        "chip, PERF.md §8.2 — kept for A/Bs); 'apply' = "
+                        "the FULL fused block: stats+apply+absorbed-ReLU "
+                        "in one kernel forward, Σdy/Σ(dy·x̂)+dx in one "
+                        "kernel backward (PERF.md §10). Bare --fusedBN "
+                        "means 'stats' (historical)")
+
+
+def apply_fused_bn(model, mode: Optional[str]):
+    """Install the --fusedBN choice on a built model (no-op for
+    None/'off'). Returns the model."""
+    if mode and mode != "off":
+        from bigdl_tpu.nn import set_bn_fused
+        set_bn_fused(model, mode)
+    return model
+
+
 def compile_cache_dir() -> Optional[str]:
     """Resolve the persistent compile-cache dir: BIGDL_JAX_CACHE wins;
     a user-managed JAX_COMPILATION_CACHE_DIR is left to jax itself (None
@@ -130,6 +155,7 @@ def add_train_args(p: argparse.ArgumentParser) -> None:
     p.add_argument("--dataParallel", action="store_true",
                    help="shard the batch over all visible devices")
     add_autotune_arg(p)
+    add_fused_bn_arg(p)
     p.add_argument("--seed", type=int, default=42)
     p.add_argument("--logEvery", type=int, default=10)
     p.add_argument("--summary", default=None, metavar="DIR",
@@ -173,6 +199,10 @@ def build_optimizer(model, dataset, criterion, args, schedule=None,
                     optim_method=None):
     from bigdl_tpu.optim import Optimizer, SGD, Trigger
     from bigdl_tpu.optim.schedules import Default
+
+    # --fusedBN lever for every training CLI (the Optimizer auto-unfuses
+    # with a warning under a multi-device strategy)
+    apply_fused_bn(model, getattr(args, "fusedBN", None))
 
     if optim_method is None:
         sched = (schedule if schedule is not None

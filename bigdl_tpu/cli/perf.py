@@ -50,9 +50,9 @@ def _bn_subset(m, k: int = 32):
     return set_bn_stat_sample(m, k)
 
 
-def _bn_fused(m):
+def _bn_fused(m, mode=True):
     from bigdl_tpu.nn import set_bn_fused
-    return set_bn_fused(m)
+    return set_bn_fused(m, mode)
 
 
 def _lm(*, num_kv_heads=2, pos_encoding="rope", **kw):
@@ -86,8 +86,15 @@ def build_model(name: str, class_num: int = 1000):
         "resnet50_bnss": lambda: _bn_subset(models.resnet50(class_num)),
         # single-read Pallas BN stats (ops/bn_kernel.py): the stats pass
         # is the #1 sync op category (PERF.md §2); exact semantics,
-        # unlike the bnss subset sampling
+        # unlike the bnss subset sampling. Measured −46% on chip (§8.2)
+        # — kept as the A/B middle leg against _fba below
         "resnet50_fbn": lambda: _bn_fused(models.resnet50(class_num)),
+        # the FULL fused BN block (ISSUE 2): stats+apply+absorbed-ReLU
+        # one kernel forward, Σdy/Σ(dy·x̂)+dx one kernel backward —
+        # attacks the 34 ms backward (PERF.md §10) where the stats-only
+        # kernel above LOST 46% by unfusing its elementwise neighbors
+        "resnet50_fba": lambda: _bn_fused(models.resnet50(class_num),
+                                          "apply"),
         # CIFAR-shaped depth-20 resnet (reference models/resnet/README
         # recipe) — the fast time-to-accuracy config
         "resnet20_cifar": lambda: models.resnet_cifar(
@@ -191,15 +198,26 @@ def _annotate_autotune(out: dict) -> None:
         out["autotune"] = ann
 
 
+def _annotate_bn_fused(out: dict, model) -> None:
+    """Stamp the model's effective BN fusion mode (off/stats/apply) the
+    same way the autotune decisions are stamped, so fused-vs-stats-vs-
+    default A/B rows are self-describing (ISSUE 2 satellite)."""
+    from bigdl_tpu.nn.norm import bn_fused_mode
+    out["bn_fused"] = bn_fused_mode(model)
+
+
 def run(model_name: str, batch: int, iterations: int, data_type: str,
         use_bf16: bool = True, data_parallel: bool = False,
         data_source: str | None = None, inner_steps: int = 1,
-        profile_dir: str | None = None, autotune: str | None = None):
+        profile_dir: str | None = None, autotune: str | None = None,
+        fused_bn: str | None = None):
     """Throughput harness entry. ``autotune`` optionally installs the
     tuning mode (the CLI does it via --autotune/apply_platform; bench.py
-    children pass it directly). The conv layout policy is snapshotted and
-    restored so back-to-back runs in one process stay independent
-    (ADVICE r5 #1)."""
+    children pass it directly). ``fused_bn`` ('off'/'stats'/'apply')
+    installs the Pallas BN path on the built model — the flag spelling of
+    the resnet50_fbn/_fba model names. The conv layout policy is
+    snapshotted and restored so back-to-back runs in one process stay
+    independent (ADVICE r5 #1)."""
     from bigdl_tpu import tuning
     from bigdl_tpu.ops import conv2d
 
@@ -211,7 +229,7 @@ def run(model_name: str, batch: int, iterations: int, data_type: str,
         return _run_timed(model_name, batch, iterations, data_type,
                           use_bf16=use_bf16, data_parallel=data_parallel,
                           data_source=data_source, inner_steps=inner_steps,
-                          profile_dir=profile_dir)
+                          profile_dir=profile_dir, fused_bn=fused_bn)
     finally:
         conv2d.restore_policy(snap)
 
@@ -219,7 +237,8 @@ def run(model_name: str, batch: int, iterations: int, data_type: str,
 def _run_timed(model_name: str, batch: int, iterations: int, data_type: str,
                use_bf16: bool = True, data_parallel: bool = False,
                data_source: str | None = None, inner_steps: int = 1,
-               profile_dir: str | None = None):
+               profile_dir: str | None = None,
+               fused_bn: str | None = None):
     import os
 
     import jax
@@ -252,6 +271,8 @@ def _run_timed(model_name: str, batch: int, iterations: int, data_type: str,
     from bigdl_tpu.optim import SGD
 
     model, in_shape = build_model(model_name)
+    from bigdl_tpu.cli.common import apply_fused_bn
+    apply_fused_bn(model, fused_bn)
     is_lm = model_name.startswith("transformer_lm")
     crit = (nn.TimeDistributedCriterion(nn.ClassNLLCriterion()) if is_lm
             else nn.ClassNLLCriterion())
@@ -424,6 +445,7 @@ def _run_timed(model_name: str, batch: int, iterations: int, data_type: str,
     }
     _annotate_conv_layouts(out)
     _annotate_autotune(out)
+    _annotate_bn_fused(out, model)
     if flops_error is not None:
         out["flops_analytic_error"] = flops_error
     if flops_analytic and flops_hlo:
@@ -531,7 +553,8 @@ def run_time_to_acc(model_name: str, batch: int, target: float,
                     use_bf16: bool = True, data_dir: str | None = None,
                     hard: bool = False, val_every_iters: int | None = None,
                     lift: float | None = None, noise: float | None = None,
-                    weight_decay: float = 1e-4):
+                    weight_decay: float = 1e-4,
+                    fused_bn: str | None = None):
     """Time-to-accuracy harness (BASELINE.json metric: images/sec/chip
     **+ time-to-76%-top1**; reference recipe models/inception/Train.scala
     :77-83 + scripts/run.example.sh:54). Trains ``model_name`` from
@@ -583,6 +606,8 @@ def run_time_to_acc(model_name: str, batch: int, target: float,
                                     std=std)
 
         model, _ = build_model(model_name, class_num=classes)
+        from bigdl_tpu.cli.common import apply_fused_bn
+        apply_fused_bn(model, fused_bn)
         opt = Optimizer(
             model, train_ds, nn.ClassNLLCriterion(),
             # wd matches the reference CIFAR recipe (models/resnet/README.md
@@ -640,6 +665,7 @@ def run_time_to_acc(model_name: str, batch: int, target: float,
     }
     _annotate_conv_layouts(out)
     _annotate_autotune(out)
+    _annotate_bn_fused(out, model)
     print(json.dumps(out))
     return out
 
@@ -710,9 +736,10 @@ def main(argv=None):
                         "kind (ops/conv2d.MEASURED_DECISIONS), no-op on "
                         "unmeasured devices; 'default' forces all-NHWC")
     from bigdl_tpu.cli.common import (_add_platform_arg, add_autotune_arg,
-                                      apply_platform)
+                                      add_fused_bn_arg, apply_platform)
     _add_platform_arg(p)
     add_autotune_arg(p)
+    add_fused_bn_arg(p)
     args = p.parse_args(argv)
     apply_platform(args)
     if args.convLayout:
@@ -732,12 +759,12 @@ def main(argv=None):
                         use_bf16=not args.f32, data_dir=data_dir,
                         hard=args.ttaHard, val_every_iters=args.valEvery,
                         lift=args.ttaLift, noise=args.ttaNoise,
-                        weight_decay=args.ttaWd)
+                        weight_decay=args.ttaWd, fused_bn=args.fusedBN)
         return
     run(args.model, args.batchSize, args.iteration, args.dataType,
         use_bf16=not args.f32, data_parallel=args.dataParallel,
         data_source=args.data, inner_steps=args.innerSteps,
-        profile_dir=args.profile)
+        profile_dir=args.profile, fused_bn=args.fusedBN)
 
 
 if __name__ == "__main__":

@@ -16,9 +16,11 @@ same way, models/inception/Options.scala:134):
 * ``--s2d`` (imagenet-only) — space-to-depth stem: the 7x7/2 conv on
   224x224x3 runs at ~3.6% of MXU peak (PERF.md §3); the s2d rewrite is
   the same math with MXU-sized channel dims.
-* ``--fusedBN`` — single-read Pallas BN stats (ops/bn_kernel.py),
-  targeting the BN-stats HBM re-read (largest sync-op category in the
-  profiled ResNet-50 step, PERF.md §2). Single-device jit path; the
+* ``--fusedBN [off|stats|apply]`` — Pallas BN (ops/bn_kernel.py):
+  ``stats`` is the single-read stats kernel (round-4 lever, measured
+  −46% on chip — PERF.md §8.2); ``apply`` is the full fused BN block
+  (stats+apply+absorbed-ReLU forward, reductions+dx backward — PERF.md
+  §10), attacking the 34 ms backward. Single-device jit path; the
   Optimizer falls back automatically (with a warning) under multi-device
   SPMD, where pallas_call has no partitioning rule.
 """
@@ -35,9 +37,7 @@ def _add_lever_args(tr):
     tr.add_argument("--bnStatSample", type=int, default=None,
                     help="BN training stats from this many batch rows "
                          "(throughput lever; see nn.set_bn_stat_sample)")
-    tr.add_argument("--fusedBN", action="store_true",
-                    help="single-read Pallas BN stats kernel "
-                         "(single-device jit; auto-disabled under SPMD)")
+    # --fusedBN [off|stats|apply] comes in via common.add_train_args
     tr.add_argument("--s2d", action="store_true",
                     help="space-to-depth stem (imagenet models only): "
                          "MXU-friendly rewrite of the 7x7/2 stem conv")
@@ -126,9 +126,9 @@ def main(argv=None):
     if getattr(args, "bnStatSample", None):
         from bigdl_tpu.nn import set_bn_stat_sample
         set_bn_stat_sample(model, args.bnStatSample)
-    if getattr(args, "fusedBN", False):
-        from bigdl_tpu.nn import set_bn_fused
-        set_bn_fused(model)
+    # --fusedBN (off/stats/apply) is installed by common.build_optimizer
+    # for train; the test path has no BN-fusion lever (eval-mode BN is a
+    # plain elementwise op)
     if args.cmd == "train":
         if args.dataset == "imagenet":
             train, test = _imagenet_datasets(args.folder, args.batchSize)

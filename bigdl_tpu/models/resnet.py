@@ -160,10 +160,13 @@ _IMAGENET_CFG = {
 
 def resnet(depth: int = 50, class_num: int = 1000,
            shortcut_type: str = "B", zero_init_residual: bool = False,
-           s2d_stem: bool = False) -> Sequential:
+           s2d_stem: bool = False, fused_bn=False) -> Sequential:
     """ImageNet ResNet (reference ResNet.apply with DataSet.ImageNet).
     Input (B, 224, 224, 3) NHWC. ``s2d_stem`` swaps the 7x7/2 stem for
-    the space-to-depth equivalent (see :class:`SpaceToDepthStem`)."""
+    the space-to-depth equivalent (see :class:`SpaceToDepthStem`).
+    ``fused_bn``: "stats" or "apply" routes every BN through the Pallas
+    kernels at build time (nn.set_bn_fused); "apply" also absorbs the
+    conv→BN→ReLU chains' ReLUs into the fused block epilogue."""
     kind, layers = _IMAGENET_CFG[depth]
     m = Sequential(name=f"ResNet{depth}")
     if s2d_stem:
@@ -191,14 +194,16 @@ def resnet(depth: int = 50, class_num: int = 1000,
     m.add(nn.Reshape([cin]))
     m.add(nn.Linear(cin, class_num, init="xavier"))
     m.add(nn.LogSoftMax())
+    if fused_bn:
+        nn.set_bn_fused(m, fused_bn)
     return m
 
 
 def resnet_cifar(depth: int = 20, class_num: int = 10,
-                 shortcut_type: str = "A") -> Sequential:
+                 shortcut_type: str = "A", fused_bn=False) -> Sequential:
     """CIFAR-10 ResNet, depth = 6n+2 (reference ResNet.apply CIFAR path;
     recipe in models/resnet/README: depth 20, shortcut A). Input
-    (B, 32, 32, 3)."""
+    (B, 32, 32, 3). ``fused_bn`` as in :func:`resnet`."""
     assert (depth - 2) % 6 == 0, "CIFAR depth must be 6n+2"
     n = (depth - 2) // 6
     m = Sequential(name=f"ResNet{depth}-cifar")
@@ -216,8 +221,11 @@ def resnet_cifar(depth: int = 20, class_num: int = 10,
     m.add(nn.Reshape([64]))
     m.add(nn.Linear(64, class_num, init="xavier"))
     m.add(nn.LogSoftMax())
+    if fused_bn:
+        nn.set_bn_fused(m, fused_bn)
     return m
 
 
-def resnet50(class_num: int = 1000, s2d_stem: bool = False) -> Sequential:
-    return resnet(50, class_num, s2d_stem=s2d_stem)
+def resnet50(class_num: int = 1000, s2d_stem: bool = False,
+             fused_bn=False) -> Sequential:
+    return resnet(50, class_num, s2d_stem=s2d_stem, fused_bn=fused_bn)

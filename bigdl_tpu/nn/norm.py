@@ -20,6 +20,7 @@ __all__ = [
     "BatchNormalization",
     "set_bn_stat_sample",
     "set_bn_fused",
+    "bn_fused_mode",
     "unfuse_bn_for_spmd",
     "SpatialBatchNormalization",
     "SpatialCrossMapLRN",
@@ -28,6 +29,20 @@ __all__ = [
     "SpatialContrastiveNormalization",
     "Normalize",
 ]
+
+
+def _canon_fused(fused) -> "bool | str":
+    """Normalize the ``fused`` knob: False/None/"off" → False (jnp path),
+    True/"stats" → "stats" (single-read stats kernel), "apply" → "apply"
+    (the full fused block)."""
+    if fused in (False, None, "off"):
+        return False
+    if fused in (True, "stats"):
+        return "stats"
+    if fused == "apply":
+        return "apply"
+    raise ValueError(f"fused must be one of False/'off'/True/'stats'/"
+                     f"'apply', got {fused!r}")
 
 
 class BatchNormalization(Module):
@@ -53,19 +68,28 @@ class BatchNormalization(Module):
     def __init__(self, n_output: int, eps: float = 1e-5, momentum: float = 0.1,
                  affine: bool = True, axis_name: Optional[str] = None,
                  gamma_init: float = 1.0, stat_sample: Optional[int] = None,
-                 fused: bool = False, name: Optional[str] = None):
+                 fused=False, name: Optional[str] = None):
         super().__init__(name)
         self.n_output = n_output
         self.eps, self.momentum, self.affine = eps, momentum, affine
         self.axis_name = axis_name
         self.gamma_init = gamma_init
-        # fused=True routes training stats through the single-read Pallas
-        # kernel (ops/bn_kernel.py) — the BN stats pass is the largest
-        # sync op category in the ResNet step (PERF.md §2). Single-device
-        # jit only: under SPMD-sharded batches a pallas_call does not
-        # auto-partition (use axis_name + shard_map for sync-BN instead),
-        # and it composes with neither axis_name nor stat_sample.
-        self.fused = fused
+        # fused routes training-mode BN through the Pallas kernels
+        # (ops/bn_kernel.py). Modes: False/"off" = jnp (XLA fuses);
+        # True/"stats" = single-read stats kernel, apply/dx in jnp (the
+        # round-4 lever, measured NEGATIVE on chip — PERF.md §8.2);
+        # "apply" = the FULL fused block (ISSUE 2): stats+apply(+absorbed
+        # ReLU, see ``fuse_relu``) one kernel forward, reductions+dx one
+        # kernel backward. Single-device jit only: under SPMD-sharded
+        # batches a pallas_call does not auto-partition (use axis_name +
+        # shard_map for sync-BN instead), and it composes with neither
+        # axis_name nor stat_sample.
+        self.fused = _canon_fused(fused)
+        # set by nn.structural.absorb_bn_relu when this BN swallowed the
+        # ReLU that followed it in a Sequential chain; EVERY code path
+        # (fused or jnp, train or eval) then applies the ReLU here, so
+        # semantics survive mode flips and the SPMD unfuse fallback
+        self.fuse_relu = False
         # stat_sample=k: training statistics from the first k batch rows
         # only. The stats pass re-reads every activation from HBM (the
         # dominant BN cost on TPU — PERF.md §2); a subset cuts that read
@@ -93,10 +117,19 @@ class BatchNormalization(Module):
         axes = tuple(range(x.ndim - 1))  # all but features
         if (training and self.fused and self.affine
                 and self.axis_name is None and not self.stat_sample):
-            from bigdl_tpu.ops.bn_kernel import fused_bn_train
+            if self.fused == "apply":
+                from bigdl_tpu.ops.bn_kernel import fused_bn_apply_train
 
-            y, mean, var = fused_bn_train(x, params["weight"],
-                                          params["bias"], self.eps)
+                y, mean, var = fused_bn_apply_train(
+                    x, params["weight"], params["bias"], self.eps,
+                    bool(self.fuse_relu))
+            else:
+                from bigdl_tpu.ops.bn_kernel import fused_bn_train
+
+                y, mean, var = fused_bn_train(x, params["weight"],
+                                              params["bias"], self.eps)
+                if self.fuse_relu:
+                    y = jnp.maximum(y, jnp.zeros((), y.dtype))
             m = self.momentum
             n = x.size // x.shape[-1]
             unbiased = var * n / max(1, n - 1)
@@ -136,6 +169,8 @@ class BatchNormalization(Module):
             scale = inv
             shift = -mean * scale
         y = xf * scale + shift
+        if self.fuse_relu:  # absorbed ReLU: applies on EVERY path
+            y = jnp.maximum(y, 0.0)
         return y.astype(x.dtype), new_state
 
 
@@ -149,14 +184,39 @@ def set_bn_stat_sample(module, k: Optional[int]):
     return module
 
 
-def set_bn_fused(module, fused: bool = True):
-    """Route every BatchNormalization's training stats through the
-    single-read Pallas kernel (ops/bn_kernel.py; single-device jit —
-    see the ``fused`` constructor note). Returns the module."""
+def set_bn_fused(module, fused=True):
+    """Route every BatchNormalization through a Pallas BN path
+    (ops/bn_kernel.py; single-device jit — see the ``fused`` constructor
+    note). ``fused``: True/"stats" = the single-read stats kernel,
+    "apply" = the FULL fused block (stats+apply+absorbed-ReLU forward,
+    reductions+dx backward — ISSUE 2), False/"off" = back to jnp.
+    "apply" additionally rewrites Sequential chains so a ReLU directly
+    following a BN is absorbed into the kernel epilogue
+    (:func:`~bigdl_tpu.nn.structural.absorb_bn_relu`); the rewrite is
+    sticky — flipping back to "stats"/off keeps semantics because the BN
+    applies the absorbed ReLU on every path. Returns the module."""
+    mode = _canon_fused(fused)
     for m in module.modules():
         if isinstance(m, BatchNormalization):
-            m.fused = fused
+            m.fused = mode
+    if mode == "apply":
+        from bigdl_tpu.nn.structural import absorb_bn_relu
+        absorb_bn_relu(module)
     return module
+
+
+def bn_fused_mode(module) -> str:
+    """The model's effective BN fusion mode for result-JSON provenance:
+    "apply" if any BatchNormalization runs the full fused block, else
+    "stats" if any runs the stats kernel, else "off" (also for models
+    with no BN at all)."""
+    modes = {m.fused for m in module.modules()
+             if isinstance(m, BatchNormalization)}
+    if "apply" in modes:
+        return "apply"
+    if "stats" in modes:
+        return "stats"
+    return "off"
 
 
 def unfuse_bn_for_spmd(module, n_devices: int) -> int:
@@ -165,7 +225,9 @@ def unfuse_bn_for_spmd(module, n_devices: int) -> int:
     so a batch-sharded activation would be replicated onto every device
     (memory/perf cliff) or fail to lower — defeating the kernel's purpose.
     Called by the Optimizer's distributed compile path; returns the number
-    of modules switched back to the jnp stats path."""
+    of modules switched back to the jnp path. Covers both "stats" and
+    "apply" modes; an absorbed ReLU (``fuse_relu``) keeps applying on the
+    jnp path, so the fallback is semantics-preserving."""
     count = 0
     if n_devices > 1:
         for m in module.modules():

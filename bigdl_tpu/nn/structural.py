@@ -27,6 +27,7 @@ from bigdl_tpu.core.module import (
 )
 
 __all__ = [
+    "absorb_bn_relu",
     "Concat", "ConcatTable", "ParallelTable", "MapTable", "NarrowTable",
     "FlattenTable", "JoinTable", "MixtureTable", "CriterionTable", "Bottle",
     "Reshape", "View", "Transpose", "Squeeze", "Unsqueeze", "Select",
@@ -36,6 +37,37 @@ __all__ = [
     "CAddTable", "CSubTable", "CMulTable", "CDivTable", "CMaxTable",
     "CMinTable",
 ]
+
+
+def absorb_bn_relu(module: Module) -> int:
+    """Graph rewrite for the fused BN block (ISSUE 2): in every
+    :class:`Sequential` under ``module``, a ReLU directly following a
+    BatchNormalization is absorbed into the BN (``bn.fuse_relu = True``,
+    applied inside the Pallas epilogue on the fused path and as a jnp max
+    on every other path) and replaced by :class:`Identity`.
+
+    The swap is checkpoint-compatible: ReLU and Identity both own empty
+    params (``{}``) and state (``()``), so child indices and pytree
+    structure are unchanged. Only Sequential chains are rewritten —
+    siblings in Concat/ConcatTable consume the same INPUT, not each
+    other's output, so adjacency there is not data flow. Returns the
+    number of ReLUs absorbed; idempotent (an absorbed ReLU is already an
+    Identity on the second pass)."""
+    from bigdl_tpu.nn.activation import ReLU
+    from bigdl_tpu.nn.norm import BatchNormalization
+
+    count = 0
+    for m in module.modules():
+        if not isinstance(m, Sequential):
+            continue
+        mods = m._modules
+        for i in range(len(mods) - 1):
+            if (isinstance(mods[i], BatchNormalization)
+                    and type(mods[i + 1]) is ReLU):
+                mods[i].fuse_relu = True
+                mods[i + 1] = Identity(name=f"{mods[i + 1].name}(absorbed)")
+                count += 1
+    return count
 
 
 # --------------------------------------------------------------------------

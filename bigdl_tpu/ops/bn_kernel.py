@@ -1,4 +1,4 @@
-"""Fused batch-norm statistics for TPU — single-read Pallas kernels.
+"""Fused batch-norm kernels for TPU — single-read Pallas passes.
 
 Why this exists: the round-3 xplane profile (PERF.md §2) shows BN stat
 reductions as the largest synchronous op category in the ResNet-50 step
@@ -9,7 +9,7 @@ that read. The reference never had this problem shape: its MKL BN
 (nn/SpatialBatchNormalization.scala backed by the native batchnorm) ran
 per-core on cache-resident tiles.
 
-Two kernels, both one HBM pass:
+Two stats-only kernels, both one HBM pass:
 
 * :func:`bn_stats` — (rows, C) activations → per-channel (sum, sumsq)
   accumulated in f32 VMEM scratch across a serial row-block grid. One
@@ -18,11 +18,28 @@ Two kernels, both one HBM pass:
   same pattern over (dy, x) with the normalization folded in, one read
   of each operand.
 
-The elementwise apply ((x-μ)·inv·γ+β) and the dx elementwise expression
-stay in jnp — XLA fuses those into neighbors for free; only the
-reductions needed hand-tiling. :func:`fused_bn_train` packages
-stats+apply+backward under one ``jax.custom_vjp`` so
-``nn.BatchNormalization(fused=True)`` can swap it in transparently.
+:func:`fused_bn_train` packages those stats under one ``jax.custom_vjp``
+(the apply and dx elementwise stay in jnp) — the round-4 "stats" mode.
+The round-5 chip A/B measured it NEGATIVE end-to-end (−46%, PERF.md
+§8.2): ``pallas_call`` is an optimization barrier, so fusing ONLY the
+reductions unfuses the elementwise neighbors XLA was already folding
+them into, and the activation still crosses HBM once per extra pass.
+
+The round-7 answer is to move the whole block inside the barrier:
+
+* :func:`bn_fwd_apply` — one kernel whose two-phase row sweep first
+  accumulates the stats, then applies ``(x−μ)·inv·γ+β`` (+ optional
+  ReLU) — stats, normalize, affine and activation in a single launch.
+* :func:`bn_bwd_fused` — one kernel fusing the Σdy/Σ(dy·x̂) reductions
+  (with the ReLU mask recomputed from x, so no mask tensor is saved)
+  with the dx elementwise expression in its second phase.
+
+:func:`fused_bn_apply_train` wraps the pair in a ``jax.custom_vjp`` so
+``nn.BatchNormalization(fused="apply")`` swaps in the full fused block
+(ISSUE 2 tentpole). Per pass the activation is read twice and written
+once inside ONE kernel — vs the three separate convert/reduce/
+elementwise HBM round-trips of the unfused backward — and the ReLU
+residual disappears entirely.
 
 Non-TPU backends run interpret mode (tests); block specs follow the
 (8, 128) tiling rule (validated by the Mosaic block-spec lint in
@@ -38,7 +55,8 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-__all__ = ["bn_stats", "bn_bwd_stats", "fused_bn_train"]
+__all__ = ["bn_stats", "bn_bwd_stats", "fused_bn_train",
+           "bn_fwd_apply", "bn_bwd_fused", "fused_bn_apply_train"]
 
 
 def _vmem_scratch(shape):
@@ -265,3 +283,267 @@ def _fused_vjp_bwd(eps, res, cts):
 
 
 fused_bn_train.defvjp(_fused_vjp_fwd, _fused_vjp_bwd)
+
+
+# ---------------------------------------------------------------------------
+# Fused BN block (ISSUE 2 tentpole): stats+apply(+ReLU) forward and
+# reductions+dx backward, each a SINGLE kernel with a two-phase row sweep.
+#
+# Grid is (c_blocks, 2, row_blocks) — row dim innermost, phase in the
+# middle — so for each channel block the serial order is: phase 0 sweeps
+# every row block accumulating the per-channel reductions in f32 VMEM
+# scratch, then phase 1 re-sweeps the rows doing the elementwise work with
+# the finalized scalars still resident in scratch. The elementwise output's
+# index map collapses every phase-0 step onto block (0, ci) (``ri * ph``),
+# so Mosaic's revisit coalescing never flushes a garbage block: the first
+# real write of (0, ci) happens at phase 1, row 0, before any transition
+# away from that block index.
+# ---------------------------------------------------------------------------
+
+
+def _resolve_fba_row_block(rows: int, c: int, relu: bool, *dtypes) -> int:
+    """Row-block height for the fused-block kernels: the autotuner's
+    decision for this (rows, C, dtype, relu) under the ``bn_fba`` key when
+    one exists, else the shipped default clamped to the array."""
+    from bigdl_tpu import tuning
+    if tuning.get_mode() != "off":
+        tuned = tuning.fba_row_block(rows, c, dtypes[0], relu)
+        if tuned:
+            return min(tuned, rows)
+    return min(_ROW_BLOCK, rows)
+
+
+def _fba_check(name, rows, c, rb, *dtypes):
+    cb = min(_C_BLOCK, c)
+    ms = _min_sublane(*dtypes)
+    if rows % rb or c % cb or rows % ms or c % 128:
+        raise ValueError(f"{name} needs rows%{rb}==0, rows%{ms}==0 "
+                         f"(dtypes {'/'.join(str(d) for d in dtypes)}), "
+                         f"C%{cb}==0 and C%128==0, got ({rows}, {c})")
+    return cb
+
+
+def _pack_rows(*vecs) -> jax.Array:
+    """Stack per-channel f32 vectors into a full (8, C) min-tile operand —
+    tiny HBM traffic, and the block never relies on sub-minimum sublanes."""
+    c = vecs[0].shape[-1]
+    out = jnp.zeros((_OUT_SUBLANES, c), jnp.float32)
+    for i, v in enumerate(vecs):
+        out = out.at[i].set(v.astype(jnp.float32))
+    return out
+
+
+def _fba_fwd_kernel(x_ref, gb_ref, y_ref, mean_ref, var_ref, acc_ref, *,
+                    rows: float, eps: float, relu: bool):
+    ph = pl.program_id(1)
+    r = pl.program_id(2)
+
+    @pl.when(jnp.logical_and(ph == 0, r == 0))
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    @pl.when(ph == 0)
+    def _accum():
+        x = x_ref[...].astype(jnp.float32)
+        acc_ref[0, :] += jnp.sum(x, axis=0)
+        acc_ref[1, :] += jnp.sum(x * x, axis=0)
+
+    @pl.when(jnp.logical_and(ph == 0, r == pl.num_programs(2) - 1))
+    def _finalize():
+        mean = acc_ref[0:1, :] / rows
+        var = jnp.maximum(acc_ref[1:2, :] / rows - mean * mean, 0.0)
+        inv = jax.lax.rsqrt(var + eps)
+        scale = inv * gb_ref[0:1, :]
+        mean_ref[...] = jnp.broadcast_to(mean, mean_ref.shape)
+        var_ref[...] = jnp.broadcast_to(var, var_ref.shape)
+        # stats are folded into the (scale, shift) the apply phase needs;
+        # rows 0/1 are dead once mean/var left the kernel
+        acc_ref[2:3, :] = scale
+        acc_ref[3:4, :] = gb_ref[1:2, :] - mean * scale
+
+    @pl.when(ph == 1)
+    def _apply():
+        y = x_ref[...].astype(jnp.float32) * acc_ref[2:3, :] \
+            + acc_ref[3:4, :]
+        if relu:
+            y = jnp.maximum(y, 0.0)
+        y_ref[...] = y.astype(y_ref.dtype)
+
+
+def bn_fwd_apply(x2d: jax.Array, gamma: jax.Array, beta: jax.Array,
+                 eps: float, relu: bool = False,
+                 row_block: "int | None" = None):
+    """Training-mode BN forward over a (rows, C) array in ONE kernel:
+    per-channel stats (phase 0) then ``(x−μ)·inv·γ+β`` (+ ReLU) applied
+    in phase 1 with the scalars still in VMEM. Returns ``(y, mean, var)``
+    with mean/var f32. Same tiling contract as :func:`bn_stats`;
+    ``row_block=None`` resolves through the autotuner (``bn_fba`` key)."""
+    rows, c = x2d.shape
+    rb = row_block or _resolve_fba_row_block(rows, c, relu, x2d.dtype)
+    cb = _fba_check("bn_fwd_apply", rows, c, rb, x2d.dtype)
+    grid = (c // cb, 2, rows // rb)
+    y, mean, var = pl.pallas_call(
+        functools.partial(_fba_fwd_kernel, rows=float(rows),
+                          eps=float(eps), relu=bool(relu)),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((rb, cb), lambda ci, ph, ri: (ri, ci)),
+            pl.BlockSpec((_OUT_SUBLANES, cb), lambda ci, ph, ri: (0, ci)),
+        ],
+        out_specs=[
+            pl.BlockSpec((rb, cb), lambda ci, ph, ri: (ri * ph, ci)),
+            pl.BlockSpec((_OUT_SUBLANES, cb), lambda ci, ph, ri: (0, ci)),
+            pl.BlockSpec((_OUT_SUBLANES, cb), lambda ci, ph, ri: (0, ci)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((rows, c), x2d.dtype),
+            jax.ShapeDtypeStruct((_OUT_SUBLANES, c), jnp.float32),
+            jax.ShapeDtypeStruct((_OUT_SUBLANES, c), jnp.float32),
+        ],
+        scratch_shapes=[_vmem_scratch((4, cb))],
+        interpret=_interpret(),
+    )(x2d, _pack_rows(gamma, beta))
+    return y, mean[0], var[0]
+
+
+def _fba_bwd_kernel(dy_ref, x_ref, pp_ref, dx_ref, sdy_ref, sdyx_ref,
+                    acc_ref, *, rows: float, relu: bool):
+    ph = pl.program_id(1)
+    r = pl.program_id(2)
+    mean = pp_ref[0:1, :]
+    inv = pp_ref[1:2, :]
+    gamma = pp_ref[2:3, :]
+    dy = dy_ref[...].astype(jnp.float32)
+    xh = (x_ref[...].astype(jnp.float32) - mean) * inv
+    if relu:
+        # the ReLU mask is recomputed from x and the per-channel scalars
+        # (y = x̂·γ+β > 0) — no mask/activation tensor is saved or re-read
+        dy = jnp.where(xh * gamma + pp_ref[3:4, :] > 0.0, dy, 0.0)
+
+    @pl.when(jnp.logical_and(ph == 0, r == 0))
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    @pl.when(ph == 0)
+    def _accum():
+        acc_ref[0, :] += jnp.sum(dy, axis=0)
+        acc_ref[1, :] += jnp.sum(dy * xh, axis=0)
+
+    @pl.when(jnp.logical_and(ph == 0, r == pl.num_programs(2) - 1))
+    def _finalize():
+        sdy_ref[...] = jnp.broadcast_to(acc_ref[0:1, :], sdy_ref.shape)
+        sdyx_ref[...] = jnp.broadcast_to(acc_ref[1:2, :], sdyx_ref.shape)
+        acc_ref[2:3, :] = acc_ref[0:1, :] / rows
+        acc_ref[3:4, :] = acc_ref[1:2, :] / rows
+
+    @pl.when(ph == 1)
+    def _dx():
+        dx = (dy - acc_ref[2:3, :] - xh * acc_ref[3:4, :]) * (gamma * inv)
+        dx_ref[...] = dx.astype(dx_ref.dtype)
+
+
+def bn_bwd_fused(dy2d: jax.Array, x2d: jax.Array, mean: jax.Array,
+                 inv: jax.Array, gamma: jax.Array, beta: jax.Array,
+                 relu: bool = False, row_block: "int | None" = None):
+    """The whole BN(+ReLU) backward in ONE kernel: phase 0 accumulates
+    (Σdy, Σ(dy·x̂)) with the ReLU mask folded in, phase 1 emits the classic
+    dx expression with the finalized means still in VMEM. Returns
+    ``(dx, sum_dy, sum_dy_xhat)`` — the sums are dbeta/dgamma."""
+    rows, c = dy2d.shape
+    rb = row_block or _resolve_fba_row_block(rows, c, relu,
+                                             dy2d.dtype, x2d.dtype)
+    cb = _fba_check("bn_bwd_fused", rows, c, rb, dy2d.dtype, x2d.dtype)
+    grid = (c // cb, 2, rows // rb)
+    dx, sdy, sdyx = pl.pallas_call(
+        functools.partial(_fba_bwd_kernel, rows=float(rows),
+                          relu=bool(relu)),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((rb, cb), lambda ci, ph, ri: (ri, ci)),
+            pl.BlockSpec((rb, cb), lambda ci, ph, ri: (ri, ci)),
+            pl.BlockSpec((_OUT_SUBLANES, cb), lambda ci, ph, ri: (0, ci)),
+        ],
+        out_specs=[
+            pl.BlockSpec((rb, cb), lambda ci, ph, ri: (ri * ph, ci)),
+            pl.BlockSpec((_OUT_SUBLANES, cb), lambda ci, ph, ri: (0, ci)),
+            pl.BlockSpec((_OUT_SUBLANES, cb), lambda ci, ph, ri: (0, ci)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((rows, c), x2d.dtype),
+            jax.ShapeDtypeStruct((_OUT_SUBLANES, c), jnp.float32),
+            jax.ShapeDtypeStruct((_OUT_SUBLANES, c), jnp.float32),
+        ],
+        scratch_shapes=[_vmem_scratch((4, cb))],
+        interpret=_interpret(),
+    )(dy2d, x2d, _pack_rows(mean, inv, gamma, beta))
+    return dx, sdy[0], sdyx[0]
+
+
+def _fba_tileable(rows: int, c: int, relu: bool, *dtypes) -> bool:
+    ms = _min_sublane(*dtypes)
+    return rows % _resolve_fba_row_block(rows, c, relu, *dtypes) == 0 \
+        and rows % ms == 0 \
+        and c % min(_C_BLOCK, c) == 0 and c % 128 == 0
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def fused_bn_apply_train(x, gamma, beta, eps: float, relu: bool = False,
+                         row_block: Optional[int] = None):
+    """Training-mode BN(+ReLU) over the last axis with BOTH directions
+    fully fused (stats+apply forward, reductions+dx backward — one Pallas
+    launch each). x: (..., C); returns (y, mean, var) like
+    :func:`fused_bn_train`; mean/var are the batch stats the caller folds
+    into its running estimates. Untileable shapes fall back to the same
+    math in jnp. ``row_block`` pins the kernels' row-block height
+    (autotune measurement); ``None`` resolves through the cache."""
+    y, mean, var, _ = _fba_fwd(x, gamma, beta, eps, relu, row_block)
+    return y, mean, var
+
+
+def _fba_fwd(x, gamma, beta, eps, relu, row_block):
+    c = x.shape[-1]
+    rows = x.size // c
+    x2 = x.reshape(rows, c)
+    if row_block or _fba_tileable(rows, c, relu, x.dtype):
+        y2, mean, var = bn_fwd_apply(x2, gamma, beta, eps, relu, row_block)
+        y = y2.reshape(x.shape)
+    else:  # jnp fallback, same math
+        xf = x2.astype(jnp.float32)
+        mean = jnp.mean(xf, 0)
+        var = jnp.maximum(jnp.mean(xf * xf, 0) - mean * mean, 0.0)
+        scale = jax.lax.rsqrt(var + eps) * gamma
+        y = xf * scale + (beta - mean * scale)
+        if relu:
+            y = jnp.maximum(y, 0.0)
+        y = y.astype(x.dtype).reshape(x.shape)
+    return y, mean, var, (x, mean, var, gamma, beta)
+
+
+def _fba_vjp_fwd(x, gamma, beta, eps, relu, row_block):
+    y, mean, var, res = _fba_fwd(x, gamma, beta, eps, relu, row_block)
+    return (y, mean, var), res
+
+
+def _fba_vjp_bwd(eps, relu, row_block, res, cts):
+    dy, d_mean, d_var = cts
+    del d_mean, d_var  # running-stat EMA carries no gradient
+    x, mean, var, gamma, beta = res
+    inv = jax.lax.rsqrt(var + eps)
+    c = x.shape[-1]
+    rows = x.size // c
+    dy2 = dy.reshape(rows, c)
+    if row_block or _fba_tileable(rows, c, relu, dy.dtype, x.dtype):
+        dx2, sdy, sdyx = bn_bwd_fused(dy2, x.reshape(rows, c), mean, inv,
+                                      gamma, beta, relu, row_block)
+    else:
+        xh = (x.reshape(rows, c).astype(jnp.float32) - mean) * inv
+        dyf = dy2.astype(jnp.float32)
+        if relu:
+            dyf = jnp.where(xh * gamma + beta > 0.0, dyf, 0.0)
+        sdy, sdyx = jnp.sum(dyf, 0), jnp.sum(dyf * xh, 0)
+        dx2 = ((dyf - sdy / rows - xh * (sdyx / rows))
+               * (gamma * inv)).astype(x.dtype)
+    return dx2.reshape(x.shape), sdyx, sdy
+
+
+fused_bn_apply_train.defvjp(_fba_vjp_fwd, _fba_vjp_bwd)

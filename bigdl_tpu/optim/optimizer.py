@@ -204,7 +204,22 @@ class Optimizer:
 
     def resume(self, checkpoint_dir: str) -> "Optimizer":
         """Load the newest model.<n>/state.<n> pair from a directory
-        (either single-blob or orbax-sharded snapshots)."""
+        (either single-blob or orbax-sharded snapshots).
+
+        Step-equivalence (ADVICE r5 #4): snapshots written by this
+        version also carry the host-RNG split count, the records consumed
+        in the open epoch, and the completed-epoch count; optimize() then
+        fast-forwards the PRNG stream, skips the already-consumed leading
+        records of the interrupted epoch, and replays the per-epoch
+        ``dataset.shuffle()`` calls — so for datasets whose order is
+        driven by a seeded ``shuffle()`` (BatchDataSet, LocalArrayDataSet
+        and friends), kill+resume replays exactly the dropout keys and
+        batches an uninterrupted run would have used. Residual
+        non-equivalence: datasets that advance their own RNG inside
+        ``__iter__`` (e.g. LocalArrayDataSet(shuffle=True)) or stream
+        from non-deterministic sources re-order the skipped records, and
+        older snapshots without the counters resume with a fresh stream
+        from the seed (counters-only semantics, as before)."""
         from bigdl_tpu.utils.file import (isdir, latest_checkpoint,
                                           latest_checkpoint_pair)
         # newest MATCHED pair: a kill between the model.<n> and state.<n>
@@ -247,7 +262,8 @@ class Optimizer:
                 drv = {"iteration": int(tail)}
         if drv:
             self._resume_driver = {k: int(v) for k, v in dict(drv).items()
-                                   if k in ("epoch", "iteration")}
+                                   if k in ("epoch", "iteration",
+                                            "rng_splits", "epoch_records")}
             # a kill between the model.<n> and state.<n> writes leaves an
             # unmatched (unusable) newer snapshot; with counters resuming,
             # the deterministic trigger will re-reach exactly that name —
@@ -414,7 +430,19 @@ class Optimizer:
 
     def _optimize(self) -> TrainedModel:
         rng = jax.random.PRNGKey(self.seed)
-        rng, k_init = jax.random.split(rng)
+        # every consumption of the host PRNG stream goes through _next_key
+        # so its position is a single counter — checkpointed, and
+        # fast-forwarded on resume (ADVICE r5 #4: kill+resume replays the
+        # exact dropout/rng keys of an uninterrupted run)
+        self._rng_splits = 0
+
+        def _next_key():
+            nonlocal rng
+            rng, k = jax.random.split(rng)
+            self._rng_splits += 1
+            return k
+
+        k_init = _next_key()
         params = (self._init_params if self._init_params is not None
                   else self.model.init(k_init))
         mod_state = (self._init_mod_state if self._init_mod_state is not None
@@ -431,12 +459,24 @@ class Optimizer:
         driver = {"epoch": 1, "iteration": 0, "prev_iteration": 0,
                   "epoch_finished": False, "loss": float("inf")}
         rd = getattr(self, "_resume_driver", None)
+        self._skip_records = 0
         if rd:
             driver["iteration"] = rd.get("iteration", 0)
             driver["prev_iteration"] = driver["iteration"]
             driver["epoch"] = rd.get("epoch", 1)
-            logger.info("Resuming at epoch %d, iteration %d",
-                        driver["epoch"], driver["iteration"])
+            # step-equivalent resume (ADVICE r5 #4): put the PRNG stream,
+            # the per-epoch shuffle chain, and the data cursor back where
+            # the killed process left them. Older snapshots carry no
+            # counters and keep the counters-only behavior.
+            while self._rng_splits < rd.get("rng_splits", 0):
+                _next_key()
+            for _ in range(driver["epoch"] - 1):  # one shuffle per rollover
+                self.dataset.shuffle()
+            self._skip_records = rd.get("epoch_records", 0)
+            logger.info("Resuming at epoch %d, iteration %d (rng stream at "
+                        "%d splits, skipping %d consumed records)",
+                        driver["epoch"], driver["iteration"],
+                        self._rng_splits, self._skip_records)
         wall_start = time.time()
         self._wall_start = wall_start
         records_this_epoch = 0
@@ -455,6 +495,7 @@ class Optimizer:
             # N+1 can be enqueued while N still runs on device
             driver["loss"] = loss
             records_this_epoch += n_rec
+            driver["epoch_records"] = records_this_epoch  # resume cursor
             # crossing-based (== modulo for n_iters=1): a chunk that jumps
             # the counter past a multiple of log_every still logs
             if driver["iteration"] // self.log_every != prev_it // self.log_every:
@@ -500,8 +541,23 @@ class Optimizer:
             driver["epoch_finished"] = False
             epoch_start = time.time()
             records_this_epoch = 0
+            driver["epoch_records"] = 0
             opt_state = self.optim_method.set_epoch(opt_state, driver["epoch"])
             data_iter = iter(self.dataset)
+            if self._skip_records:
+                # mid-epoch resume: drop the leading records the killed
+                # process already trained on, so the epoch continues at
+                # the same cursor instead of replaying from its start
+                skip, self._skip_records = self._skip_records, 0
+                skipped = 0
+                while skipped < skip:
+                    b = next(data_iter, _end)
+                    if b is _end:
+                        break
+                    bx, _by = b
+                    skipped += len(bx)
+                records_this_epoch = skipped
+                driver["epoch_records"] = skipped
             pending = None  # batch fetched but shape-incompatible w/ chunk
             epoch_done = False
             while not epoch_done:
@@ -529,10 +585,8 @@ class Optimizer:
                     ys = jax.tree_util.tree_map(
                         lambda *ls: jnp.stack([jnp.asarray(l) for l in ls]),
                         *[by for _, by in buf])
-                    keys = []
-                    for _ in range(K):  # same host key sequence as K=1
-                        rng, k_step = jax.random.split(rng)
-                        keys.append(k_step)
+                    # same host key sequence as K=1 (counted for resume)
+                    keys = [_next_key() for _ in range(K)]
                     params, mod_state, opt_state, loss = chunk_fn(
                         params, mod_state, opt_state, xs, ys,
                         jnp.stack(keys))
@@ -552,7 +606,7 @@ class Optimizer:
                         # target may be a pytree (Mixup's (y_a, y_b, lam))
                         x = jnp.asarray(x)
                         y = jax.tree_util.tree_map(jnp.asarray, y)
-                    rng, k_step = jax.random.split(rng)
+                    k_step = _next_key()
                     params, mod_state, opt_state, loss = step_fn(
                         params, mod_state, opt_state, x, y, k_step)
                     after_dispatch(len(x), 1, t0, loss)
@@ -564,6 +618,7 @@ class Optimizer:
                         break
             driver["epoch"] += 1
             driver["epoch_finished"] = True
+            driver["epoch_records"] = 0  # next epoch starts at cursor 0
             self.dataset.shuffle()
             dt_e = time.time() - epoch_start
             logger.info("Epoch %d done: %d records in %.2fs (%.1f rec/s)",
@@ -643,7 +698,12 @@ class Optimizer:
             raise FileExistsError(
                 f"{target} exists; pass overwrite=True to set_checkpoint "
                 f"(--overWriteCheckpoint) to clobber it")
-        drv = {"epoch": driver["epoch"], "iteration": n}
+        drv = {"epoch": driver["epoch"], "iteration": n,
+               # step-equivalent resume counters (ADVICE r5 #4): the host
+               # PRNG stream position and the records already consumed in
+               # the open epoch (0 at an epoch boundary)
+               "rng_splits": int(getattr(self, "_rng_splits", 0)),
+               "epoch_records": int(driver.get("epoch_records", 0))}
         if getattr(self, "_ckpt_sharded", False):
             # pod-scale path: every host writes its own shards, no gather
             from bigdl_tpu.utils.orbax_ckpt import save_sharded

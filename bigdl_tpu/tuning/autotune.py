@@ -35,7 +35,8 @@ from typing import Dict, Optional, Tuple
 from bigdl_tpu.tuning.cache import AutotuneCache
 
 __all__ = ["MODES", "set_mode", "get_mode", "dry_run", "make_key",
-           "flash_blocks", "bn_row_block", "install_conv_layouts",
+           "flash_blocks", "bn_row_block", "fba_row_block",
+           "install_conv_layouts",
            "annotation", "reset", "reset_decisions", "get_cache"]
 
 MODES = ("off", "cached", "measure")
@@ -219,6 +220,39 @@ def bn_row_block(rows: int, c: int, dtype) -> Optional[int]:
     def _measure():
         from bigdl_tpu.tuning.measure import measure_bn_row_block
         return measure_bn_row_block(rows, c, dtype, cands)
+
+    config, _ = _resolve(key, default, _measure)
+    return int(config["row_block"])
+
+
+def fba_row_block(rows: int, c: int, dtype,
+                  relu: bool = False) -> Optional[int]:
+    """Tuned row-block height for the FUSED BN block kernels (ISSUE 2:
+    stats+apply forward / reductions+dx backward, ops/bn_kernel.py
+    ``bn_fwd_apply``/``bn_bwd_fused``), or None when off / no legal
+    candidate. Keyed separately from the stats-only kernel — the fused
+    block keeps the activation resident across a two-phase sweep, so its
+    best height need not match ``bn_stats``'s; ``relu`` is a key facet
+    because the mask work changes the phase balance."""
+    if _MODE == "off":
+        return None
+    from bigdl_tpu.ops.bn_kernel import _min_sublane
+
+    ms = _min_sublane(dtype)
+    cands = [rb for rb in BN_ROW_BLOCKS
+             if rb <= rows and rows % rb == 0 and rb % ms == 0]
+    if not cands or c % 128:
+        return None
+    key = make_key("bn_fba", rows=rows, channels=c,
+                   dtype=_dtype_name(dtype), relu=int(bool(relu)))
+    default_rb = min(512, rows)
+    if rows % default_rb:  # default doesn't tile: smallest legal candidate
+        default_rb = cands[0]
+    default = {"row_block": default_rb}
+
+    def _measure():
+        from bigdl_tpu.tuning.measure import measure_fba_row_block
+        return measure_fba_row_block(rows, c, dtype, relu, cands)
 
     config, _ = _resolve(key, default, _measure)
     return int(config["row_block"])

@@ -19,7 +19,8 @@ import time
 from typing import Dict, List, Sequence, Tuple
 
 __all__ = ["time_fn", "measure_flash_blocks", "measure_bn_row_block",
-           "measure_conv_layouts", "CONV_PROBE_SHAPES"]
+           "measure_fba_row_block", "measure_conv_layouts",
+           "CONV_PROBE_SHAPES"]
 
 _WARMUP = 1
 _ITERS = 3
@@ -105,6 +106,34 @@ def measure_bn_row_block(rows: int, c: int, dtype,
         fn = jax.jit(functools.partial(bn_stats, row_block=rb))
         # bn_stats returns (sum, sumsq), not x-shaped: time_fn re-invokes
         ms = time_fn(fn, x)
+        timed.append(({"row_block": rb}, ms))
+    return _pick(timed)
+
+
+def measure_fba_row_block(rows: int, c: int, dtype, relu: bool,
+                          candidates: Sequence[int]) -> Tuple[dict, float]:
+    """Time fwd+bwd of the FUSED BN block (stats+apply(+ReLU) forward,
+    reductions+dx backward — ops/bn_kernel.fused_bn_apply_train) per
+    row-block candidate on the exact (rows, C) shape being tuned. Both
+    kernels share the decision, so the timed unit is a full grad step."""
+    import jax
+    import jax.numpy as jnp
+
+    from bigdl_tpu.ops.bn_kernel import fused_bn_apply_train
+
+    key = jax.random.PRNGKey(0)
+    x = jax.random.normal(key, (rows, c), dtype)
+    gamma = jnp.ones((c,), jnp.float32)
+    beta = jnp.zeros((c,), jnp.float32)
+
+    timed: List[Tuple[dict, float]] = []
+    for rb in candidates:
+        def loss(x_, rb=rb):
+            return jnp.sum(fused_bn_apply_train(
+                x_, gamma, beta, 1e-5, relu, rb)[0].astype(jnp.float32))
+
+        g = jax.jit(jax.grad(loss))
+        ms = time_fn(g, x)  # grad is x-shaped: calls chain
         timed.append(({"row_block": rb}, ms))
     return _pick(timed)
 

@@ -1,0 +1,58 @@
+#!/usr/bin/env bash
+# Round-7 capture: ISSUE 2 (fused BN apply+backward epilogue) chip
+# evidence. Core contract: the ResNet-50 b128 fused-vs-stats-vs-default
+# A/B — resnet50 (default jnp BN) vs resnet50_fbn (round-4 stats-only
+# kernel, the measured −46% leg) vs resnet50_fba (the FULL fused block:
+# stats+apply+absorbed-ReLU one kernel forward, Σdy/Σ(dy·x̂)+dx one
+# kernel backward — PERF.md §10), attacking the 34 ms backward where the
+# stats-only kernel lost by unfusing its elementwise neighbors. Plus the
+# bn_fba row-block autotune populate/replay and the flag-spelled run so
+# the bn_fused JSON stamp lands in the log. Appends to $OUT, mirrored
+# into the repo per step.
+
+set -uo pipefail
+cd "$(dirname "$0")/.."
+OUT="${OUT:-/tmp/tpu_capture_r07.log}"
+REPO_LOG="${REPO_LOG:-TPU_CAPTURE_r07.log}"
+trap 'cp -f "$OUT" "$REPO_LOG" 2>/dev/null || true' EXIT
+
+step() {
+  local name="$1" tmo="$2"; shift 2
+  echo "=== $name ($(date -u +%H:%M:%SZ))" | tee -a "$OUT"
+  timeout "$tmo" "$@" 2>&1 | tail -30 | tee -a "$OUT"
+  echo "=== end $name rc=$?" | tee -a "$OUT"
+  cp -f "$OUT" "$REPO_LOG" 2>/dev/null || true
+}
+
+# 1. compiled-path kernel tests (includes test_fba_compiled_on_tpu: the
+#    two-phase grid + ri*ph output index map verified under Mosaic, not
+#    interpret — the round-3 lesson)
+step "pytest_tpu_marked" 1200 env BIGDL_TPU_TESTS=1 python -m pytest tests/ -m tpu -q
+
+# 2. THE A/B contract — resnet50 b128 fused-vs-stats-vs-default, same
+#    window, bn_fused stamped in every JSON line
+step "perf_resnet50_b128_default" 900 python -m bigdl_tpu.cli.perf -m resnet50 -b 128 -i 20 --dataType random
+step "perf_resnet50_b128_fbn_stats" 900 python -m bigdl_tpu.cli.perf -m resnet50_fbn -b 128 -i 20 --dataType random
+step "perf_resnet50_b128_fba_apply" 900 python -m bigdl_tpu.cli.perf -m resnet50_fba -b 128 -i 20 --dataType random
+
+# 3. flag spelling of the same lever (reaches every model, stamps
+#    bn_fused=apply without the _fba model alias)
+step "perf_resnet50_b128_fusedBN_apply_flag" 900 python -m bigdl_tpu.cli.perf -m resnet50 -b 128 -i 20 --dataType random --fusedBN apply
+
+# 4. bn_fba row-block autotune: populate the cache (measure pays the
+#    candidate sweep), then the timed replay under cached — does a tuned
+#    row block move the fused-block verdict?
+step "autotune_measure_resnet50_fba" 1800 python -m bigdl_tpu.cli.perf -m resnet50_fba -b 128 -i 20 --dataType random --autotune measure
+step "perf_resnet50_fba_tuned" 900 python -m bigdl_tpu.cli.perf -m resnet50_fba -b 128 -i 20 --dataType random --autotune cached
+
+# 5. fused block composed with the best measured single lever
+#    (innerSteps=10, the 2,677.7 img/s config) — the §8.2 lesson is that
+#    levers interact; measure the composition, don't assume it
+step "perf_resnet50_fba_inner10" 900 python -m bigdl_tpu.cli.perf -m resnet50_fba -b 128 -i 4 --innerSteps 10 --dataType random
+
+# 6. the populated cache is part of the evidence — archive it
+step "autotune_cache_dump" 60 sh -c 'for f in ~/.cache/bigdl_tpu/autotune/*.json; do echo "--- $f"; cat "$f"; done'
+
+# 7. full bench line (resnet50_fba companion rides next to resnet50_fbn
+#    and the headline — the A/B inside one JSON line)
+step "bench_headline" 5400 env BENCH_TPU_TIMEOUT=2000 python bench.py resnet50 128 20

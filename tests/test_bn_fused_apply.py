@@ -1,0 +1,354 @@
+"""Fused BN block (ISSUE 2 tentpole, ops/bn_kernel.py): stats+apply(+ReLU)
+forward and reductions+dx backward as single Pallas launches — CPU parity
+vs the unfused jnp reference, vjp gradcheck, module/model wiring, the
+Mosaic tiling lint, and the autotune bn_fba key round-trip."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu import nn
+from bigdl_tpu.ops.bn_kernel import (bn_fwd_apply, bn_bwd_fused,
+                                     fused_bn_apply_train)
+
+EPS = 1e-5
+
+
+def _ref_bn(x, gamma, beta, relu):
+    """Plain differentiable BN(+ReLU) in jnp — the oracle."""
+    c = x.shape[-1]
+    xf = x.astype(jnp.float32).reshape(-1, c)
+    mean = xf.mean(0)
+    var = xf.var(0)
+    y = (xf - mean) * jax.lax.rsqrt(var + EPS) * gamma + beta
+    if relu:
+        y = jnp.maximum(y, 0.0)
+    return y.reshape(x.shape).astype(x.dtype), mean, var
+
+
+@pytest.mark.parametrize("relu", [False, True])
+@pytest.mark.parametrize("shape", [(8, 4, 4, 128), (1024, 256)])
+def test_fwd_apply_matches_ref(shape, relu):
+    rs = np.random.RandomState(0)
+    c = shape[-1]
+    x = jnp.asarray(rs.randn(*shape).reshape(-1, c), jnp.float32)
+    gamma = jnp.asarray(rs.rand(c) + 0.5, jnp.float32)
+    beta = jnp.asarray(rs.randn(c), jnp.float32)
+    y, mean, var = bn_fwd_apply(x, gamma, beta, EPS, relu)
+    yr, mr, vr = _ref_bn(x, gamma, beta, relu)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(yr), atol=1e-4)
+    np.testing.assert_allclose(np.asarray(mean), np.asarray(mr), atol=1e-4)
+    np.testing.assert_allclose(np.asarray(var), np.asarray(vr), atol=1e-4)
+
+
+@pytest.mark.parametrize("relu", [False, True])
+def test_vjp_matches_ref(relu):
+    """dx/dgamma/dbeta of the fused block == autodiff through the jnp
+    reference, under a non-uniform cotangent (a uniform one would hide a
+    missing mean-subtraction in dx)."""
+    rs = np.random.RandomState(1)
+    shape, c = (16, 4, 4, 128), 128
+    x = jnp.asarray(rs.randn(*shape), jnp.float32)
+    gamma = jnp.asarray(rs.rand(c) + 0.5, jnp.float32)
+    beta = jnp.asarray(rs.randn(c), jnp.float32)
+    w = jnp.asarray(rs.randn(*shape), jnp.float32)
+
+    gf = jax.grad(lambda *a: jnp.sum(
+        fused_bn_apply_train(*a, EPS, relu)[0] * w), argnums=(0, 1, 2))(
+        x, gamma, beta)
+    gr = jax.grad(lambda *a: jnp.sum(
+        _ref_bn(*a, relu)[0] * w), argnums=(0, 1, 2))(x, gamma, beta)
+    for a, b, n in zip(gf, gr, ("dx", "dgamma", "dbeta")):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=2e-4, err_msg=f"{n} relu={relu}")
+
+
+def test_bwd_fused_sums_match_jnp():
+    """The kernel's (Σdy, Σ(dy·x̂)) outputs with the ReLU mask folded in
+    match the explicit jnp computation."""
+    rs = np.random.RandomState(2)
+    rows, c = 512, 128
+    x = jnp.asarray(rs.randn(rows, c), jnp.float32)
+    dy = jnp.asarray(rs.randn(rows, c), jnp.float32)
+    gamma = jnp.asarray(rs.rand(c) + 0.5, jnp.float32)
+    beta = jnp.asarray(rs.randn(c), jnp.float32)
+    mean = x.mean(0)
+    var = x.var(0)
+    inv = jax.lax.rsqrt(var + EPS)
+    dx, sdy, sdyx = bn_bwd_fused(dy, x, mean, inv, gamma, beta, relu=True)
+    xh = (x - mean) * inv
+    dy_eff = jnp.where(xh * gamma + beta > 0.0, dy, 0.0)
+    np.testing.assert_allclose(np.asarray(sdy),
+                               np.asarray(jnp.sum(dy_eff, 0)), atol=5e-3)
+    np.testing.assert_allclose(np.asarray(sdyx),
+                               np.asarray(jnp.sum(dy_eff * xh, 0)),
+                               atol=5e-3)
+    dx_ref = (dy_eff - jnp.mean(dy_eff, 0)
+              - xh * jnp.mean(dy_eff * xh, 0)) * (gamma * inv)
+    np.testing.assert_allclose(np.asarray(dx), np.asarray(dx_ref),
+                               atol=2e-4)
+
+
+def test_bf16_fwd_and_grad_parity():
+    rs = np.random.RandomState(3)
+    rows, c = 1024, 128
+    xf = rs.randn(rows, c).astype(np.float32)
+    x16 = jnp.asarray(xf, jnp.bfloat16)
+    gamma = jnp.asarray(rs.rand(c) + 0.5, jnp.float32)
+    beta = jnp.asarray(rs.randn(c), jnp.float32)
+    y, mean, var = bn_fwd_apply(x16, gamma, beta, EPS, True)
+    assert y.dtype == jnp.bfloat16 and mean.dtype == jnp.float32
+    yr, _, _ = _ref_bn(x16, gamma, beta, True)
+    np.testing.assert_allclose(np.asarray(y, np.float32),
+                               np.asarray(yr, np.float32), atol=5e-2)
+    g = jax.grad(lambda g_: jnp.sum(jnp.sin(fused_bn_apply_train(
+        x16, g_, beta, EPS, True)[0].astype(jnp.float32))))(gamma)
+    gr = jax.grad(lambda g_: jnp.sum(jnp.sin(_ref_bn(
+        x16, g_, beta, True)[0].astype(jnp.float32))))(gamma)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(gr),
+                               rtol=2e-2, atol=2e-1)
+
+
+@pytest.mark.parametrize("relu", [False, True])
+def test_module_apply_mode_matches_unfused(relu):
+    """BatchNormalization(fused='apply') (+absorbed ReLU) training step ==
+    the unfused BN(+ReLU) chain: outputs, running-stat updates, grads —
+    and eval mode (running stats, jnp path) stays identical too."""
+    rs = np.random.RandomState(4)
+    x = jnp.asarray(rs.randn(16, 4, 4, 128), jnp.float32)
+
+    def chain():
+        m = nn.Sequential(nn.SpatialBatchNormalization(128))
+        if relu:
+            m.add(nn.ReLU())
+        return m
+
+    m_ref, m_fba = chain(), chain()
+    nn.set_bn_fused(m_fba, "apply")
+    assert m_fba[0].fused == "apply"
+    assert m_fba[0].fuse_relu == relu
+    p = m_ref.init(jax.random.PRNGKey(0))
+    assert (jax.tree_util.tree_structure(p)
+            == jax.tree_util.tree_structure(m_fba.init(jax.random.PRNGKey(0))))
+
+    for training in (True, False):
+        y0, ns0 = m_ref.apply(p, m_ref.init_state(), x, training=training)
+        y1, ns1 = m_fba.apply(p, m_fba.init_state(), x, training=training)
+        np.testing.assert_allclose(np.asarray(y1), np.asarray(y0),
+                                   atol=1e-4, err_msg=f"train={training}")
+        for k in ns0["0"]:
+            np.testing.assert_allclose(np.asarray(ns1["0"][k]),
+                                       np.asarray(ns0["0"][k]), atol=1e-5)
+    s0, s1 = m_ref.init_state(), m_fba.init_state()
+    g0 = jax.grad(lambda xx: jnp.sum(jnp.square(
+        m_ref.apply(p, s0, xx, training=True)[0])))(x)
+    g1 = jax.grad(lambda xx: jnp.sum(jnp.square(
+        m_fba.apply(p, s1, xx, training=True)[0])))(x)
+    np.testing.assert_allclose(np.asarray(g1), np.asarray(g0), atol=2e-4)
+
+
+def test_absorb_bn_relu_rewrite():
+    """The rewrite absorbs only BN→ReLU adjacency inside Sequential,
+    keeps the params pytree structure (checkpoint compat), and is
+    idempotent."""
+    from bigdl_tpu.nn.structural import absorb_bn_relu
+
+    m = nn.Sequential(
+        nn.SpatialConvolution(3, 128, 3, 3),
+        nn.SpatialBatchNormalization(128),
+        nn.ReLU(),
+        nn.SpatialBatchNormalization(128),   # no ReLU after: not absorbed
+        nn.ConcatTable(nn.SpatialBatchNormalization(128), nn.ReLU()),
+    )
+    before = jax.tree_util.tree_structure(m.init(jax.random.PRNGKey(0)))
+    n = absorb_bn_relu(m)
+    assert n == 1
+    assert m[1].fuse_relu and not m[3].fuse_relu
+    assert type(m[2]).__name__ == "Identity"
+    # ConcatTable siblings see the same INPUT — never rewritten
+    assert not m[4][0].fuse_relu
+    assert jax.tree_util.tree_structure(
+        m.init(jax.random.PRNGKey(0))) == before
+    assert absorb_bn_relu(m) == 0  # idempotent
+
+
+def test_untileable_falls_back_to_jnp():
+    """C not %128: the jnp fallback inside the custom_vjp keeps the module
+    usable with identical semantics, fwd and bwd."""
+    rs = np.random.RandomState(5)
+    x = jnp.asarray(rs.randn(8, 3, 3, 20), jnp.float32)
+    g = jnp.asarray(rs.rand(20) + 0.5, jnp.float32)
+    b = jnp.asarray(rs.randn(20), jnp.float32)
+    y, _, _ = fused_bn_apply_train(x, g, b, EPS, True)
+    yr, _, _ = _ref_bn(x, g, b, True)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(yr), atol=1e-5)
+    gx = jax.grad(lambda xx: jnp.sum(
+        jnp.square(fused_bn_apply_train(xx, g, b, EPS, True)[0])))(x)
+    gr = jax.grad(lambda xx: jnp.sum(jnp.square(_ref_bn(
+        xx, g, b, True)[0])))(x)
+    np.testing.assert_allclose(np.asarray(gx), np.asarray(gr), atol=1e-4)
+
+
+def test_resnet_builder_fused_apply_parity():
+    """models.resnet_cifar(fused_bn='apply') — the end-to-end wiring: same
+    params pytree as the plain model, same loss and input grads."""
+    from bigdl_tpu import models
+    from bigdl_tpu.nn.norm import bn_fused_mode
+
+    rs = np.random.RandomState(6)
+    x = jnp.asarray(rs.randn(8, 32, 32, 3), jnp.float32)
+    m0 = models.resnet_cifar(8, 10)
+    m1 = models.resnet_cifar(8, 10, fused_bn="apply")
+    assert bn_fused_mode(m0) == "off" and bn_fused_mode(m1) == "apply"
+    assert sum(1 for mm in m1.modules()
+               if getattr(mm, "fuse_relu", False)) > 0
+    p0 = m0.init(jax.random.PRNGKey(0))
+    p1 = m1.init(jax.random.PRNGKey(0))
+    assert (jax.tree_util.tree_structure(p0)
+            == jax.tree_util.tree_structure(p1))
+    y0, _ = m0.apply(p0, m0.init_state(), x, training=True)
+    y1, _ = m1.apply(p1, m1.init_state(), x, training=True)
+    np.testing.assert_allclose(np.asarray(y1), np.asarray(y0), atol=1e-4)
+    g0 = jax.grad(lambda xx: jnp.sum(
+        m0.apply(p0, m0.init_state(), xx, training=True)[0]))(x)
+    g1 = jax.grad(lambda xx: jnp.sum(
+        m1.apply(p1, m1.init_state(), xx, training=True)[0]))(x)
+    np.testing.assert_allclose(np.asarray(g1), np.asarray(g0), atol=2e-4)
+
+
+def test_fba_kernel_block_specs_satisfy_mosaic_tiling():
+    """Same lint as the stats/flash kernels: every block of the two new
+    pallas_calls is a full (>=8, >=128) tile or equals the array dims —
+    no reliance on the sub-minimum-tile escape."""
+    from unittest import mock
+
+    from jax.experimental import pallas as real_pl
+
+    captured = []
+    real_call = real_pl.pallas_call
+
+    def spy(kernel, **kw):
+        in_specs = kw.get("in_specs") or []
+        out_specs = kw.get("out_specs")
+        out_shape = kw.get("out_shape")
+        outs = out_specs if isinstance(out_specs, (list, tuple)) \
+            else [out_specs]
+        shapes = out_shape if isinstance(out_shape, (list, tuple)) \
+            else [out_shape]
+        inner = real_call(kernel, **kw)
+
+        def wrapped(*args):
+            for spec, arr in list(zip(in_specs, args)) + [
+                    (sp, sh) for sp, sh in zip(outs, shapes)]:
+                if spec is not None:
+                    captured.append((tuple(spec.block_shape),
+                                     tuple(arr.shape)))
+            return inner(*args)
+
+        return wrapped
+
+    import bigdl_tpu.ops.bn_kernel as bnk
+    with mock.patch.object(bnk.pl, "pallas_call", side_effect=spy):
+        rs = np.random.RandomState(7)
+        x = jnp.asarray(rs.randn(1024, 256), jnp.float32)
+        g = jnp.asarray(rs.rand(256), jnp.float32)
+        b = jnp.asarray(rs.randn(256), jnp.float32)
+        jax.grad(lambda xx: jnp.sum(
+            fused_bn_apply_train(xx, g, b, EPS, True)[0]))(x)
+
+    assert len(captured) >= 10, len(captured)  # fwd 2in+3out, bwd 3in+3out
+    for bs, ashape in captured:
+        b0, b1 = bs[-2], bs[-1]
+        a0, a1 = ashape[-2], ashape[-1]
+        assert b1 == a1 or b1 % 128 == 0, (bs, ashape)
+        assert b0 % 8 == 0 and b1 % 128 == 0, (bs, ashape)
+
+
+def test_fba_rejects_sublane_untileable():
+    with pytest.raises(ValueError, match="rows%8"):
+        bn_fwd_apply(jnp.zeros((4, 128)), jnp.zeros(128), jnp.zeros(128),
+                     EPS)
+    with pytest.raises(ValueError, match="rows%16"):
+        bn_fwd_apply(jnp.zeros((8, 128), jnp.bfloat16), jnp.zeros(128),
+                     jnp.zeros(128), EPS)
+
+
+def test_fba_autotune_key_roundtrip(tmp_path, monkeypatch):
+    """The bn_fba decision resolves through the existing (op, shape,
+    dtype, device-kind) cache scheme: dry measure records the default,
+    cached replays it, and the relu facet keys separately."""
+    monkeypatch.setenv("BIGDL_TPU_AUTOTUNE_CACHE", str(tmp_path))
+    from bigdl_tpu import tuning
+
+    tuning.reset()
+    try:
+        assert tuning.fba_row_block(1024, 256, jnp.float32, True) is None
+        tuning.set_mode("measure")  # dry off-TPU
+        rb = tuning.fba_row_block(1024, 256, jnp.float32, True)
+        assert rb == 512
+        ann = tuning.annotation()
+        key = "bn_fba|channels=256|dtype=float32|relu=1|rows=1024"
+        assert key in ann["decisions"]
+        # a tuned divisor unlocks rows the 512 default cannot tile
+        assert tuning.fba_row_block(768, 128, jnp.float32, False) == 128
+        tuning.reset()
+        tuning.set_mode("cached")
+        assert tuning.fba_row_block(1024, 256, jnp.float32, True) == rb
+        # the kernel resolver consults the same decision
+        from bigdl_tpu.ops.bn_kernel import _resolve_fba_row_block
+        assert _resolve_fba_row_block(768, 128, False, jnp.float32) == 128
+        # ...and the kernel actually runs at the unlocked height
+        rs = np.random.RandomState(8)
+        x = jnp.asarray(rs.randn(768, 128), jnp.float32)
+        g = jnp.asarray(rs.rand(128) + 0.5, jnp.float32)
+        b = jnp.asarray(rs.randn(128), jnp.float32)
+        y, _, _ = fused_bn_apply_train(x, g, b, EPS, False)
+        yr, _, _ = _ref_bn(x, g, b, False)
+        np.testing.assert_allclose(np.asarray(y), np.asarray(yr),
+                                   atol=1e-4)
+    finally:
+        tuning.reset()
+
+
+def test_perf_run_stamps_bn_fused():
+    """--fusedBN provenance (ISSUE 2 satellite): perf JSON lines carry
+    bn_fused = off/stats/apply like the autotune decisions."""
+    from bigdl_tpu.cli import perf
+
+    out = perf.run("resnet20_cifar", 4, 1, "random", use_bf16=False,
+                   fused_bn="apply")
+    assert out["bn_fused"] == "apply"
+    out = perf.run("resnet20_cifar", 4, 1, "random", use_bf16=False)
+    assert out["bn_fused"] == "off"
+
+
+@pytest.mark.tpu
+def test_fba_compiled_on_tpu():
+    """Non-interpret (Mosaic-compiled) parity for the fused block — the
+    two-phase grid and the ``ri * ph`` output index map are exactly the
+    kind of structure interpret mode cannot vouch for."""
+    if jax.default_backend() != "tpu":
+        pytest.skip("needs a TPU backend (kernel runs interpret elsewhere)")
+    rs = np.random.RandomState(9)
+    x = jnp.asarray(rs.randn(4096, 256), jnp.bfloat16)
+    gamma = jnp.asarray(rs.rand(256) + 0.5, jnp.float32)
+    beta = jnp.asarray(rs.randn(256), jnp.float32)
+    for relu in (False, True):
+        y, mean, var = jax.jit(
+            lambda a, g, b, r=relu: fused_bn_apply_train(a, g, b, EPS, r)
+        )(x, gamma, beta)
+        yr, mr, vr = _ref_bn(x, gamma, beta, relu)
+        np.testing.assert_allclose(np.asarray(y, np.float32),
+                                   np.asarray(yr, np.float32), atol=5e-2)
+        np.testing.assert_allclose(np.asarray(mean), np.asarray(mr),
+                                   rtol=2e-2, atol=2e-2)
+        g = jax.jit(jax.grad(lambda a, r=relu: jnp.sum(jnp.square(
+            fused_bn_apply_train(a, gamma, beta, EPS, r)[0]
+            .astype(jnp.float32)))))(x)
+        gr = jax.grad(lambda a, r=relu: jnp.sum(jnp.square(
+            _ref_bn(a, gamma, beta, r)[0].astype(jnp.float32))))(x)
+        np.testing.assert_allclose(np.asarray(g, np.float32),
+                                   np.asarray(gr, np.float32),
+                                   rtol=5e-2, atol=2e-1)

@@ -151,6 +151,22 @@ def test_resnet_cli_cifar_fused_bn(tmp_path):
     assert trained is not None
 
 
+def test_resnet_cli_cifar_fused_bn_apply(tmp_path):
+    """--fusedBN apply (ISSUE 2): the FULL fused BN block (stats+apply+
+    absorbed-ReLU fwd, reductions+dx bwd) reachable end-to-end on the
+    real training CLI."""
+    from bigdl_tpu.cli import resnet
+
+    data = str(tmp_path / "cifar")
+    _write_cifar(data)
+    trained = resnet.main(["train", "-f", data, "-b", "8", "--maxEpoch",
+                           "1", "--depth", "8", "--fusedBN", "apply",
+                           "--logEvery", "100"])
+    assert trained is not None
+    from bigdl_tpu.nn.norm import bn_fused_mode
+    assert bn_fused_mode(trained.module) == "apply"
+
+
 def test_resnet_cli_imagenet_s2d(tmp_path):
     """--dataset imagenet --s2d: space-to-depth stem on the training CLI,
     one epoch over a tiny label-by-folder image tree."""

@@ -268,7 +268,11 @@ def test_resume_continues_iteration_and_epoch_numbering(tmp_path):
     opt2 = mk(Trigger.max_iteration(12))
     opt2.set_checkpoint(Trigger.every_epoch(), ck)
     opt2.resume(ck)
-    assert opt2._resume_driver == {"epoch": 3, "iteration": 8}
+    # epoch/iteration counters continue; the blob also carries the
+    # step-equivalence counters (rng_splits/epoch_records, ADVICE r5 #4)
+    assert opt2._resume_driver["epoch"] == 3
+    assert opt2._resume_driver["iteration"] == 8
+    assert opt2._resume_driver["epoch_records"] == 0  # epoch boundary
     opt2.optimize()
     assert os.path.exists(os.path.join(ck, "model.12"))
     assert not os.path.exists(os.path.join(ck, "model.4.1"))
