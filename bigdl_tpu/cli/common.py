@@ -6,6 +6,7 @@ reference's env-var / system-property / scopt triple, SURVEY.md §5
 from __future__ import annotations
 
 import argparse
+import json
 import logging
 import os
 from typing import Optional, Sequence
@@ -107,10 +108,25 @@ def apply_platform(args) -> None:
             tuning.set_mode(mode)
         except ValueError as e:
             raise SystemExit(str(e))
+    geom = getattr(args, "convGeom", None)
+    if geom:
+        # per-geometry decision file (apply_conv_probe.py --geom) — the
+        # stem's wgrad can run NCHW while the 3x3 stages stay NHWC and
+        # 1x1/s1 convs may run as GEMM; an explicit --convLayout below
+        # still wins at lookup time
+        from bigdl_tpu.ops.conv2d import install_geom_file
+        try:
+            n = install_geom_file(geom)
+        except (OSError, ValueError, json.JSONDecodeError) as e:
+            raise SystemExit(f"--convGeom {geom}: {e}")
+        logging.getLogger(__name__).info(
+            "installed %d per-geometry conv layout decisions from %s",
+            n, geom)
     spec = getattr(args, "convLayout", None)
     if spec:
         # explicit per-pass conv layouts (or 'auto'/'default') — wins
-        # over the measured-decision auto-install the Optimizer does
+        # over the measured-decision auto-install the Optimizer does,
+        # over --convGeom decisions and over the autotuner
         from bigdl_tpu.ops.conv2d import install_layout_spec
         try:
             install_layout_spec(spec)
@@ -142,13 +158,22 @@ def add_train_args(p: argparse.ArgumentParser) -> None:
                         "Single-device only")
     p.add_argument("--convLayout", default=None,
                    metavar="FWD,DGRAD,WGRAD",
-                   help="per-pass conv activation layouts (NHWC|NCHW "
-                        "each, or 'auto'/'default'). Unset = 'auto': "
-                        "the measured probe decision shipped for this "
-                        "device kind (ops/conv2d.MEASURED_DECISIONS, "
+                   help="per-pass conv activation layouts (NHWC|NCHW|"
+                        "GEMM each, or 'auto'/'default'; GEMM = "
+                        "dot_general for eligible 1x1/stride-1 convs, "
+                        "exact-parity fallback elsewhere). Unset = "
+                        "'auto': the measured probe decision shipped for "
+                        "this device kind (ops/conv2d.MEASURED_DECISIONS, "
                         "+1.1%% ResNet-50 train throughput on TPU v5 "
                         "lite), no-op on unmeasured devices; 'default' "
-                        "forces all-NHWC")
+                        "forces all-NHWC. Wins over --convGeom and the "
+                        "autotuner")
+    p.add_argument("--convGeom", default=None, metavar="FILE",
+                   help="per-conv-geometry layout decision JSON "
+                        "(scripts/apply_conv_probe.py --geom): decisions "
+                        "keyed by (kh, kw, stride, cin, cout, groups, "
+                        "dilation, dtype), each pass independently "
+                        "NHWC/NCHW/GEMM")
     p.add_argument("--model", default=None,
                    help="checkpoint dir to resume model from")
     p.add_argument("--overWriteCheckpoint", action="store_true")

@@ -179,13 +179,19 @@ def _record_batches(source: str, batch: int, n_threads: int = 0):
 
 
 def _annotate_conv_layouts(out: dict) -> None:
-    """Stamp the active non-default conv layout policy into a result dict
-    — shared by run() and run_time_to_acc() so their JSON provenance
-    cannot drift apart."""
-    from bigdl_tpu.ops.conv2d import conv_layouts_if_nondefault
+    """Stamp the active non-default conv layout policy — global triple
+    AND installed per-geometry decisions — into a result dict; shared by
+    run() and run_time_to_acc() so their JSON provenance cannot drift
+    apart. (Tuner-resolved per-geometry decisions additionally appear in
+    the autotune ledger under their ``conv_geom`` keys.)"""
+    from bigdl_tpu.ops.conv2d import (conv_layouts_if_nondefault,
+                                      geom_policy_if_any)
     cl = conv_layouts_if_nondefault()
     if cl:
         out["conv_layouts"] = cl
+    gp = geom_policy_if_any()
+    if gp:
+        out["conv_geom"] = gp
 
 
 def _annotate_autotune(out: dict) -> None:
@@ -728,13 +734,25 @@ def main(argv=None):
                    help="weight decay for --timeToAcc (reference CIFAR "
                         "recipe value 1e-4)")
     p.add_argument("--convLayout", default=None, metavar="FWD,DGRAD,WGRAD",
-                   help="per-pass conv activation layouts (NHWC|NCHW "
+                   help="per-pass conv activation layouts (NHWC|NCHW|GEMM "
                         "each, or 'auto'/'default') — e.g. a "
                         "scripts/conv_bwd_probe.py decision via "
-                        "scripts/apply_conv_probe.py. Unset = 'auto': "
-                        "the measured decision shipped for this device "
-                        "kind (ops/conv2d.MEASURED_DECISIONS), no-op on "
-                        "unmeasured devices; 'default' forces all-NHWC")
+                        "scripts/apply_conv_probe.py. GEMM runs eligible "
+                        "1x1/stride-1 convs as dot_general (exact-parity "
+                        "NHWC fallback elsewhere). Unset = 'auto': the "
+                        "measured decision shipped for this device kind "
+                        "(ops/conv2d.MEASURED_DECISIONS), no-op on "
+                        "unmeasured devices; 'default' forces all-NHWC. "
+                        "An explicit spec wins over --convGeom and the "
+                        "autotuner")
+    p.add_argument("--convGeom", default=None, metavar="FILE",
+                   help="per-conv-geometry layout decision JSON "
+                        "(scripts/apply_conv_probe.py --geom output): "
+                        "keys decisions by (kh, kw, stride, cin, cout, "
+                        "groups, dilation, dtype) so e.g. the stem's "
+                        "wgrad runs NCHW while 3x3 stages stay NHWC and "
+                        "1x1/s1 convs may run as GEMM; stamped as "
+                        "conv_geom in the result JSON")
     from bigdl_tpu.cli.common import (_add_platform_arg, add_autotune_arg,
                                       add_fused_bn_arg, apply_platform)
     _add_platform_arg(p)

@@ -98,10 +98,11 @@ class SpatialConvolution(SimpleModule):
         w = params["weight"].astype(x.dtype)
         from bigdl_tpu.ops import conv2d as _c2d
 
-        if not _c2d.is_default_policy():
-            # a conv_bwd_probe decision is installed: route through the
-            # per-pass-layout custom vjp (ops/conv2d.py) so each of
-            # fwd/dgrad/wgrad compiles under its probe-winning layout
+        if _c2d.policy_active():
+            # a layout decision can apply (probe/per-geometry/autotune):
+            # route through the per-pass-layout custom vjp (ops/conv2d.py)
+            # so each of fwd/dgrad/wgrad compiles under its winning
+            # layout — NHWC, NCHW, or dot_general (GEMM) for 1x1/s1 sites
             y = _c2d.conv2d(
                 x, w, (self.stride_h, self.stride_w),
                 ((self.pad_h, self.pad_h), (self.pad_w, self.pad_w)),
@@ -243,7 +244,7 @@ class SpatialDilatedConvolution(SpatialConvolution):
         w = params["weight"].astype(x.dtype)
         from bigdl_tpu.ops import conv2d as _c2d
 
-        if not _c2d.is_default_policy():
+        if _c2d.policy_active():
             y = _c2d.conv2d(
                 x, w, (self.stride_h, self.stride_w),
                 ((self.pad_h, self.pad_h), (self.pad_w, self.pad_w)),

@@ -13,15 +13,23 @@ from bigdl_tpu.ops.attention_kernel import (
 )
 from bigdl_tpu.ops.bn_kernel import bn_stats, bn_bwd_stats, fused_bn_train
 from bigdl_tpu.ops.conv2d import (MEASURED_DECISIONS, decide_from_probe,
-                                  get_conv_pass_layouts,
+                                  decide_geom_from_probe,
+                                  get_conv_pass_layouts, gemm_eligible,
+                                  geom_policy_if_any,
+                                  install_geom_decisions,
+                                  install_geom_file,
                                   install_layout_spec, maybe_install_auto,
-                                  policy_snapshot, resolve_layout_spec,
+                                  policy_active, policy_snapshot,
+                                  resolve_layout_spec,
                                   restore_policy, set_conv_pass_layouts)
 
 __all__ = ["flash_attention", "blockwise_attention",
            "bn_stats", "bn_bwd_stats", "fused_bn_train",
            "set_conv_pass_layouts", "get_conv_pass_layouts",
-           "decide_from_probe", "resolve_layout_spec",
+           "decide_from_probe", "decide_geom_from_probe",
+           "resolve_layout_spec",
            "install_layout_spec", "maybe_install_auto",
+           "install_geom_decisions", "install_geom_file",
+           "gemm_eligible", "geom_policy_if_any", "policy_active",
            "policy_snapshot", "restore_policy",
            "MEASURED_DECISIONS"]

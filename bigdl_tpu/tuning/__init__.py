@@ -7,17 +7,20 @@ sizes) and ops/bn_kernel.py (stats row block).
 """
 
 from bigdl_tpu.tuning.autotune import (MODES, annotation, bn_row_block,
+                                       conv_geom_key, conv_geom_layout,
                                        dry_run, fba_row_block, flash_blocks,
                                        get_cache, get_mode,
                                        install_conv_layouts,
-                                       make_key, reset, reset_decisions,
+                                       make_key, put_geom_decisions,
+                                       reset, reset_decisions,
                                        set_mode)
 from bigdl_tpu.tuning.cache import (CACHE_VERSION, AutotuneCache, cache_dir,
                                     cache_path, device_kind, device_slug)
 
 __all__ = ["MODES", "set_mode", "get_mode", "dry_run", "make_key",
            "flash_blocks", "bn_row_block", "fba_row_block",
-           "install_conv_layouts",
+           "install_conv_layouts", "conv_geom_key", "conv_geom_layout",
+           "put_geom_decisions",
            "annotation", "reset", "reset_decisions", "get_cache",
            "AutotuneCache", "CACHE_VERSION", "cache_dir", "cache_path",
            "device_kind", "device_slug"]

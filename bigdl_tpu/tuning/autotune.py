@@ -36,7 +36,8 @@ from bigdl_tpu.tuning.cache import AutotuneCache
 
 __all__ = ["MODES", "set_mode", "get_mode", "dry_run", "make_key",
            "flash_blocks", "bn_row_block", "fba_row_block",
-           "install_conv_layouts",
+           "install_conv_layouts", "conv_geom_layout", "conv_geom_key",
+           "put_geom_decisions",
            "annotation", "reset", "reset_decisions", "get_cache"]
 
 MODES = ("off", "cached", "measure")
@@ -54,6 +55,11 @@ FLASH_TILINGS = (128, 256, 512, 1024)
 BN_ROW_BLOCKS = (128, 256, 512, 1024, 2048)
 
 CONV_VARIANTS = ("plain", "inner", "s2d")
+
+# per-geometry conv layout candidates (ISSUE 3 tentpole): the two
+# activation layouts always, plus the dot_general spelling where the
+# geometry is exactly a matmul (1x1, stride 1, unpadded, ungrouped)
+CONV_GEOM_LAYOUTS = ("NHWC", "NCHW", "GEMM")
 
 
 def set_mode(mode: str) -> str:
@@ -256,6 +262,84 @@ def fba_row_block(rows: int, c: int, dtype,
 
     config, _ = _resolve(key, default, _measure)
     return int(config["row_block"])
+
+
+def conv_geom_key(pass_name: str, geom: tuple) -> str:
+    """Canonical ``conv_geom`` cache key for one (geometry, pass, dtype):
+    geom is ops.conv2d's 10-tuple (kh, kw, sh, sw, cin, cout, groups,
+    dh, dw, dtype)."""
+    kh, kw, sh, sw, cin, cout, groups, dh, dw, dtype = geom
+    return make_key("conv_geom", kh=kh, kw=kw, stride=f"{sh}x{sw}",
+                    cin=cin, cout=cout, groups=groups, dil=f"{dh}x{dw}",
+                    dtype=dtype, **{"pass": pass_name})
+
+
+def conv_geom_layout(pass_name: str, geom: tuple, x_shape: tuple,
+                     gemm_ok: bool) -> Optional[str]:
+    """Tuned layout for ONE conv geometry and pass (ISSUE 3 tentpole), or
+    None — the caller (ops/conv2d._pass_layout) then falls back to the
+    global triple. Unlike the other resolvers this one has no forced
+    default on a cached-mode miss: "no per-geometry decision" must mean
+    "use whatever the global policy says", not "pin NHWC".
+
+    measure mode on a chip times the pass for this exact geometry at the
+    traced activation shape ``x_shape`` (batch/spatial are not in the
+    key — the first traced shape of a geometry decides for all of them,
+    which is the right weighting since ResNet geometries recur at one
+    spatial size each); off-TPU the dry run records NHWC without timing
+    so the CPU pipeline round-trips deterministically."""
+    if _MODE == "off":
+        return None
+    key = conv_geom_key(pass_name, geom)
+    cache = get_cache()
+    ent = cache.get(key)
+    if ent is not None and not (_MODE == "measure"
+                                and ent.get("source") == "dry"
+                                and not dry_run()):
+        lay = (ent.get("config") or {}).get("layout")
+        if lay in CONV_GEOM_LAYOUTS and (lay != "GEMM" or gemm_ok):
+            _record(key, ent.get("config"), "cached")
+            return lay
+        # unusable entry (corrupt edit, or a GEMM decision for a site
+        # that can't run it): behave like a miss — cached mode falls back
+        # to the global triple, measure mode re-measures below
+    if _MODE == "cached":
+        _record(key, None, "default")
+        return None
+    if dry_run():
+        ent = {"config": {"layout": "NHWC"}, "source": "dry"}
+    else:
+        cands = [l for l in CONV_GEOM_LAYOUTS if l != "GEMM" or gemm_ok]
+        from bigdl_tpu.tuning.measure import measure_conv_geom
+        config, best_ms = measure_conv_geom(pass_name, geom, x_shape,
+                                            cands)
+        ent = {"config": dict(config), "source": "measured",
+               "best_ms": round(best_ms, 4)}
+    cache.put(key, ent)
+    cache.save()
+    _record(key, ent["config"], ent["source"])
+    return ent["config"]["layout"]
+
+
+def put_geom_decisions(decisions, cache=None) -> int:
+    """Write probe-derived per-geometry decisions (the
+    ``apply_conv_probe.py --geom`` JSON) into the autotune cache under
+    ``conv_geom`` keys with source "probe", so ``--autotune cached``
+    replays them with zero measurement. Returns the number of (geometry,
+    pass) entries written."""
+    from bigdl_tpu.ops.conv2d import geom_from_json
+    cache = cache or get_cache()
+    n = 0
+    for d in decisions:
+        geom = geom_from_json(d.get("geom", {}))
+        for p, lay in sorted((d.get("layouts") or {}).items()):
+            if lay not in CONV_GEOM_LAYOUTS:
+                raise ValueError(f"bad layout {lay!r} in decision {d!r}")
+            cache.put(conv_geom_key(p, geom),
+                      {"config": {"layout": lay}, "source": "probe"})
+            n += 1
+    cache.save()
+    return n
 
 
 def install_conv_layouts(variant: str = "plain", device=None

@@ -16,6 +16,14 @@ measured milliseconds (chip mode). Corrupt or version-mismatched files
 load as empty (the tuner then falls back to defaults) instead of raising:
 a half-written cache after a tunnel drop must never take down a training
 run.
+
+Namespaces in one file (the key's leading ``op`` token): ``flash`` /
+``bn_stats`` / ``bn_fba`` / ``conv_layouts`` (global per-variant triple)
+and, from round 8, ``conv_geom`` — per-conv-geometry layout decisions
+keyed by (kh, kw, stride, cin, cout, groups, dilation, dtype, pass),
+written by measure mode or imported from probe output with source
+``"probe"`` (tuning.put_geom_decisions). Entry sources: ``measured`` /
+``dry`` / ``probe``.
 """
 
 from __future__ import annotations

@@ -20,7 +20,7 @@ from typing import Dict, List, Sequence, Tuple
 
 __all__ = ["time_fn", "measure_flash_blocks", "measure_bn_row_block",
            "measure_fba_row_block", "measure_conv_layouts",
-           "CONV_PROBE_SHAPES"]
+           "measure_conv_geom", "CONV_PROBE_SHAPES"]
 
 _WARMUP = 1
 _ITERS = 3
@@ -151,6 +151,54 @@ CONV_PROBE_SHAPES: Tuple[Tuple[int, int, int, int, int, int, int, int], ...] = (
     (32, 14, 14, 256, 256, 3, 3, 1),
     (32, 7, 7, 512, 512, 3, 3, 1),
 )
+
+
+def measure_conv_geom(pass_name: str, geom: tuple, x_shape: tuple,
+                      candidates: Sequence[str]) -> Tuple[dict, float]:
+    """Time ONE conv pass of ONE geometry under each candidate layout
+    (NHWC/NCHW, plus GEMM where eligible) at the exact activation shape
+    the training trace presented — the per-geometry refinement of
+    :func:`measure_conv_layouts` (ISSUE 3). Returns ({"layout": best},
+    best_ms); candidate order is the deterministic CONV_GEOM_LAYOUTS
+    order, so exact ties re-pick identically."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from bigdl_tpu.ops.conv2d import _conv_in_layout
+
+    kh, kw, sh, sw, cin, cout, groups, dh, dw, dtype_name = geom
+    n, h, w_ = int(x_shape[0]), int(x_shape[1]), int(x_shape[2])
+    dtype = np.dtype(dtype_name)
+    kx, kw_ = jax.random.split(jax.random.PRNGKey(0))
+    x = jax.random.normal(kx, (n, h, w_, cin), dtype)
+    wgt = jax.random.normal(kw_, (kh, kw, cin // groups, cout), dtype)
+    # SAME-style symmetric padding approximates the training sites (the
+    # geometry key carries no padding; for the k=1 GEMM-eligible sites
+    # this is exactly zero padding)
+    pad = ((kh // 2, kh // 2), (kw // 2, kw // 2))
+
+    timed: List[Tuple[dict, float]] = []
+    for layout in candidates:
+        conv = functools.partial(
+            _conv_in_layout, stride=(sh, sw), padding=pad,
+            rhs_dilation=(dh, dw), groups=groups, layout=layout)
+        if pass_name == "fwd":
+            fn = jax.jit(lambda x_, w_c=wgt, c=conv: c(x_, w_c))
+            ms = time_fn(fn, x)
+        else:
+            dy = jnp.ones_like(conv(x, wgt))
+            if pass_name == "dgrad":
+                fn = jax.jit(lambda dy_, x_=x, w_c=wgt, c=conv:
+                             jax.linear_transpose(
+                                 lambda xx: c(xx, w_c), x_)(dy_)[0])
+            else:
+                fn = jax.jit(lambda dy_, x_=x, w_c=wgt, c=conv:
+                             jax.linear_transpose(
+                                 lambda ww: c(x_, ww), w_c)(dy_)[0])
+            ms = time_fn(fn, dy)
+        timed.append(({"layout": layout}, ms))
+    return _pick(timed)
 
 
 def measure_conv_layouts(dtype) -> Tuple[dict, float]:

@@ -17,6 +17,14 @@ denominator used for MFU. Control-flow bodies are recursed into
 (``scan`` multiplied by trip count, ``cond`` by the most expensive
 branch); ``remat`` bodies are counted once (algorithmic FLOPs, not
 executed FLOPs, per the usual MFU definition).
+
+GEMM-path accounting (ISSUE 3): when the per-geometry conv policy runs a
+1x1 stride-1 conv as ``dot_general`` over ``(N*H*W, Cin) x (Cin, Cout)``,
+the contraction is unchanged — ``2*N*H*W*Cin*Cout`` FLOPs either way —
+so the analytic numerator is invariant under the layout/GEMM choice; the
+two primitive rules above agree by construction, and
+:func:`conv_unit_flops` is the closed-form spelling shared by the probe
+and roofline scripts so every TF/s figure in PERF.md uses one numerator.
 """
 
 from __future__ import annotations
@@ -126,6 +134,15 @@ def jaxpr_flops(jaxpr) -> float:
         for sub in _sub_jaxprs(eqn.params):
             total += mult * jaxpr_flops(sub)
     return total
+
+
+def conv_unit_flops(n: int, h_out: int, w_out: int, cin: int, cout: int,
+                    kh: int, kw: int, groups: int = 1) -> float:
+    """Closed-form 2·MAC FLOPs of ONE conv pass (fwd == dgrad == wgrad:
+    transposes of the same linear map have identical nnz). The 1x1 GEMM
+    spelling computes the identical contraction, so this is also its
+    dot_general count — one numerator for probe/roofline TF/s."""
+    return 2.0 * n * h_out * w_out * cout * (cin / max(1, groups)) * kh * kw
 
 
 def fn_flops(fn, *args, **kwargs) -> float:

@@ -1,28 +1,77 @@
-"""Turn conv_bwd_probe output into a conv layout decision.
+"""Turn conv_bwd_probe output into conv layout decisions.
 
-Reads probe JSONL rows (file args or stdin), aggregates per-pass totals
-via ops.conv2d, prints the winning ``FWD,DGRAD,WGRAD`` string on stdout
-(consumable by ``perf --convLayout $(...)``) and the per-pass totals on
+Default mode (back-compat): aggregates per-pass totals via ops.conv2d,
+prints the winning global ``FWD,DGRAD,WGRAD`` string on stdout
+(consumable by ``perf --convLayout $(...)``) and per-pass totals on
 stderr.
+
+``--geom`` (ISSUE 3): emits PER-GEOMETRY decisions instead — one entry
+per (kh, kw, stride, cin, cout, groups, dilation, dtype), each pass
+independently NHWC/NCHW/GEMM — as deterministic JSON on stdout,
+consumable by ``perf --convGeom FILE`` and by
+``ops.conv2d.install_geom_decisions``. Rows from probes predating the
+geometry fields are mapped through ``ops.conv2d.LEGACY_PROBE_SHAPES``.
+
+``--cache`` additionally writes the per-geometry decisions into the
+autotune cache's ``conv_geom`` namespace (source "probe") for the
+current device kind, so ``--autotune cached`` replays them with zero
+measurement cost on every later run.
 
 Usage:
     python scripts/conv_bwd_probe.py 30 | tee /tmp/probe.jsonl
+    # global triple (historical):
     python -m bigdl_tpu.cli.perf -m resnet50 -b 128 -i 20 \
         --convLayout "$(python scripts/apply_conv_probe.py /tmp/probe.jsonl)"
+    # per-geometry decisions:
+    python scripts/apply_conv_probe.py --geom /tmp/probe.jsonl > geom.json
+    python -m bigdl_tpu.cli.perf -m resnet50 -b 128 -i 20 --convGeom geom.json
+    # persist into the autotune cache for --autotune cached replay:
+    python scripts/apply_conv_probe.py --geom --cache /tmp/probe.jsonl
 """
 
+import argparse
 import fileinput
+import json
 import os
 import sys
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 from bigdl_tpu.ops.conv2d import (_PASSES, decide_from_probe,  # noqa: E402
-                                  probe_totals)
+                                  decide_geom_from_probe, probe_totals)
 
 
-def main():
-    lines = list(fileinput.input())
+def main(argv=None):
+    ap = argparse.ArgumentParser("apply conv probe")
+    ap.add_argument("--geom", action="store_true",
+                    help="emit per-geometry decision JSON instead of the "
+                         "global FWD,DGRAD,WGRAD triple")
+    ap.add_argument("--cache", action="store_true",
+                    help="also persist the per-geometry decisions into "
+                         "the autotune cache (conv_geom namespace, "
+                         "source 'probe') for --autotune cached replay")
+    ap.add_argument("files", nargs="*",
+                    help="probe JSONL files (stdin when omitted)")
+    args = ap.parse_args(argv)
+
+    lines = list(fileinput.input(args.files))
+    if args.geom or args.cache:
+        decisions = decide_geom_from_probe(lines)
+        for d in decisions:
+            g = d["geom"]
+            print(f"{g['kh']}x{g['kw']}/s{g['stride'][0]} "
+                  f"{g['cin']}->{g['cout']} {g['dtype']}: "
+                  f"{d['layouts']}", file=sys.stderr)
+        if args.cache:
+            from bigdl_tpu import tuning
+            n = tuning.put_geom_decisions(decisions)
+            print(f"wrote {n} conv_geom entries to "
+                  f"{tuning.get_cache().path}", file=sys.stderr)
+        json.dump({"decisions": decisions}, sys.stdout, indent=1,
+                  sort_keys=True)
+        sys.stdout.write("\n")
+        return
+
     totals = probe_totals(lines)
     decision = decide_from_probe(lines)
     for p in _PASSES:

@@ -3,10 +3,17 @@ vs the forward's 46% — this isolates WHERE).
 
 For each representative ResNet-50 conv shape, times the three conv passes
 separately (forward, input-grad, filter-grad) in bf16, for both NHWC and
-NCHW activation layouts. XLA picks internal layouts per op; what the
-framework controls is the activation layout it hands XLA — if NCHW wins
-the backward for some shape class, a layout-swapped backward (transpose
-in, transpose out, fused by XLA into neighbors) is the lever.
+NCHW activation layouts — and, where the shape is exactly a matmul (1x1,
+stride 1), for the GEMM spelling (``dot_general`` over flattened pixels,
+the ops/conv2d.py round-8 layout choice). XLA picks internal layouts per
+op; what the framework controls is the activation layout (or the matmul
+spelling) it hands XLA — if NCHW or GEMM wins some pass for some shape
+class, the per-geometry policy (ops/conv2d.py, ISSUE 3) is the lever.
+
+Every row carries its geometry fields (kh/kw/stride/cin/cout/groups/
+dilation/dtype), so ``scripts/apply_conv_probe.py --geom`` can turn the
+JSONL directly into per-geometry decisions; rows from older probes
+(name-only) are mapped through ops/conv2d.LEGACY_PROBE_SHAPES.
 
 Usage: python scripts/conv_bwd_probe.py [iters]   # one JSON line per cell
 """
@@ -23,6 +30,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from bigdl_tpu.utils.flops import conv_unit_flops  # noqa: E402
+
 # (name, batch, h, w, cin, cout, k, stride)
 SHAPES = [
     ("stem7x7s2", 128, 224, 224, 3, 64, 7, 2),
@@ -31,6 +40,10 @@ SHAPES = [
     ("s3_3x3", 128, 14, 14, 256, 256, 3, 1),
     ("s4_3x3", 128, 7, 7, 512, 512, 3, 1),
     ("s2_1x1", 128, 28, 28, 512, 128, 1, 1),
+    # the two remaining 1x1 families (~half of ResNet-50's FLOPs are
+    # 1x1 GEMMs): bottleneck expand and reduce at stage-1 width
+    ("s1_1x1_expand", 128, 56, 56, 64, 256, 1, 1),
+    ("s1_1x1_reduce", 128, 56, 56, 256, 64, 1, 1),
 ]
 
 _DIMSPEC = {"NHWC": ("NHWC", "HWIO", "NHWC"),
@@ -38,6 +51,13 @@ _DIMSPEC = {"NHWC": ("NHWC", "HWIO", "NHWC"),
 
 
 def _conv(x, w, stride, layout):
+    if layout == "GEMM":
+        # 1x1/s1 only: the conv IS a matmul over flattened pixels
+        b, h, w_, cin = x.shape
+        cout = w.shape[-1]
+        y = lax.dot_general(x.reshape(b * h * w_, cin),
+                            w.reshape(cin, cout), (((1,), (0,)), ((), ())))
+        return y.reshape(b, h, w_, cout)
     k = w.shape[0] if layout == "NHWC" else w.shape[2]
     pad = (k - 1) // 2
     # bf16 in/out (MXU accumulates f32 internally); an explicit f32
@@ -85,15 +105,19 @@ def _time(fn, args, iters):
 def probe(iters: int = 30):
     dev = jax.devices()[0]
     for name, b, h, w_, cin, cout, k, stride in SHAPES:
-        flops = 2.0 * b * (h // stride) * (w_ // stride) * cin * cout * k * k
+        flops = conv_unit_flops(b, h // stride, w_ // stride, cin, cout,
+                                k, k)
         rs = np.random.RandomState(0)
-        for layout in ("NHWC", "NCHW"):
-            if layout == "NHWC":
-                x = jnp.asarray(rs.randn(b, h, w_, cin), jnp.bfloat16)
-                kern = jnp.asarray(rs.randn(k, k, cin, cout), jnp.bfloat16)
-            else:
+        layouts = ["NHWC", "NCHW"]
+        if k == 1 and stride == 1:
+            layouts.append("GEMM")  # matmul spelling of the same conv
+        for layout in layouts:
+            if layout == "NCHW":
                 x = jnp.asarray(rs.randn(b, cin, h, w_), jnp.bfloat16)
                 kern = jnp.asarray(rs.randn(cout, cin, k, k), jnp.bfloat16)
+            else:  # NHWC and GEMM share the NHWC operand layout
+                x = jnp.asarray(rs.randn(b, h, w_, cin), jnp.bfloat16)
+                kern = jnp.asarray(rs.randn(k, k, cin, cout), jnp.bfloat16)
 
             fwd = jax.jit(lambda a, c: _conv(a, c, stride, layout))
             loss = lambda a, c: jnp.sum(
@@ -103,6 +127,11 @@ def probe(iters: int = 30):
 
             row = {"shape": name, "layout": layout,
                    "gflops": round(flops / 1e9, 1),
+                   # geometry fields: apply_conv_probe.py --geom turns
+                   # rows into per-geometry decisions (ops/conv2d.py)
+                   "kh": k, "kw": k, "stride": [stride, stride],
+                   "cin": cin, "cout": cout, "groups": 1,
+                   "dilation": [1, 1], "dtype": "bfloat16",
                    "device": dev.device_kind}
             for pname, fn in (("fwd", fwd), ("dgrad", dgrad),
                               ("wgrad", wgrad)):

@@ -1,0 +1,69 @@
+#!/usr/bin/env bash
+# Round-8 capture: ISSUE 3 (per-conv-geometry layout policy + 1x1-as-GEMM)
+# chip evidence. Core contract: the ResNet-50 b128 tuned-vs-global A/B —
+# per-geometry decisions (stem wgrad NCHW, 3x3 stages NHWC, 1x1/s1 convs
+# optionally GEMM; ops/conv2d.py + tuning conv_geom namespace) against
+# the single global triple that round 5 shipped — plus the per-op
+# backward roofline capture (xplane profile joined against same-shape
+# isolated microbenches, scripts/backward_roofline.py -> PERF.md §11).
+# resnet50_pipe is gone from the sweep (VERDICT r5 weak #5) — its ~32 s
+# funds the A/B legs here. Appends to $OUT, mirrored into the repo per
+# step.
+
+set -uo pipefail
+cd "$(dirname "$0")/.."
+OUT="${OUT:-/tmp/tpu_capture_r08.log}"
+REPO_LOG="${REPO_LOG:-TPU_CAPTURE_r08.log}"
+trap 'cp -f "$OUT" "$REPO_LOG" 2>/dev/null || true' EXIT
+
+step() {
+  local name="$1" tmo="$2"; shift 2
+  echo "=== $name ($(date -u +%H:%M:%SZ))" | tee -a "$OUT"
+  timeout "$tmo" "$@" 2>&1 | tail -30 | tee -a "$OUT"
+  echo "=== end $name rc=$?" | tee -a "$OUT"
+  cp -f "$OUT" "$REPO_LOG" 2>/dev/null || true
+}
+
+# 1. compiled-path tests incl. the per-geometry/GEMM conv smoke
+step "pytest_tpu_marked" 1200 env BIGDL_TPU_TESTS=1 python -m pytest tests/ -m tpu -q
+
+# 2. the per-shape probe, now with geometry fields + the GEMM leg on the
+#    1x1/s1 shapes (~half of ResNet-50's FLOPs are GEMMs in conv
+#    clothes) — the decision source AND the roofline microbench side
+step "conv_probe_geom" 1200 sh -c 'python scripts/conv_bwd_probe.py 30 | tee /tmp/conv_probe_r08.jsonl; cp -f /tmp/conv_probe_r08.jsonl CONV_PROBE_r08.jsonl'
+
+# 3. probe -> per-geometry decisions: JSON for --convGeom AND persisted
+#    into the autotune conv_geom namespace for --autotune cached replay
+step "apply_probe_geom" 120 sh -c 'python scripts/apply_conv_probe.py --geom --cache /tmp/conv_probe_r08.jsonl | tee /tmp/conv_geom_r08.json; cp -f /tmp/conv_geom_r08.json CONV_GEOM_r08.json'
+
+# 4. THE A/B contract — resnet50 b128, same window:
+#    (a) global policy baseline (the round-5 shipped decision),
+#    (b) per-geometry decisions from the probe (--convGeom),
+#    (c) cached autotune replay (conv_geom namespace; also re-tunes
+#        flash/BN keys it already holds),
+#    (d) the explicit all-GEMM-wgrad spelling as a single-lever probe.
+step "perf_resnet50_b128_global" 900 python -m bigdl_tpu.cli.perf -m resnet50 -b 128 -i 20 --dataType random
+step "perf_resnet50_b128_geom" 900 python -m bigdl_tpu.cli.perf -m resnet50 -b 128 -i 20 --dataType random --convGeom /tmp/conv_geom_r08.json
+step "perf_resnet50_b128_geom_cached" 900 python -m bigdl_tpu.cli.perf -m resnet50 -b 128 -i 20 --dataType random --autotune cached
+step "perf_resnet50_b128_gemm_wgrad" 900 python -m bigdl_tpu.cli.perf -m resnet50 -b 128 -i 20 --dataType random --convLayout NHWC,NHWC,GEMM
+
+# 5. measure-mode autotune now resolves per-geometry conv_geom keys live
+#    at trace time (plus the flash/BN keys) — the fully-automatic leg
+step "perf_resnet50_b128_geom_measure" 1800 python -m bigdl_tpu.cli.perf -m resnet50 -b 128 -i 20 --dataType random --autotune measure
+
+# 6. per-geometry composed with the best single lever (innerSteps=10):
+#    the §8.2 lesson — levers interact, measure the composition
+step "perf_resnet50_geom_inner10" 900 python -m bigdl_tpu.cli.perf -m resnet50 -b 128 -i 4 --innerSteps 10 --dataType random --convGeom /tmp/conv_geom_r08.json
+
+# 7. ROOFLINE capture: xplane trace of the tuned b128 run, joined against
+#    the same-window isolated microbenches -> the PERF.md §11 table
+step "perf_profile_roofline" 900 python -m bigdl_tpu.cli.perf -m resnet50 -b 128 -i 5 --dataType random --convGeom /tmp/conv_geom_r08.json --profile /tmp/xprof_r08
+step "roofline_join" 300 sh -c 'python scripts/backward_roofline.py --probe /tmp/conv_probe_r08.jsonl --profile /tmp/xprof_r08 --steps 5 --top 12 --out ROOFLINE_r08.md --json ROOFLINE_r08.json; cat ROOFLINE_r08.md'
+
+# 8. the populated cache is part of the evidence — archive it
+step "autotune_cache_dump" 60 sh -c 'for f in ~/.cache/bigdl_tpu/autotune/*.json; do echo "--- $f"; cat "$f"; done'
+
+# 9. full bench line: resnet50_geom companion (cached replay) rides next
+#    to resnet50_tuned and the headline; hard-grade TTA curve included;
+#    pipe row gone
+step "bench_headline" 5400 env BENCH_TPU_TIMEOUT=2000 python bench.py resnet50 128 20
