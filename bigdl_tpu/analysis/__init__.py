@@ -1,0 +1,225 @@
+"""tpulint — trace-time static analysis for TPU perf/correctness
+anti-patterns (ISSUE 4 tentpole).
+
+BigDL's operability came from catching config mistakes at submit time,
+before a cluster burned hours (PAPER §BigDL). The TPU analogue: trace a
+model's full train step with ``jax.make_jaxpr`` under **abstract**
+inputs (no compilation, no device, seconds on CPU), walk every nested
+pjit/custom_vjp/pallas_call sub-jaxpr, and evaluate a rule registry over
+the jaxpr plus the kernel/block/layout metadata PRs 1–3 already record.
+The same pass is the CI gate that keeps those PRs' wins from regressing.
+
+Public surface:
+
+* :func:`lint_fn` — lint any callable (traced with the given abstract
+  args); jaxpr rules only.
+* :func:`lint_perf_model` — lint a perf-zoo model end-to-end: builds the
+  model (LMs get the flash kernel forced on so the TPU-projected trace
+  is analyzed even off-chip), constructs the donated SGD train step the
+  perf harness runs, traces it abstractly, and evaluates jaxpr + module
+  rules. The ``bigdl-tpu lint`` CLI and the perf ``--lint`` pre-flight
+  call this.
+* :func:`preflight_optimizer` — lint a built
+  :class:`~bigdl_tpu.optim.Optimizer` before ``optimize()`` (the
+  training CLIs' ``--lint`` flag): module rules always; the real
+  ``_build_step`` product is traced when the dataset exposes its batch
+  geometry without consuming the shuffle stream.
+
+Findings: :class:`~bigdl_tpu.analysis.report.Finding` /
+:class:`~bigdl_tpu.analysis.report.Report`; the rule catalog with
+severities lives in :data:`bigdl_tpu.analysis.rules.CATALOG`
+(documented in PERF.md §12).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from bigdl_tpu.analysis.report import Finding, Report, SEVERITIES
+from bigdl_tpu.analysis.rules import (CATALOG, assert_blocks_tileable,
+                                      check_block_padding,
+                                      check_block_tiling, min_sublane,
+                                      run_jaxpr_rules, run_module_rules)
+
+__all__ = ["Finding", "Report", "SEVERITIES", "CATALOG",
+           "check_block_tiling", "check_block_padding",
+           "assert_blocks_tileable", "min_sublane",
+           "run_jaxpr_rules", "run_module_rules",
+           "lint_fn", "trace_train_step", "lint_perf_model",
+           "preflight_optimizer"]
+
+
+def lint_fn(fn, *args, report: Optional[Report] = None, **kwargs) -> Report:
+    """Trace ``fn(*args, **kwargs)`` abstractly (args may be arrays or
+    ``jax.ShapeDtypeStruct``) and run every jaxpr rule. Pass an already-
+    jitted ``fn`` to get donation analysis of its pjit boundary."""
+    import jax
+
+    closed = jax.make_jaxpr(fn)(*args, **kwargs)
+    return run_jaxpr_rules(closed, report)
+
+
+def trace_train_step(model, in_shape, batch, *, dtype=None, is_lm=False,
+                     vocab: int = 32000, donate=(0, 1, 2)):
+    """ClosedJaxpr of the canonical SGD train step over ``model`` at
+    ``batch`` x ``in_shape`` — the same step shape the perf harness
+    compiles (donated (params, mod_state, opt_state), bf16 activations
+    by default, fp32 loss). Everything abstract: params/opt-state come
+    from ``jax.eval_shape``, inputs are ShapeDtypeStructs; nothing is
+    allocated or executed."""
+    import jax
+    import jax.numpy as jnp
+
+    from bigdl_tpu import nn
+    from bigdl_tpu.optim import SGD
+
+    dtype = jnp.bfloat16 if dtype is None else dtype
+    crit = (nn.TimeDistributedCriterion(nn.ClassNLLCriterion()) if is_lm
+            else nn.ClassNLLCriterion())
+    opt = SGD(learning_rate=0.01, momentum=0.9)
+
+    if is_lm:
+        if dtype == jnp.bfloat16:
+            model.compute_dtype = dtype  # cast lives after the embedding
+        x = jax.ShapeDtypeStruct((batch, *in_shape), jnp.int32)
+        y = jax.ShapeDtypeStruct((batch, *in_shape), jnp.int32)
+    else:
+        x = jax.ShapeDtypeStruct((batch, *in_shape), jnp.float32)
+        y = jax.ShapeDtypeStruct((batch,), jnp.int32)
+
+    key = jax.random.PRNGKey(0)
+    params = jax.eval_shape(model.init, key)
+    mod_state = model.init_state()
+    opt_state = jax.eval_shape(opt.init, params)
+
+    def train_step(params, mod_state, opt_state, x, y, rng):
+        def loss_fn(p):
+            xc = (x.astype(dtype)
+                  if jnp.issubdtype(x.dtype, jnp.floating) else x)
+            out, ms = model.apply(p, mod_state, xc, training=True, rng=rng)
+            return crit(out.astype(jnp.float32), y), ms
+
+        (loss, ms), grads = jax.value_and_grad(loss_fn,
+                                               has_aux=True)(params)
+        new_p, new_o = opt.update(grads, opt_state, params)
+        return new_p, ms, new_o, loss
+
+    step = (jax.jit(train_step, donate_argnums=donate) if donate
+            else jax.jit(train_step))
+    return jax.make_jaxpr(step)(params, mod_state, opt_state, x, y, key)
+
+
+def _bn_fallback_rule(model, closed, report: Report) -> None:
+    """Model+jaxpr combo rule: fused BN was requested, eligible sites
+    exist, but fewer forward kernels were traced than sites — some (or
+    all) silently fell back to the jnp path (rows untileable at this
+    batch)."""
+    from bigdl_tpu.analysis.jaxpr_walk import (iter_levels,
+                                               pallas_kernel_name)
+    from bigdl_tpu.nn.norm import BatchNormalization
+
+    sites = [m for m in model.modules()
+             if isinstance(m, BatchNormalization) and m.fused
+             and m.affine and m.axis_name is None and not m.stat_sample
+             and int(m.n_output) % 128 == 0]
+    if not sites:
+        return
+    fwd_names = {"_fba_fwd_kernel", "_stats_kernel"}
+    traced = 0
+    for lv in iter_levels(closed):
+        for eqn in lv.jaxpr.eqns:
+            if eqn.primitive.name == "pallas_call" \
+                    and pallas_kernel_name(eqn) in fwd_names:
+                traced += 1
+    if traced < len(sites):
+        report.add(Finding(
+            rule="tile-bn-fallback", family="tiling",
+            severity="warning",
+            message=(f"fused BN requested on {len(sites)} eligible "
+                     f"site(s) but only {traced} fused stats/apply "
+                     "kernel(s) traced — the rest fell back to the jnp "
+                     "path (rows % row-block != 0 at this batch)"),
+            hint="--autotune measure can unlock smaller legal row "
+                 "blocks; or pick a batch whose rows tile",
+            detail={"eligible_sites": len(sites),
+                    "traced_kernels": traced}))
+
+
+def lint_perf_model(name: str, batch: int = 32, *, seq_len=None,
+                    dtype=None, fused_bn=None, classes: int = 1000,
+                    trace: bool = True) -> Report:
+    """Full lint of one perf-zoo model (see module docstring). LMs are
+    built with ``attn_impl='flash'`` forced so the TPU-projected kernels
+    appear in the CPU trace; ``trace=False`` skips the jaxpr pass
+    (module rules only — used when only configuration is in question)."""
+    import jax.numpy as jnp
+
+    from bigdl_tpu.cli.common import apply_fused_bn
+    from bigdl_tpu.cli.perf import build_model
+
+    dtype = jnp.bfloat16 if dtype is None else dtype
+    model, in_shape = build_model(name, class_num=classes,
+                                  seq_len=seq_len, lm_attn_impl="flash")
+    apply_fused_bn(model, fused_bn)
+    is_lm = name.startswith("transformer_lm")
+    seq = in_shape[0] if is_lm else None
+
+    report = Report()
+    dtname = jnp.dtype(dtype).name
+    run_module_rules(model, report, seq=seq, dtype=dtname)
+    if trace:
+        closed = trace_train_step(model, in_shape, batch, dtype=dtype,
+                                  is_lm=is_lm)
+        run_jaxpr_rules(closed, report)
+        _bn_fallback_rule(model, closed, report)
+    return report
+
+
+def preflight_optimizer(opt) -> Report:
+    """Lint a built Optimizer before it trains (the training CLIs'
+    ``--lint`` pre-flight). Module rules always run; the jaxpr pass runs
+    when the step can be traced without side effects: single-device
+    strategy and a dataset exposing ``features``/``labels``/
+    ``batch_size`` (reading them, unlike pulling a batch, does not
+    advance the shuffle RNG that step-equivalent resume depends on)."""
+    import numpy as np
+
+    report = Report()
+    dtname = ("bfloat16" if getattr(opt, "compute_dtype", None) is not None
+              else "float32")
+    run_module_rules(opt.model, report, dtype=dtname)
+
+    ds = opt.dataset
+    feats = getattr(ds, "features", None)
+    labs = getattr(ds, "labels", None)
+    bs = getattr(ds, "batch_size", None)
+    if opt.strategy is not None or feats is None or labs is None or not bs:
+        return report
+    try:
+        import jax
+
+        from bigdl_tpu.ops.conv2d import policy_snapshot, restore_policy
+
+        x = jax.ShapeDtypeStruct((int(bs),) + tuple(feats.shape[1:]),
+                                 np.asarray(feats).dtype)
+        y = jax.ShapeDtypeStruct((int(bs),) + tuple(labs.shape[1:]),
+                                 np.asarray(labs).dtype)
+        snap = policy_snapshot()
+        try:
+            step, _ = opt._build_step()
+            key = jax.random.PRNGKey(0)
+            params = jax.eval_shape(opt.model.init, key)
+            mod_state = opt.model.init_state()
+            opt_state = jax.eval_shape(opt.optim_method.init, params)
+            closed = jax.make_jaxpr(step)(params, mod_state, opt_state,
+                                          x, y, key)
+        finally:
+            restore_policy(snap)
+        run_jaxpr_rules(closed, report)
+        _bn_fallback_rule(opt.model, closed, report)
+    except Exception as e:  # surface, never block training on lint bugs
+        report.add(Finding(
+            rule="lint-trace-error", family="meta", severity="info",
+            message=f"step trace skipped ({type(e).__name__}: {e})",
+            hint="module-level rules still ran"))
+    return report

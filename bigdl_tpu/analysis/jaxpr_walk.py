@@ -1,0 +1,162 @@
+"""Jaxpr traversal for the tpulint pass — provenance-preserving iteration
+over a ClosedJaxpr including every nested sub-jaxpr (``pjit`` bodies,
+``custom_vjp``/``custom_jvp`` rules, scan/while/cond branches, and
+``pallas_call`` kernel bodies).
+
+Unlike ``utils/flops.py`` (which only needs a FLOP sum), rules need to
+know *where* an equation lives — so each visited jaxpr level carries a
+path string like ``pjit:train_step/custom_vjp_call_jaxpr/pallas_call:
+_fba_fwd_kernel`` — and *who consumes* each value, so the dtype rules can
+tell a stats-reduction upcast from an fp32-softmax one. Everything here
+is read-only over trace-time metadata: no compilation, no execution, no
+device needed.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+from jax.extend import core as jex_core
+
+__all__ = ["JaxprLevel", "iter_levels", "eqn_label", "consumers_map",
+           "pallas_block_views", "pallas_scratch_avals",
+           "pallas_kernel_name", "aval_bytes"]
+
+
+def aval_bytes(aval) -> int:
+    """Abstract byte size of one value (0 when shape/dtype are absent,
+    e.g. tokens of an opaque effect)."""
+    shape = getattr(aval, "shape", None)
+    dtype = getattr(aval, "dtype", None)
+    if shape is None or dtype is None:
+        return 0
+    n = 1
+    for d in shape:
+        try:
+            n *= int(d)
+        except TypeError:  # symbolic/polymorphic dim
+            return 0
+    return n * np.dtype(dtype).itemsize
+
+
+def eqn_label(eqn) -> str:
+    """Short label for one equation: primitive plus its best name hint
+    (pjit ``name``, pallas kernel name) when one exists."""
+    name = eqn.params.get("name") if eqn.params else None
+    if name is None and eqn.primitive.name == "pallas_call":
+        name = pallas_kernel_name(eqn)
+    return (f"{eqn.primitive.name}:{name}" if name
+            else eqn.primitive.name)
+
+
+def pallas_kernel_name(eqn) -> Optional[str]:
+    """Kernel function name of a ``pallas_call`` eqn (from
+    ``name_and_src_info``), or None."""
+    nsi = eqn.params.get("name_and_src_info")
+    name = getattr(nsi, "name", None)
+    if name:
+        return str(name)
+    if nsi is not None:  # str form is "name at file:line"
+        return str(nsi).split(" ")[0] or None
+    return None
+
+
+def _sub_jaxprs(eqn) -> Iterator[Tuple[object, str]]:
+    """(jaxpr, label) pairs for every sub-jaxpr carried in one eqn's
+    params — the recursion edge of the walk."""
+    label = eqn_label(eqn)
+    for v in eqn.params.values():
+        vs = v if isinstance(v, (tuple, list)) else [v]
+        for w in vs:
+            if isinstance(w, jex_core.ClosedJaxpr):
+                yield w.jaxpr, label
+            elif isinstance(w, jex_core.Jaxpr):
+                yield w, label
+
+
+@dataclass
+class JaxprLevel:
+    """One jaxpr in the nesting tree: the jaxpr itself, the ``/``-joined
+    path of enclosing eqn labels (empty for the top level), and depth."""
+    jaxpr: object
+    path: str
+    depth: int
+
+    def where(self, i: int, eqn) -> str:
+        """Provenance string for eqn ``i`` of this level."""
+        base = f"{self.path}/" if self.path else ""
+        return f"{base}{eqn_label(eqn)}#{i}"
+
+
+def iter_levels(jaxpr, path: str = "", depth: int = 0,
+                max_depth: int = 24) -> Iterator[JaxprLevel]:
+    """Yield every jaxpr level (pre-order), starting at ``jaxpr`` itself.
+    Accepts a ClosedJaxpr or Jaxpr. ``max_depth`` guards against
+    pathological nesting."""
+    if isinstance(jaxpr, jex_core.ClosedJaxpr):
+        jaxpr = jaxpr.jaxpr
+    yield JaxprLevel(jaxpr, path, depth)
+    if depth >= max_depth:
+        return
+    for i, eqn in enumerate(jaxpr.eqns):
+        for sub, label in _sub_jaxprs(eqn):
+            sub_path = f"{path}/{label}#{i}" if path else f"{label}#{i}"
+            yield from iter_levels(sub, sub_path, depth + 1, max_depth)
+
+
+def consumers_map(jaxpr) -> Dict[object, List[object]]:
+    """var -> [consumer eqns] within ONE jaxpr level (no recursion —
+    cross-level dataflow goes through sub-jaxpr invars, which the nested
+    level's own map sees)."""
+    out: Dict[object, List[object]] = {}
+    for eqn in jaxpr.eqns:
+        for v in eqn.invars:
+            if isinstance(v, jex_core.Literal):
+                continue
+            out.setdefault(v, []).append(eqn)
+    return out
+
+
+# ------------------------------------------------------------------ pallas
+def pallas_block_views(eqn) -> List[Tuple[Tuple, Tuple, object, bool]]:
+    """(block_shape, array_shape, dtype, is_output) for every block
+    mapping of a ``pallas_call`` eqn — the raw material of the tiling,
+    padding and VMEM rules. Best-effort across jax versions: mappings
+    without the expected fields are skipped rather than crashed on."""
+    gm = eqn.params.get("grid_mapping")
+    bms = getattr(gm, "block_mappings", None) or ()
+    n_in = getattr(gm, "num_inputs", None)
+    views = []
+    for idx, bm in enumerate(bms):
+        bs = getattr(bm, "block_shape", None)
+        sds = getattr(bm, "array_shape_dtype", None)
+        if bs is None or sds is None:
+            continue
+        is_out = n_in is not None and idx >= n_in
+        views.append((tuple(bs), tuple(sds.shape),
+                      np.dtype(sds.dtype), is_out))
+    return views
+
+
+def pallas_scratch_avals(eqn) -> List[object]:
+    """Avals of the kernel's scratch operands (the VMEM accumulators) —
+    the tail invars of the kernel jaxpr, per ``num_scratch_operands``."""
+    gm = eqn.params.get("grid_mapping")
+    n = int(getattr(gm, "num_scratch_operands", 0) or 0)
+    if n <= 0:
+        return []
+    kj = eqn.params.get("jaxpr")
+    if isinstance(kj, jex_core.ClosedJaxpr):
+        kj = kj.jaxpr
+    invars = getattr(kj, "invars", None)
+    if not invars:
+        return []
+    out = []
+    for v in invars[-n:]:
+        aval = getattr(v, "aval", None)
+        inner = getattr(aval, "inner_aval", aval)  # Ref wraps the array
+        if inner is not None:
+            out.append(inner)
+    return out

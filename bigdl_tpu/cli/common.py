@@ -52,6 +52,36 @@ def add_fused_bn_arg(p: argparse.ArgumentParser) -> None:
                         "means 'stats' (historical)")
 
 
+def add_lint_arg(p: argparse.ArgumentParser) -> None:
+    """--lint[=strict]: tpulint pre-flight (bigdl_tpu.analysis) before
+    the run compiles anything — trace-time rule evaluation on CPU in
+    seconds. ``strict`` refuses to launch on error-severity findings."""
+    p.add_argument("--lint", nargs="?", const="on", default=None,
+                   choices=["on", "strict"],
+                   help="pre-flight static analysis of the model/config "
+                        "(bigdl_tpu.analysis, PERF.md §12): dtype "
+                        "upcasts, donation, Pallas tiling/VMEM, fusion "
+                        "opportunities (unfused BN, GEMM-eligible "
+                        "convs), host syncs. Bare --lint prints the "
+                        "report and continues; --lint=strict exits "
+                        "nonzero on error-severity findings. Findings "
+                        "are stamped into perf JSON lines as 'lint'")
+
+
+def run_preflight_lint(report, strict: bool = False):
+    """Print one lint report; returns ``(exit_code, annotation)`` —
+    exit_code 0 means proceed (the annotation is stamped into result
+    JSON), nonzero means the caller should abort the launch (strict
+    mode with error-severity findings)."""
+    print(report.render(), flush=True)
+    rc = report.exit_code(strict=strict)
+    if rc:
+        print(f"lint: {report.errors} error-severity finding(s) — "
+              "refusing to launch (--lint=strict)", flush=True)
+        return rc, None
+    return 0, report.annotation()
+
+
 def apply_fused_bn(model, mode: Optional[str]):
     """Install the --fusedBN choice on a built model (no-op for
     None/'off'). Returns the model."""
@@ -181,6 +211,7 @@ def add_train_args(p: argparse.ArgumentParser) -> None:
                    help="shard the batch over all visible devices")
     add_autotune_arg(p)
     add_fused_bn_arg(p)
+    add_lint_arg(p)
     p.add_argument("--seed", type=int, default=42)
     p.add_argument("--logEvery", type=int, default=10)
     p.add_argument("--summary", default=None, metavar="DIR",
@@ -284,6 +315,17 @@ def build_optimizer(model, dataset, criterion, args, schedule=None,
         opt.resume(args.model)
     if getattr(args, "summary", None):
         opt.set_summary(args.summary)
+    lint_mode = getattr(args, "lint", None)
+    if lint_mode:
+        # pre-flight static analysis of the REAL step this Optimizer
+        # will compile (bigdl_tpu.analysis.preflight_optimizer) —
+        # module rules always, the jaxpr pass when the dataset exposes
+        # its batch geometry; strict aborts before any compile
+        from bigdl_tpu.analysis import preflight_optimizer
+        rc, _ = run_preflight_lint(preflight_optimizer(opt),
+                                   strict=(lint_mode == "strict"))
+        if rc:
+            raise SystemExit(rc)
     return opt
 
 

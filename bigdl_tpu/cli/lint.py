@@ -1,0 +1,89 @@
+"""`bigdl-tpu lint` — the tpulint static-analysis CLI (ISSUE 4).
+
+Trace a perf-zoo model's full train step on CPU in seconds (abstract
+inputs, no compile, no device) and report TPU perf/correctness
+anti-patterns with rule-level provenance and fix hints:
+
+    python -m bigdl_tpu.cli.main lint resnet50 -b 128
+    bigdl-tpu lint resnet50 --fusedBN apply --convLayout GEMM,GEMM,GEMM
+    bigdl-tpu lint transformer_lm --seq 600 --strict   # ragged seq -> rc 2
+    bigdl-tpu lint lenet5 --json report.json
+
+Configuration flags mirror the perf harness (--fusedBN / --convLayout /
+--convGeom / --autotune) so the exact run configuration you are about to
+launch is what gets analyzed; ``--strict`` exits nonzero on any
+error-severity finding (the CI gate). Rule catalog: PERF.md §12.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        "bigdl-tpu lint",
+        description="trace-time TPU anti-pattern lint "
+                    "(bigdl_tpu.analysis; PERF.md §12)")
+    p.add_argument("model",
+                   help="perf model-zoo name (see `bigdl-tpu perf`), "
+                        "e.g. resnet50, lenet5, transformer_lm")
+    p.add_argument("-b", "--batchSize", type=int, default=32)
+    p.add_argument("--seq", type=int, default=None,
+                   help="override the LM sequence length (transformer_lm* "
+                        "models) — e.g. 600 demonstrates the ragged-seq "
+                        "flash fallback finding")
+    p.add_argument("--classes", type=int, default=1000)
+    p.add_argument("--f32", action="store_true",
+                   help="analyze the f32 path instead of the bf16 "
+                        "TPU-projected default")
+    p.add_argument("--strict", action="store_true",
+                   help="exit nonzero on error-severity findings (what "
+                        "--lint=strict does on the perf/training CLIs)")
+    p.add_argument("--json", default=None, metavar="FILE",
+                   help="also write the full finding list as JSON "
+                        "('-' = stdout)")
+    p.add_argument("--no-trace", action="store_true",
+                   help="module-level rules only (skip the jaxpr pass)")
+    from bigdl_tpu.cli.common import (_add_platform_arg, add_autotune_arg,
+                                      add_fused_bn_arg, apply_platform)
+    _add_platform_arg(p)
+    add_autotune_arg(p)
+    add_fused_bn_arg(p)
+    p.add_argument("--convLayout", default=None, metavar="FWD,DGRAD,WGRAD",
+                   help="analyze under this explicit per-pass conv layout "
+                        "policy (NHWC|NCHW|GEMM each, or "
+                        "'auto'/'default')")
+    p.add_argument("--convGeom", default=None, metavar="FILE",
+                   help="analyze under this per-geometry conv decision "
+                        "JSON (scripts/apply_conv_probe.py --geom)")
+    args = p.parse_args(argv)
+    apply_platform(args)  # installs --convLayout/--convGeom/--autotune
+
+    import jax.numpy as jnp
+
+    from bigdl_tpu.analysis import lint_perf_model
+    from bigdl_tpu.ops.conv2d import policy_snapshot, restore_policy
+
+    snap = policy_snapshot()
+    try:
+        report = lint_perf_model(
+            args.model, args.batchSize, seq_len=args.seq,
+            dtype=jnp.float32 if args.f32 else None,
+            fused_bn=args.fusedBN, classes=args.classes,
+            trace=not getattr(args, "no_trace", False))
+    finally:
+        restore_policy(snap)
+
+    print(report.render(), flush=True)
+    if args.json == "-":
+        print(json.dumps(report.to_json(), indent=2), flush=True)
+    elif args.json:
+        report.dump_json(args.json)
+        print(f"lint: wrote {args.json}", flush=True)
+    return report.exit_code(strict=args.strict)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -29,6 +29,7 @@ _COMMANDS = {
     "transformerlm": "transformerlm",
     "textclassification": "textclassification",
     "perf": "perf",
+    "lint": "lint",
     "predict": "predict",
     "loadmodel": "loadmodel",
     "record-gen": "record_gen",

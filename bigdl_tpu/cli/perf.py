@@ -55,6 +55,12 @@ def _bn_fused(m, mode=True):
     return set_bn_fused(m, mode)
 
 
+# build_model(seq_len=..., lm_attn_impl=...) installs overrides here for
+# the duration of one table call — tpulint builds LMs with the flash
+# kernel forced on (TPU-projected trace off-chip) and a custom seq
+_LM_OVERRIDE: dict = {}
+
+
 def _lm(*, num_kv_heads=2, pos_encoding="rope", **kw):
     """Shared LM-config plumbing for the perf model zoo (vocab + the
     backend-conditional flash selection live in ONE place)."""
@@ -62,13 +68,17 @@ def _lm(*, num_kv_heads=2, pos_encoding="rope", **kw):
 
     from bigdl_tpu import models
 
+    kw = dict(kw)
+    kw.setdefault("attn_impl",
+                  "flash" if jax.default_backend() == "tpu" else None)
+    kw.update(_LM_OVERRIDE)
     return models.transformer_lm(
         _LM_VOCAB, pos_encoding=pos_encoding, num_kv_heads=num_kv_heads,
-        attn_impl=("flash" if jax.default_backend() == "tpu" else None),
         **kw)
 
 
-def build_model(name: str, class_num: int = 1000):
+def build_model(name: str, class_num: int = 1000, seq_len=None,
+                lm_attn_impl=None):
     import jax
 
     from bigdl_tpu import models
@@ -155,7 +165,23 @@ def build_model(name: str, class_num: int = 1000):
             "transformer_lm_1k_hd128": (1024,),
             "transformer_lm_16k": (16384,),
             "transformer_lm_32k": (32768,)}.get(name, (224, 224, 3))
-    return table[name](), size
+    # LM build overrides (tpulint): forced attn_impl and/or seq length
+    # apply only to transformer_lm* names and only for this one call
+    global _LM_OVERRIDE
+    over = {}
+    if name.startswith("transformer_lm"):
+        if lm_attn_impl is not None:
+            over["attn_impl"] = lm_attn_impl
+        if seq_len is not None:
+            over["max_len"] = int(seq_len)
+            size = (int(seq_len),)
+    prev = _LM_OVERRIDE
+    _LM_OVERRIDE = over
+    try:
+        model = table[name]()
+    finally:
+        _LM_OVERRIDE = prev
+    return model, size
 
 
 def _record_batches(source: str, batch: int, n_threads: int = 0):
@@ -216,7 +242,7 @@ def run(model_name: str, batch: int, iterations: int, data_type: str,
         use_bf16: bool = True, data_parallel: bool = False,
         data_source: str | None = None, inner_steps: int = 1,
         profile_dir: str | None = None, autotune: str | None = None,
-        fused_bn: str | None = None):
+        fused_bn: str | None = None, lint: dict | None = None):
     """Throughput harness entry. ``autotune`` optionally installs the
     tuning mode (the CLI does it via --autotune/apply_platform; bench.py
     children pass it directly). ``fused_bn`` ('off'/'stats'/'apply')
@@ -235,7 +261,8 @@ def run(model_name: str, batch: int, iterations: int, data_type: str,
         return _run_timed(model_name, batch, iterations, data_type,
                           use_bf16=use_bf16, data_parallel=data_parallel,
                           data_source=data_source, inner_steps=inner_steps,
-                          profile_dir=profile_dir, fused_bn=fused_bn)
+                          profile_dir=profile_dir, fused_bn=fused_bn,
+                          lint=lint)
     finally:
         conv2d.restore_policy(snap)
 
@@ -244,7 +271,7 @@ def _run_timed(model_name: str, batch: int, iterations: int, data_type: str,
                use_bf16: bool = True, data_parallel: bool = False,
                data_source: str | None = None, inner_steps: int = 1,
                profile_dir: str | None = None,
-               fused_bn: str | None = None):
+               fused_bn: str | None = None, lint: dict | None = None):
     import os
 
     import jax
@@ -452,6 +479,8 @@ def _run_timed(model_name: str, batch: int, iterations: int, data_type: str,
     _annotate_conv_layouts(out)
     _annotate_autotune(out)
     _annotate_bn_fused(out, model)
+    if lint is not None:  # --lint pre-flight summary rides in the JSON
+        out["lint"] = lint  # line like bn_fused/autotune decisions do
     if flops_error is not None:
         out["flops_analytic_error"] = flops_error
     if flops_analytic and flops_hlo:
@@ -560,7 +589,8 @@ def run_time_to_acc(model_name: str, batch: int, target: float,
                     hard: bool = False, val_every_iters: int | None = None,
                     lift: float | None = None, noise: float | None = None,
                     weight_decay: float = 1e-4,
-                    fused_bn: str | None = None):
+                    fused_bn: str | None = None,
+                    lint: dict | None = None):
     """Time-to-accuracy harness (BASELINE.json metric: images/sec/chip
     **+ time-to-76%-top1**; reference recipe models/inception/Train.scala
     :77-83 + scripts/run.example.sh:54). Trains ``model_name`` from
@@ -672,6 +702,8 @@ def run_time_to_acc(model_name: str, batch: int, target: float,
     _annotate_conv_layouts(out)
     _annotate_autotune(out)
     _annotate_bn_fused(out, model)
+    if lint is not None:
+        out["lint"] = lint
     print(json.dumps(out))
     return out
 
@@ -754,10 +786,12 @@ def main(argv=None):
                         "1x1/s1 convs may run as GEMM; stamped as "
                         "conv_geom in the result JSON")
     from bigdl_tpu.cli.common import (_add_platform_arg, add_autotune_arg,
-                                      add_fused_bn_arg, apply_platform)
+                                      add_fused_bn_arg, add_lint_arg,
+                                      apply_platform, run_preflight_lint)
     _add_platform_arg(p)
     add_autotune_arg(p)
     add_fused_bn_arg(p)
+    add_lint_arg(p)
     args = p.parse_args(argv)
     apply_platform(args)
     if args.convLayout:
@@ -765,6 +799,22 @@ def main(argv=None):
         # one); just surface what's active for the capture logs
         from bigdl_tpu.ops.conv2d import get_conv_pass_layouts
         print("conv pass layouts:", get_conv_pass_layouts(), flush=True)
+    lint_ann = None
+    if args.lint:
+        # pre-flight static analysis of THIS run's model/config
+        # (bigdl_tpu.analysis; PERF.md §12) — strict refuses to launch
+        # on error-severity findings, and the summary is stamped into
+        # the result JSON either way
+        import jax.numpy as jnp
+
+        from bigdl_tpu.analysis import lint_perf_model
+        report = lint_perf_model(
+            args.model, args.batchSize, fused_bn=args.fusedBN,
+            dtype=jnp.float32 if args.f32 else None)
+        rc, lint_ann = run_preflight_lint(
+            report, strict=(args.lint == "strict"))
+        if rc:
+            return rc
     if args.timeToAcc is not None:
         data_dir = None
         if args.data and args.data.startswith("record:"):
@@ -777,12 +827,13 @@ def main(argv=None):
                         use_bf16=not args.f32, data_dir=data_dir,
                         hard=args.ttaHard, val_every_iters=args.valEvery,
                         lift=args.ttaLift, noise=args.ttaNoise,
-                        weight_decay=args.ttaWd, fused_bn=args.fusedBN)
+                        weight_decay=args.ttaWd, fused_bn=args.fusedBN,
+                        lint=lint_ann)
         return
     run(args.model, args.batchSize, args.iteration, args.dataType,
         use_bf16=not args.f32, data_parallel=args.dataParallel,
         data_source=args.data, inner_steps=args.innerSteps,
-        profile_dir=args.profile, fused_bn=args.fusedBN)
+        profile_dir=args.profile, fused_bn=args.fusedBN, lint=lint_ann)
 
 
 if __name__ == "__main__":

@@ -178,18 +178,25 @@ class SpatialFullConvolution(SimpleModule):
             # matching channels filled, so the deconv starts as exact
             # bilinear upsampling (what segmentation heads actually want;
             # identical to "bilinear" when n_in == n_out == 1).
+            # generated in float32 end-to-end (no float64 intermediate
+            # that a final cast then hides) so init is dtype-consistent
+            # with every other layer and tpulint's dtype rules never
+            # have to special-case our own defaults (ISSUE 4 satellite);
+            # the single jnp.asarray below is the only conversion
             f_h = (self.kernel_h + 1) // 2
-            c_h = (2 * f_h - 1 - f_h % 2) / (2.0 * f_h)
-            wh = 1 - np.abs(np.arange(self.kernel_h) / f_h - c_h)
+            c_h = np.float32((2 * f_h - 1 - f_h % 2) / (2.0 * f_h))
+            wh = 1 - np.abs(np.arange(self.kernel_h, dtype=np.float32)
+                            / f_h - c_h)
             f_w = (self.kernel_w + 1) // 2
-            c_w = (2 * f_w - 1 - f_w % 2) / (2.0 * f_w)
-            ww = 1 - np.abs(np.arange(self.kernel_w) / f_w - c_w)
+            c_w = np.float32((2 * f_w - 1 - f_w % 2) / (2.0 * f_w))
+            ww = 1 - np.abs(np.arange(self.kernel_w, dtype=np.float32)
+                            / f_w - c_w)
             tri = wh[:, None] * ww[None, :]
             cin = self.n_input_plane // self.n_group
             if self.init_method == "bilinear":
                 w = np.broadcast_to(tri[:, :, None, None], shape).copy()
             else:
-                w = np.zeros(shape, np.float64)
+                w = np.zeros(shape, np.float32)
                 for i in range(min(cin, self.n_output_plane)):
                     w[:, :, i, i] = tri
             p = {"weight": jnp.asarray(w, self.param_dtype)}

@@ -36,7 +36,7 @@ import jax.numpy as jnp
 from bigdl_tpu.nn import attention as _dense
 
 __all__ = ["flash_attention", "blockwise_attention",
-           "online_softmax_update"]
+           "online_softmax_update", "flash_block_plan"]
 
 _NEG_INF = -1e30
 
@@ -504,6 +504,33 @@ def _resolve_blocks(s_q: int, s_k: int, d: int, causal: bool, dtype,
         if block_k is None:
             block_k = tuned[1] if tuned else _DEFAULT_BLOCK
     return _clamp_block(block_q, s_q), _clamp_block(block_k, s_k)
+
+
+def flash_block_plan(s_q: int, s_k: int, d: int, causal: bool,
+                     dtype) -> dict:
+    """Static view of what :func:`flash_attention` would do at this
+    shape — the block metadata tpulint (bigdl_tpu.analysis) evaluates
+    without tracing a kernel:
+
+    * ``block_q``/``block_k`` — the resolved (autotuner-consulted,
+      clamped) tile sizes;
+    * ``kernel_ok`` — False when the ragged key length knocks the call
+      off the Pallas kernel onto the remat-scan fallback;
+    * ``q_pad``/``k_pad`` — rows a padded final block would add (the
+      pre-round-6 s=768 failure mode: nonzero means wasted grid work);
+    * ``clamped`` — blocks sit below the 512 default because the seq
+      admits no larger divisor (fine, but worth a note).
+    """
+    bq, bk = _resolve_blocks(int(s_q), int(s_k), int(d), bool(causal),
+                             dtype, None, None)
+    return {
+        "block_q": bq, "block_k": bk,
+        "kernel_ok": _tileable(int(s_q), int(s_k), bk),
+        "q_pad": (-int(s_q)) % bq,
+        "k_pad": (-int(s_k)) % bk,
+        "clamped": (bq < _DEFAULT_BLOCK and bq < s_q)
+                   or (bk < _DEFAULT_BLOCK and bk < s_k),
+    }
 
 
 def _seg_arrays(segments, sq, sk, bq):

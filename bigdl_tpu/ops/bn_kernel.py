@@ -56,7 +56,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
 __all__ = ["bn_stats", "bn_bwd_stats", "fused_bn_train",
-           "bn_fwd_apply", "bn_bwd_fused", "fused_bn_apply_train"]
+           "bn_fwd_apply", "bn_bwd_fused", "fused_bn_apply_train",
+           "fused_bn_tileable", "fba_tileable", "min_sublane"]
 
 
 def _vmem_scratch(shape):
@@ -222,6 +223,19 @@ def _tileable(rows: int, c: int, *dtypes) -> bool:
     return rows % _resolve_row_block(rows, c, *dtypes) == 0 \
         and rows % ms == 0 \
         and c % min(_C_BLOCK, c) == 0 and c % 128 == 0
+
+
+def fused_bn_tileable(rows: int, c: int, *dtypes) -> bool:
+    """Public view of the stats-kernel routing predicate — the
+    eligibility metadata tpulint (bigdl_tpu.analysis) and callers check
+    before assuming the single-read kernel engages."""
+    return _tileable(rows, c, *dtypes)
+
+
+def min_sublane(*dtypes) -> int:
+    """Public view of Mosaic's per-dtype minimum sublane count (8/16/32
+    for 4/2/1-byte dtypes) — shared with analysis.rules' tile checker."""
+    return _min_sublane(*dtypes)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
@@ -484,6 +498,13 @@ def _fba_tileable(rows: int, c: int, relu: bool, *dtypes) -> bool:
     return rows % _resolve_fba_row_block(rows, c, relu, *dtypes) == 0 \
         and rows % ms == 0 \
         and c % min(_C_BLOCK, c) == 0 and c % 128 == 0
+
+
+def fba_tileable(rows: int, c: int, relu: bool, *dtypes) -> bool:
+    """Public view of the fused-block routing predicate (see
+    :func:`fused_bn_tileable`) — keyed additionally by ``relu`` because
+    the autotuned row block is."""
+    return _fba_tileable(rows, c, relu, *dtypes)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))

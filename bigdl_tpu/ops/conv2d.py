@@ -69,6 +69,7 @@ __all__ = ["conv2d", "set_conv_pass_layouts", "get_conv_pass_layouts",
            "install_layout_spec", "maybe_install_auto",
            "install_geom_decisions", "install_geom_file",
            "clear_geom_policy", "geom_policy_if_any", "gemm_eligible",
+           "resolve_site_layouts",
            "policy_snapshot", "restore_policy", "policy_active",
            "MEASURED_DECISIONS"]
 
@@ -461,6 +462,56 @@ def decide_geom_from_probe(lines: Iterable[str]) -> List[dict]:
                     "layouts": {p: best[g][p][2] for p in _PASSES
                                 if p in best[g]}})
     return out
+
+
+def resolve_site_layouts(kh: int, kw: int, stride, padding, rhs_dilation,
+                         groups: int, cin: int, cout: int,
+                         dtype="bfloat16") -> Dict[str, str]:
+    """What layout each pass of ONE conv site would resolve to under the
+    currently-installed policy — the same precedence ladder
+    :func:`_pass_layout` applies at trace time (explicit spec >
+    per-geometry decision > cached tuner decision > global triple, GEMM
+    degrading to NHWC at ineligible sites) but computed from static site
+    metadata, with the tuner consulted READ-ONLY (no measuring, no cache
+    writes, no ledger entries). This is tpulint's layout/fusion oracle
+    (bigdl_tpu.analysis): a GEMM-eligible site resolving to a spatial
+    layout is a fusion-opportunity finding."""
+    stride = tuple(int(s) for s in stride)
+    rhs_dilation = tuple(int(d) for d in rhs_dilation)
+    geom = (int(kh), int(kw), stride[0], stride[1], int(cin), int(cout),
+            int(groups), rhs_dilation[0], rhs_dilation[1],
+            _dtype_name(dtype))
+    ok = gemm_eligible(int(kh), int(kw), stride, padding, rhs_dilation,
+                       int(groups))
+    out: Dict[str, str] = {}
+    for p in _PASSES:
+        lay = None
+        if not _EXPLICIT:
+            per = _GEOM_POLICY.get(geom)
+            if per:
+                lay = per.get(p)
+            if lay is None:
+                lay = _peek_tuned_geom(p, geom, ok)
+        if lay is None:
+            lay = _POLICY[p]
+        if lay == "GEMM" and not ok:
+            lay = "NHWC"
+        out[p] = lay
+    return out
+
+
+def _peek_tuned_geom(pass_name: str, geom: tuple,
+                     gemm_ok: bool) -> "str | None":
+    """Read-only view of the tuner's ``conv_geom`` decision for one
+    (pass, geometry) — unlike :func:`_tuned_geom_layout` this can never
+    measure, write a dry entry, or touch the provenance ledger."""
+    try:
+        from bigdl_tpu.tuning import autotune as _at
+    except Exception:
+        return None
+    if _at.get_mode() == "off":
+        return None
+    return _at.peek_geom_layout(pass_name, geom, gemm_ok)
 
 
 def _to_nchw(x):

@@ -37,7 +37,7 @@ from bigdl_tpu.tuning.cache import AutotuneCache
 __all__ = ["MODES", "set_mode", "get_mode", "dry_run", "make_key",
            "flash_blocks", "bn_row_block", "fba_row_block",
            "install_conv_layouts", "conv_geom_layout", "conv_geom_key",
-           "put_geom_decisions",
+           "peek_geom_layout", "put_geom_decisions",
            "annotation", "reset", "reset_decisions", "get_cache"]
 
 MODES = ("off", "cached", "measure")
@@ -319,6 +319,23 @@ def conv_geom_layout(pass_name: str, geom: tuple, x_shape: tuple,
     cache.save()
     _record(key, ent["config"], ent["source"])
     return ent["config"]["layout"]
+
+
+def peek_geom_layout(pass_name: str, geom: tuple,
+                     gemm_ok: bool) -> Optional[str]:
+    """Read-only ``conv_geom`` lookup for static analysis (tpulint):
+    the cached decision for this (pass, geometry) when one exists and is
+    usable, else None. Never measures, never writes a dry entry, never
+    records in the provenance ledger — a lint pass must not change what
+    a later run resolves."""
+    if _MODE == "off":
+        return None
+    ent = get_cache().get(conv_geom_key(pass_name, geom))
+    lay = ((ent.get("config") or {}).get("layout")
+           if isinstance(ent, dict) else None)
+    if lay in CONV_GEOM_LAYOUTS and (lay != "GEMM" or gemm_ok):
+        return lay
+    return None
 
 
 def put_geom_decisions(decisions, cache=None) -> int:

@@ -10,11 +10,31 @@ registered JAX pytree, so it can flow through jit/grad like a dict.
 
 from __future__ import annotations
 
-from typing import Any, Iterator
+from typing import Any, Iterator, Sequence
 
 import jax
 
-__all__ = ["Table", "T"]
+__all__ = ["Table", "T", "format_table"]
+
+
+def format_table(headers: Sequence[str], rows: Sequence[Sequence[Any]],
+                 max_col: int = 72) -> str:
+    """Plain-text column-aligned table (the lint report's human output;
+    also usable by any CLI that wants aligned rows without a dependency).
+    Cells are str()'d and clipped at ``max_col`` chars with an ellipsis so
+    one long provenance path cannot wrap the whole report."""
+    def clip(s: Any) -> str:
+        s = str(s)
+        return s if len(s) <= max_col else s[:max_col - 1] + "…"
+
+    srows = [[clip(c) for c in r] for r in rows]
+    heads = [clip(h) for h in headers]
+    widths = [max(len(heads[i]), *(len(r[i]) for r in srows))
+              if srows else len(heads[i]) for i in range(len(heads))]
+    fmt = "  ".join(f"{{:<{w}}}" for w in widths)
+    lines = [fmt.format(*heads), fmt.format(*("-" * w for w in widths))]
+    lines += [fmt.format(*r) for r in srows]
+    return "\n".join(line.rstrip() for line in lines)
 
 
 class Table:

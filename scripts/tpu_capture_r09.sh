@@ -1,0 +1,57 @@
+#!/usr/bin/env bash
+# Round-9 capture: ISSUE 4 (tpulint) chip correlation. The lint pass is
+# CPU-static by construction; what only a chip can tell us is which
+# findings CORRELATE with measured MFU gaps — so this window records the
+# lint report for each A/B leg right next to the measured numbers
+# (PERF.md §12 "next chip window" contract), then re-runs the r08-style
+# tuned-vs-default A/Bs with --lint so every perf JSON line carries its
+# finding summary inline. Appends to $OUT, mirrored into the repo per
+# step.
+
+set -uo pipefail
+cd "$(dirname "$0")/.."
+OUT="${OUT:-/tmp/tpu_capture_r09.log}"
+REPO_LOG="${REPO_LOG:-TPU_CAPTURE_r09.log}"
+trap 'cp -f "$OUT" "$REPO_LOG" 2>/dev/null || true' EXIT
+
+step() {
+  local name="$1" tmo="$2"; shift 2
+  echo "=== $name ($(date -u +%H:%M:%SZ))" | tee -a "$OUT"
+  timeout "$tmo" "$@" 2>&1 | tail -30 | tee -a "$OUT"
+  echo "=== end $name rc=$?" | tee -a "$OUT"
+  cp -f "$OUT" "$REPO_LOG" 2>/dev/null || true
+}
+
+# 1. compiled-path tests incl. the lint suite (CPU rules must agree with
+#    what actually lowers on the chip backend)
+step "pytest_tpu_marked" 1200 env BIGDL_TPU_TESTS=1 python -m pytest tests/ -m tpu -q
+step "pytest_lint" 300 python -m pytest tests/test_lint.py -q
+
+# 2. lint reports for the A/B legs, archived as JSON — the artifact the
+#    correlation table in PERF.md §12 is built from. Default config
+#    (expected: fusion-bn-unfused error, conv-gemm + upcast warnings)
+#    vs the tuned config (expected: zero fusion findings).
+step "lint_resnet50_default" 300 sh -c 'python -m bigdl_tpu.cli.main lint resnet50 -b 128 --json LINT_r09_resnet50_default.json'
+step "lint_resnet50_tuned" 300 sh -c 'python -m bigdl_tpu.cli.main lint resnet50 -b 128 --fusedBN apply --convLayout GEMM,GEMM,GEMM --json LINT_r09_resnet50_tuned.json'
+step "lint_resnet50_fba_b128" 300 sh -c 'python -m bigdl_tpu.cli.main lint resnet50_fba -b 128 --json LINT_r09_resnet50_fba.json'
+step "lint_transformer_lm_1k" 300 sh -c 'python -m bigdl_tpu.cli.main lint transformer_lm_1k -b 8 --json LINT_r09_lm1k.json'
+step "lint_transformer_lm_1k_hd128" 300 sh -c 'python -m bigdl_tpu.cli.main lint transformer_lm_1k_hd128 -b 8 --json LINT_r09_lm1k_hd128.json'
+
+# 3. the correlation legs: same model, lint-flagged config vs lint-clean
+#    config, measured in one window — does the error/warning delta
+#    predict the MFU delta? --lint stamps the summary into each JSON
+#    line so the pairing is self-describing.
+step "perf_resnet50_default_lint" 900 python -m bigdl_tpu.cli.perf -m resnet50 -b 128 -i 20 --dataType random --lint
+step "perf_resnet50_fba_lint" 900 python -m bigdl_tpu.cli.perf -m resnet50_fba -b 128 -i 20 --dataType random --lint
+step "perf_resnet50_tuned_lint" 900 python -m bigdl_tpu.cli.perf -m resnet50 -b 128 -i 20 --dataType random --fusedBN apply --autotune cached --lint
+step "perf_lm1k_lint" 900 python -m bigdl_tpu.cli.perf -m transformer_lm_1k -b 8 -i 20 --dataType random --lint
+step "perf_lm1k_hd128_lint" 900 python -m bigdl_tpu.cli.perf -m transformer_lm_1k_hd128 -b 8 -i 20 --dataType random --lint
+
+# 4. strict gate smoke ON the chip environment (exit codes are the CI
+#    contract; rc=2 expected for the first, rc=0 for the second)
+step "lint_strict_misconfig" 300 sh -c 'python -m bigdl_tpu.cli.main lint resnet50 -b 128 --strict; echo "strict-misconfig rc=$?"'
+step "lint_strict_tuned" 300 sh -c 'python -m bigdl_tpu.cli.main lint resnet50 -b 128 --fusedBN apply --convLayout GEMM,GEMM,GEMM --strict; echo "strict-tuned rc=$?"'
+
+# 5. full bench line rides along as usual so the window also refreshes
+#    the headline numbers next to the lint artifacts
+step "bench_headline" 5400 env BENCH_TPU_TIMEOUT=2000 python bench.py resnet50 128 20

@@ -259,10 +259,13 @@ def test_fba_kernel_block_specs_satisfy_mosaic_tiling():
             fused_bn_apply_train(xx, g, b, EPS, True)[0]))(x)
 
     assert len(captured) >= 10, len(captured)  # fwd 2in+3out, bwd 3in+3out
+    # shared Mosaic law via analysis.rules (tpulint's tile-min rule)...
+    from bigdl_tpu.analysis.rules import assert_blocks_tileable
+    assert_blocks_tileable(captured, jnp.float32)
     for bs, ashape in captured:
         b0, b1 = bs[-2], bs[-1]
-        a0, a1 = ashape[-2], ashape[-1]
-        assert b1 == a1 or b1 % 128 == 0, (bs, ashape)
+        # ...plus the stricter full-tile hardening: no reliance on the
+        # block-dim==array-dim escape at all
         assert b0 % 8 == 0 and b1 % 128 == 0, (bs, ashape)
 
 

@@ -173,15 +173,17 @@ def test_bn_kernel_block_specs_satisfy_mosaic_tiling():
             fused_bn_train(xx, g, g, 1e-5)[0]))(x)
 
     assert len(captured) >= 6, len(captured)
+    # the shared Mosaic law lives in analysis.rules (tpulint's tile-min
+    # rule) — one source of truth instead of a per-test copy
+    from bigdl_tpu.analysis.rules import assert_blocks_tileable
+    assert_blocks_tileable(captured, jnp.float32)
     for bs, ashape in captured:
         b0, b1 = bs[-2], bs[-1]
-        a0, a1 = ashape[-2], ashape[-1]
-        assert b1 == a1 or b1 % 128 == 0, (bs, ashape)
-        assert b0 == a0 or b0 % 8 == 0, (bs, ashape)
-        # round-5 hardening: no block relies on the block-dim==array-dim
-        # escape for sub-minimum f32 sublanes — every block is a full
-        # (>=8, >=128) tile outright (the escape is what the round-3
-        # flash lowering failure was about)
+        # round-5 hardening (stricter than the Mosaic minimum): no block
+        # relies on the block-dim==array-dim escape for sub-minimum f32
+        # sublanes — every block is a full (>=8, >=128) tile outright
+        # (the escape is what the round-3 flash lowering failure was
+        # about)
         assert b0 % 8 == 0 and b1 % 128 == 0, (bs, ashape)
 
 
