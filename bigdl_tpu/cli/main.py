@@ -30,6 +30,7 @@ _COMMANDS = {
     "textclassification": "textclassification",
     "perf": "perf",
     "lint": "lint",
+    "serve": "serve",
     "predict": "predict",
     "loadmodel": "loadmodel",
     "record-gen": "record_gen",

@@ -42,7 +42,7 @@ def main(argv=None):
 
     from bigdl_tpu import models
     from bigdl_tpu.dataset.folder import _decode, list_image_folder
-    from bigdl_tpu.utils import Classifier
+    from bigdl_tpu.serving import InferenceEngine, power_of_two_buckets
 
     model = None
     if os.path.isfile(args.model):
@@ -77,7 +77,12 @@ def main(argv=None):
         if args.imageSize:
             size = (args.imageSize, args.imageSize)
         params, mod_state = common.load_trained(model, args.model)
-    clf = Classifier(model, params, mod_state, batch_size=args.batchSize)
+    # the serving engine's bucketed compile cache (power-of-two ladder up
+    # to --batchSize): the tail batch pads to an existing bucket instead
+    # of compiling its own odd shape — same scores row-for-row as the
+    # old full-batch-padded Classifier path
+    clf = InferenceEngine(model, params, mod_state,
+                          buckets=power_of_two_buckets(args.batchSize))
 
     # accept both a class-subdir tree and a flat folder of images
     try:

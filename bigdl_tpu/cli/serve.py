@@ -1,0 +1,212 @@
+"""`bigdl-tpu serve` — the online inference endpoint (ISSUE 5).
+
+Serves a perf-zoo model (or a custom-dims transformer_lm) from a
+training checkpoint over HTTP with dynamic micro-batching, bucketed
+compiles, and (for LMs) continuous-batching KV-cache decode:
+
+    bigdl-tpu serve lenet5 --model ckpt_dir --port 8000
+    bigdl-tpu serve resnet50 --model ckpt_dir --fusedBN apply \
+        --autotune cached --buckets 1,2,4,8,16,32
+    bigdl-tpu serve transformer_lm --model ckpt_dir --slots 8 --bf16
+    curl -d '{"tokens": [3, 1, 4], "max_new_tokens": 8}' \
+        localhost:8000/generate
+
+The config flags mirror the perf harness (`--fusedBN`, `--convLayout`,
+`--convGeom`, `--autotune`, `--lint`) so the served program is the SAME
+tuned program the benchmarks measured, and the resolved configuration is
+stamped into every `/metrics` scrape (the perf-JSON provenance contract,
+extended to serving).
+"""
+
+from __future__ import annotations
+
+import argparse
+
+from bigdl_tpu.cli import common
+
+
+def _parse_buckets(spec: str):
+    try:
+        out = tuple(sorted({int(t) for t in spec.split(",") if t.strip()}))
+        if not out or out[0] < 1:
+            raise ValueError
+        return out
+    except ValueError:
+        raise SystemExit(f"--buckets {spec!r}: expected a comma-separated "
+                         f"list of positive ints, e.g. 1,2,4,8,16,32")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        "bigdl-tpu serve",
+        description="online inference over HTTP (bigdl_tpu.serving): "
+                    "bucketed compiles, dynamic micro-batching, KV-cache "
+                    "decode for LMs, /metrics with config provenance")
+    p.add_argument("model",
+                   help="perf model-zoo name (see `bigdl-tpu perf`), e.g. "
+                        "lenet5, resnet50, transformer_lm")
+    p.add_argument("--model", dest="checkpoint", default=None,
+                   metavar="CKPT",
+                   help="training checkpoint to serve: dir with model.<n> "
+                        "(single-blob or sharded orbax) or a single file; "
+                        "optimizer state is never loaded")
+    p.add_argument("--randomInit", action="store_true",
+                   help="serve freshly initialized weights (benchmarks / "
+                        "smoke tests; refuses to default silently)")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("-p", "--port", type=int, default=8000,
+                   help="0 = ephemeral (the chosen port is printed)")
+    p.add_argument("--buckets", default="1,2,4,8,16,32",
+                   help="batch-size buckets the engine pre-compiles; "
+                        "requests pad up to the nearest (bounded compile "
+                        "cache, metered padding waste)")
+    p.add_argument("--maxBatch", type=int, default=32,
+                   help="micro-batcher flush size (throughput trigger)")
+    p.add_argument("--maxWaitMs", type=float, default=5.0,
+                   help="oldest-row age that forces a flush (latency "
+                        "trigger)")
+    p.add_argument("--maxQueue", type=int, default=256,
+                   help="admission control: queued rows beyond this are "
+                        "fast-rejected with HTTP 429")
+    p.add_argument("--slots", type=int, default=4,
+                   help="continuous-batching decode slots (LM models): "
+                        "concurrent generations sharing one decode batch")
+    p.add_argument("--maxWaiting", type=int, default=64,
+                   help="generate requests allowed to wait for a slot "
+                        "before 429")
+    p.add_argument("--seq", type=int, default=None,
+                   help="override the LM sequence length / max context")
+    p.add_argument("--classes", type=int, default=1000)
+    p.add_argument("--bf16", action="store_true",
+                   help="bf16 activations (vision: input cast; LM: "
+                        "post-embedding cast + bf16 KV cache)")
+    p.add_argument("--no-warmup", action="store_true",
+                   help="skip pre-compiling every bucket at startup "
+                        "(first requests then pay the compiles)")
+    p.add_argument("--timeout", type=float, default=120.0,
+                   help="per-request wall timeout (503 past it)")
+    # custom-dims LM (matches cli/transformerlm.py checkpoints)
+    p.add_argument("--vocabSize", type=int, default=None,
+                   help="build a custom transformer_lm (with --dModel/"
+                        "--numLayers/--numHeads/--seq) instead of the "
+                        "32k-vocab perf-zoo config — the shape "
+                        "`bigdl-tpu transformerlm train` checkpoints")
+    p.add_argument("--dModel", type=int, default=128)
+    p.add_argument("--numLayers", type=int, default=2)
+    p.add_argument("--numHeads", type=int, default=4)
+    common._add_platform_arg(p)
+    common.add_autotune_arg(p)
+    common.add_fused_bn_arg(p)
+    common.add_lint_arg(p)
+    p.add_argument("--convLayout", default=None, metavar="FWD,DGRAD,WGRAD",
+                   help="per-pass conv activation layouts "
+                        "(NHWC|NCHW|GEMM each, or 'auto'/'default') — "
+                        "same semantics as the perf harness")
+    p.add_argument("--convGeom", default=None, metavar="FILE",
+                   help="per-conv-geometry layout decision JSON "
+                        "(scripts/apply_conv_probe.py --geom)")
+    return p
+
+
+def build_app(args):
+    """Construct (app, engine, in_shape, in_dtype) from parsed args —
+    separated from main() so tests and the load generator can run the
+    server in-process on an ephemeral port."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from bigdl_tpu.serving import (DecodeEngine, InferenceEngine,
+                                   MetricsRegistry, MicroBatcher,
+                                   ServingApp)
+
+    name = args.model
+    is_lm = name.startswith("transformer_lm")
+    if is_lm and args.vocabSize is not None:
+        from bigdl_tpu import models
+        seq = args.seq or 128
+        model = models.transformer_lm(
+            args.vocabSize, d_model=args.dModel,
+            num_layers=args.numLayers, num_heads=args.numHeads,
+            max_len=seq)
+        in_shape = (seq,)
+    else:
+        from bigdl_tpu.cli.perf import build_model
+        model, in_shape = build_model(name, class_num=args.classes,
+                                      seq_len=args.seq)
+    common.apply_fused_bn(model, getattr(args, "fusedBN", None))
+    compute_dtype = jnp.bfloat16 if args.bf16 else None
+    if is_lm and compute_dtype is not None:
+        model.compute_dtype = compute_dtype  # post-embedding cast
+
+    if args.checkpoint:
+        from bigdl_tpu.utils.orbax_ckpt import restore_for_inference
+        params, mod_state = restore_for_inference(args.checkpoint)
+    elif args.randomInit:
+        import jax
+        params, mod_state = model.init(jax.random.PRNGKey(0)), None
+    else:
+        raise SystemExit(
+            "serve needs weights: pass --model CKPT (a training "
+            "checkpoint dir or file) or --randomInit for smoke/bench "
+            "runs")
+
+    metrics = MetricsRegistry()
+    engine = InferenceEngine(
+        model, params, mod_state, buckets=_parse_buckets(args.buckets),
+        compute_dtype=compute_dtype, lint=getattr(args, "lint", None),
+        metrics=metrics)
+    in_dtype = np.int32 if is_lm else np.float32
+
+    # lint pre-flight over the exact serving graph BEFORE first compile
+    # (strict refuses to serve, same contract as the perf/training CLIs)
+    rc = engine.preflight_lint(in_shape, in_dtype)
+    if rc:
+        raise SystemExit(rc)
+
+    batcher = MicroBatcher(engine.predict_scores, max_batch=args.maxBatch,
+                           max_wait_ms=args.maxWaitMs,
+                           max_queue=args.maxQueue, metrics=metrics)
+    decoder = None
+    if is_lm:
+        decoder = DecodeEngine(model, params, slots=args.slots,
+                               cache_dtype=compute_dtype,
+                               max_waiting=args.maxWaiting,
+                               metrics=metrics)
+        decoder.start()
+
+    prov = engine.provenance()
+    prov.update({
+        "model": name,
+        "max_batch": args.maxBatch,
+        "max_wait_ms": args.maxWaitMs,
+        "max_queue": args.maxQueue,
+    })
+    if decoder is not None:
+        prov["decode_slots"] = args.slots
+        prov["prompt_buckets"] = ",".join(
+            str(b) for b in decoder.prompt_buckets)
+    metrics.set_provenance(prov)
+
+    app = ServingApp(name=name, metrics=metrics, engine=engine,
+                     batcher=batcher, decoder=decoder,
+                     request_timeout_s=args.timeout)
+    return app, engine, in_shape, in_dtype
+
+
+def main(argv=None):
+    common.setup_logging()
+    args = build_parser().parse_args(argv)
+    common.apply_platform(args)  # --convLayout/--convGeom/--autotune
+
+    from bigdl_tpu.serving import run_server
+
+    app, engine, in_shape, in_dtype = build_app(args)
+    if not args.no_warmup:
+        print(f"warmup: compiling buckets {engine.buckets} at "
+              f"{tuple(in_shape)} {in_dtype.__name__}", flush=True)
+        engine.warmup(in_shape, in_dtype)
+    return run_server(app, args.host, args.port)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

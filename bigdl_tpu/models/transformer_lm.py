@@ -174,6 +174,36 @@ class TransformerLM(Module):
             return self.head.forward(params["head"], h)
         return h @ params["emb"]["weight"].astype(h.dtype).T
 
+    def prefill_logits(self, params, tokens, cache, last=None):
+        """Serving prefill: run the full prompt once, populate the K/V
+        ``cache`` (positions 0..s-1), and return the next-token logits —
+        ``(b, vocab)`` at position ``last`` (traced index; default the
+        final position s-1) — plus the updated cache. With the prompt
+        right-padded to a length bucket, ``last`` = true_len - 1 makes
+        the result exactly the unpadded prompt's logits: causal
+        attention never lets positions > last influence position last,
+        and decode steps overwrite the pad K/V slots one position at a
+        time before ever attending to them."""
+        import jax
+
+        h = self._embed_at(params, tokens, 0)
+        h, cache = self.encoder.prefill(params["encoder"], h, cache)
+        if last is None:
+            h_last = h[:, -1:, :]
+        else:
+            h_last = jax.lax.dynamic_slice_in_dim(h, last, 1, axis=1)
+        return self._logits(params, h_last)[:, 0, :], cache
+
+    def decode_logits(self, params, tok, cache, pos):
+        """One decode step: ``tok`` (b, 1) int32 at absolute position
+        ``pos`` (traced) -> ((b, vocab) logits, cache). The per-token
+        inner loop of :meth:`generate`, exposed for the serving engine's
+        continuous-batching decoder (bigdl_tpu.serving.decode)."""
+        h = self._embed_at(params, tok, pos)
+        h, cache = self.encoder.decode_step(params["encoder"], h, cache,
+                                            pos)
+        return self._logits(params, h)[:, 0, :], cache
+
     def generate(self, params, prompt, max_new_tokens: int,
                  temperature: float = 0.0, top_k: Optional[int] = None,
                  rng=None):
@@ -210,9 +240,7 @@ class TransformerLM(Module):
 
         def run(params, prompt, rng):
             cache = self.encoder.init_cache(b, max_len, cache_dtype)
-            h = self._embed_at(params, prompt, 0)
-            h, cache = self.encoder.prefill(params["encoder"], h, cache)
-            logits = self._logits(params, h[:, -1:, :])[:, 0, :]
+            logits, cache = self.prefill_logits(params, prompt, cache)
 
             def body(i, carry):
                 buf, cache, logits, rng = carry
@@ -220,10 +248,8 @@ class TransformerLM(Module):
                 tok = sample(logits.astype(jnp.float32), key)
                 buf = jax.lax.dynamic_update_slice_in_dim(
                     buf, tok[:, None], i, axis=1)
-                h = self._embed_at(params, tok[:, None], s + i)
-                h, cache = self.encoder.decode_step(
-                    params["encoder"], h, cache, s + i)
-                logits = self._logits(params, h)[:, 0, :]
+                logits, cache = self.decode_logits(
+                    params, tok[:, None], cache, s + i)
                 return buf, cache, logits, rng
 
             buf = jnp.zeros((b, max_new_tokens), jnp.int32)

@@ -36,7 +36,8 @@ import jax.numpy as jnp
 from bigdl_tpu.nn import attention as _dense
 
 __all__ = ["flash_attention", "blockwise_attention",
-           "online_softmax_update", "flash_block_plan"]
+           "online_softmax_update", "flash_block_plan",
+           "serving_prefill_buckets"]
 
 _NEG_INF = -1e30
 
@@ -531,6 +532,34 @@ def flash_block_plan(s_q: int, s_k: int, d: int, causal: bool,
         "clamped": (bq < _DEFAULT_BLOCK and bq < s_q)
                    or (bk < _DEFAULT_BLOCK and bk < s_k),
     }
+
+
+def serving_prefill_buckets(max_len: int, head_dim: int,
+                            causal: bool = True, dtype=jnp.float32,
+                            min_bucket: int = 16) -> tuple:
+    """Prompt-length buckets for the serving prefill: a power-of-two
+    ladder from ``min_bucket`` up to (and always including) ``max_len``,
+    filtered to lengths whose :func:`flash_block_plan` stays ON the
+    Pallas kernel with zero padded rows — so a prefill at any bucket
+    reuses the tuned block plan the training benchmarks measured, never
+    the remat-scan fallback or a padded grid. Off-TPU (dense attention)
+    the same ladder simply bounds the compile cache; the filter is a
+    no-op there because power-of-two lengths clamp cleanly."""
+    if max_len < 1:
+        raise ValueError(f"max_len must be >= 1, got {max_len}")
+    ladder = []
+    b = max(1, int(min_bucket))
+    while b < max_len:
+        ladder.append(b)
+        b *= 2
+    ladder.append(int(max_len))
+    out = []
+    for s in sorted(set(ladder)):
+        plan = flash_block_plan(s, s, head_dim, causal, dtype)
+        if plan["kernel_ok"] and plan["q_pad"] == 0 and plan["k_pad"] == 0:
+            out.append(s)
+    # never return empty: the full max_len bucket always works densely
+    return tuple(out) or (int(max_len),)
 
 
 def _seg_arrays(segments, sq, sk, bq):

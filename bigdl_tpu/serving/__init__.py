@@ -1,0 +1,37 @@
+"""Online inference subsystem (ISSUE 5) — the production-serving half of
+the roadmap the first four PRs left open.
+
+BigDL's pitch was one stack for training AND serving (arxiv 1804.05839;
+BigDL 2.0 made seamless serving pipelines the headline, 2204.01715).
+Here the serving path deliberately reuses everything the training side
+tuned: the same modules and checkpoints, the same ``--fusedBN`` /
+``--convLayout`` / ``--convGeom`` / ``--autotune`` program configuration
+the perf harness measured, and the tpulint pre-flight before first
+compile.
+
+Modules:
+
+* :mod:`engine`  — bucketed pre-compiled eval forwards with donated
+  inputs (bounded compile cache, metered padding waste);
+* :mod:`batcher` — dynamic micro-batching (max_batch / max_wait_ms
+  triggers) with backpressure fast-reject admission control;
+* :mod:`decode`  — KV-cache prefill/decode split with
+  continuous-batching slots for ``transformer_lm``;
+* :mod:`metrics` — lock-cheap counters + latency histograms with a
+  plaintext exposition format and config-provenance stamping;
+* :mod:`server`  — stdlib ThreadingHTTPServer JSON endpoints
+  (``/predict`` ``/generate`` ``/healthz`` ``/metrics``), wired to the
+  ``bigdl-tpu serve`` CLI.
+"""
+
+from bigdl_tpu.serving.batcher import AdmissionError, MicroBatcher
+from bigdl_tpu.serving.decode import DecodeEngine, DecodeRequest
+from bigdl_tpu.serving.engine import InferenceEngine, power_of_two_buckets
+from bigdl_tpu.serving.metrics import (Counter, Gauge, Histogram,
+                                       MetricsRegistry)
+from bigdl_tpu.serving.server import ServingApp, make_server, run_server
+
+__all__ = ["AdmissionError", "MicroBatcher", "DecodeEngine",
+           "DecodeRequest", "InferenceEngine", "power_of_two_buckets",
+           "Counter", "Gauge", "Histogram", "MetricsRegistry",
+           "ServingApp", "make_server", "run_server"]

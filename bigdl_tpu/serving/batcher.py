@@ -1,0 +1,230 @@
+"""Dynamic micro-batching with backpressure-based admission control.
+
+Online traffic arrives one row at a time; the accelerator wants bucketed
+batches (serving/engine.py). The micro-batcher sits between: requests
+queue, a worker thread flushes a batch when either ``max_batch`` rows
+are waiting (throughput trigger) or the OLDEST row has waited
+``max_wait_ms`` (latency trigger), and the engine's bucket padding turns
+whatever was gathered into a compiled shape. This is the standard
+dynamic-batching contract (TF-Serving/Triton); the BigDL lineage analog
+is the DLClassifier's per-partition batching, which had Spark to do the
+gathering — here a queue + worker thread replace the RDD machinery.
+
+Admission control is backpressure by queue depth: when ``max_queue``
+rows are already pending, ``submit`` raises :class:`AdmissionError`
+IMMEDIATELY (fast-reject) instead of letting latency grow without bound
+— the caller (server.py) maps it to HTTP 429 so load sheds at the edge.
+
+Determinism for tests: the flush decision is a pure function of the
+injected ``clock`` (``_flush_ready``/``pump``), so the trigger semantics
+are testable without threads or real time.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Callable, Optional
+
+import numpy as np
+
+__all__ = ["AdmissionError", "MicroBatcher"]
+
+
+class AdmissionError(RuntimeError):
+    """Queue at capacity — request rejected at admission (HTTP 429)."""
+
+
+class _Future:
+    """Minimal thread-safe future (no concurrent.futures executor to
+    own it — the batcher resolves it from its worker thread)."""
+
+    __slots__ = ("_event", "_value", "_exc")
+
+    def __init__(self):
+        self._event = threading.Event()
+        self._value = None
+        self._exc = None
+
+    def set_result(self, v) -> None:
+        self._value = v
+        self._event.set()
+
+    def set_exception(self, e: BaseException) -> None:
+        self._exc = e
+        self._event.set()
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def result(self, timeout: Optional[float] = None):
+        if not self._event.wait(timeout):
+            raise TimeoutError("batched request did not complete in time")
+        if self._exc is not None:
+            raise self._exc
+        return self._value
+
+
+class _Pending:
+    __slots__ = ("row", "future", "t_enqueue")
+
+    def __init__(self, row, future, t):
+        self.row, self.future, self.t_enqueue = row, future, t
+
+
+class MicroBatcher:
+    """Gather single-row requests into engine batches.
+
+    ``predict_fn(batch_rows) -> scores`` is typically
+    ``engine.predict_scores``; rows of one flush are stacked along axis
+    0 and results are split back per request.
+
+    ``clock`` is injectable (monotonic seconds) for deterministic tests;
+    with ``start=False`` no worker thread runs and the test drives
+    :meth:`pump` manually.
+    """
+
+    def __init__(self, predict_fn: Callable, *, max_batch: int = 32,
+                 max_wait_ms: float = 5.0, max_queue: int = 256,
+                 clock: Callable[[], float] = time.monotonic,
+                 metrics=None, start: bool = True):
+        if max_batch < 1:
+            raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+        if max_queue < 1:
+            raise ValueError(f"max_queue must be >= 1, got {max_queue}")
+        self.predict_fn = predict_fn
+        self.max_batch = int(max_batch)
+        self.max_wait_s = float(max_wait_ms) / 1000.0
+        self.max_queue = int(max_queue)
+        self.clock = clock
+        self._pending: collections.deque = collections.deque()
+        self._lock = threading.Lock()
+        self._wakeup = threading.Condition(self._lock)
+        self._closed = False
+        self._thread = None
+
+        if metrics is not None:
+            self._m_submitted = metrics.counter(
+                "batcher_rows_submitted_total", "rows accepted by submit")
+            self._m_rejected = metrics.counter(
+                "batcher_rows_rejected_total",
+                "rows fast-rejected at admission (queue full)")
+            self._m_flushes = metrics.counter(
+                "batcher_flushes_total", "micro-batches dispatched")
+            self._m_wait = metrics.histogram(
+                "batcher_queue_wait_ms", "enqueue -> flush wait per row")
+            metrics.gauge("batcher_queue_depth", "rows currently queued",
+                          fn=lambda: len(self._pending))
+        else:
+            self._m_submitted = self._m_rejected = self._m_flushes = None
+            self._m_wait = None
+
+        if start:
+            self._thread = threading.Thread(target=self._worker,
+                                            name="micro-batcher",
+                                            daemon=True)
+            self._thread.start()
+
+    # --------------------------------------------------------------- submit
+    def submit(self, row) -> _Future:
+        """Queue one input row; returns a future resolving to its score
+        row. Raises :class:`AdmissionError` without blocking when the
+        queue is at ``max_queue`` (backpressure fast-reject)."""
+        fut = _Future()
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("batcher is closed")
+            if len(self._pending) >= self.max_queue:
+                if self._m_rejected is not None:
+                    self._m_rejected.inc()
+                raise AdmissionError(
+                    f"queue at capacity ({self.max_queue} rows pending)")
+            self._pending.append(_Pending(row, fut, self.clock()))
+            if self._m_submitted is not None:
+                self._m_submitted.inc()
+            self._wakeup.notify()
+        return fut
+
+    @property
+    def queue_depth(self) -> int:
+        return len(self._pending)
+
+    # ---------------------------------------------------------- flush logic
+    def _flush_ready(self, now: float) -> bool:
+        """Pure trigger decision: full batch waiting, or the oldest row
+        has aged past max_wait."""
+        if not self._pending:
+            return False
+        if len(self._pending) >= self.max_batch:
+            return True
+        return (now - self._pending[0].t_enqueue) >= self.max_wait_s
+
+    def _drain(self) -> list:
+        batch = []
+        while self._pending and len(batch) < self.max_batch:
+            batch.append(self._pending.popleft())
+        return batch
+
+    def _flush(self, batch: list, now: float) -> None:
+        if self._m_wait is not None:
+            for p in batch:
+                self._m_wait.observe((now - p.t_enqueue) * 1000.0)
+        try:
+            scores = self.predict_fn(
+                np.stack([np.asarray(p.row) for p in batch]))
+        except BaseException as e:  # resolve every waiter, never hang them
+            for p in batch:
+                p.future.set_exception(e)
+            return
+        if self._m_flushes is not None:
+            self._m_flushes.inc()
+        for p, s in zip(batch, np.asarray(scores)):
+            p.future.set_result(s)
+
+    def pump(self, now: Optional[float] = None) -> int:
+        """Flush at most one micro-batch if a trigger fired; returns the
+        number of rows flushed. The worker thread calls this in a loop;
+        tests call it directly with an injected ``now``."""
+        now = self.clock() if now is None else now
+        with self._lock:
+            if not self._flush_ready(now):
+                return 0
+            batch = self._drain()
+        # engine call happens OUTSIDE the lock: submits stay wait-free
+        # while the forward runs
+        self._flush(batch, now)
+        return len(batch)
+
+    # --------------------------------------------------------------- worker
+    def _worker(self) -> None:
+        while True:
+            with self._lock:
+                while not self._pending and not self._closed:
+                    self._wakeup.wait()
+                if self._closed and not self._pending:
+                    return
+                now = self.clock()
+                if not self._flush_ready(now):
+                    # sleep until the oldest row's deadline (or an earlier
+                    # submit fills the batch and notifies)
+                    deadline = self._pending[0].t_enqueue + self.max_wait_s
+                    self._wakeup.wait(timeout=max(deadline - now, 0.0))
+                    continue
+                batch = self._drain()
+            self._flush(batch, self.clock())
+
+    def close(self, timeout: float = 10.0) -> None:
+        """Stop accepting work, flush what is queued, join the worker."""
+        with self._lock:
+            self._closed = True
+            self._wakeup.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout)
+            self._thread = None
+        # no worker (tests / start=False): drain synchronously
+        while self._pending:
+            with self._lock:
+                batch = self._drain()
+            if batch:
+                self._flush(batch, self.clock())

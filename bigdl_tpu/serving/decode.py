@@ -1,0 +1,323 @@
+"""Incremental generation for ``transformer_lm`` with a preallocated
+KV cache and continuous-batching slots.
+
+``TransformerLM.generate`` is the offline shape of decoding: one request,
+one fori_loop, prompt and token budget baked into the compile. An online
+server cannot afford that — every (prompt_len, max_new) pair would be a
+fresh XLA program, and concurrent requests would each run their own
+batch-1 decode at ~1/slots of the achievable throughput. This module
+splits decoding the way serving systems do (Orca-style continuous
+batching):
+
+* **prefill** — one compiled program per PROMPT-LENGTH BUCKET
+  (``ops.attention_kernel.serving_prefill_buckets`` keeps the ladder on
+  the flash kernel's zero-padding block plans): the prompt, right-padded
+  to its bucket, runs once through ``model.prefill_logits`` building a
+  batch-1 K/V cache, exact because causal attention never reads past the
+  true last position and decode overwrites pad K/V before attending it;
+
+* **decode** — ONE compiled per-token step over all ``slots``
+  (``jax.vmap`` of ``model.decode_logits`` with per-slot positions), so
+  requests of different lengths and arrival times share the batch. A
+  finishing request frees its slot; the next waiting request prefills
+  into it while the others keep decoding. The whole-cache slot write is
+  a donated jitted update — no per-request cache reallocation.
+
+Greedy decoding (temperature 0) is bit-exact with the offline
+full-sequence argmax decode (the acceptance contract; see
+tests/test_serving.py) because both run the same ``prefill_logits`` /
+``decode_logits`` graph per token.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+from typing import Optional, Sequence
+
+import numpy as np
+
+from bigdl_tpu.serving.batcher import AdmissionError, _Future
+
+__all__ = ["DecodeEngine", "DecodeRequest"]
+
+
+class DecodeRequest:
+    __slots__ = ("tokens", "max_new_tokens", "temperature", "stop_token",
+                 "future", "out")
+
+    def __init__(self, tokens, max_new_tokens, temperature=0.0,
+                 stop_token=None):
+        self.tokens = [int(t) for t in tokens]
+        self.max_new_tokens = int(max_new_tokens)
+        self.temperature = float(temperature)
+        self.stop_token = stop_token
+        self.future = _Future()
+        self.out: list = []
+
+
+class DecodeEngine:
+    """Continuous-batching KV-cache decoder over a fixed slot count.
+
+    ``slots`` bounds the decode batch (and the cache HBM footprint:
+    slots x layers x kv_heads x max_len x head_dim x 2). ``submit``
+    assigns a free slot (prefill) or queues up to ``max_waiting``
+    requests, rejecting beyond that (:class:`AdmissionError` -> 429).
+    ``step`` advances every active slot one token. Without a worker
+    thread the caller drives ``step`` (tests, ``generate``); ``start()``
+    launches the decode loop for the HTTP server.
+    """
+
+    def __init__(self, model, params, *, slots: int = 4,
+                 max_len: Optional[int] = None, cache_dtype=None,
+                 prompt_buckets: Optional[Sequence[int]] = None,
+                 max_waiting: int = 64, metrics=None):
+        import jax
+        import jax.numpy as jnp
+
+        if slots < 1:
+            raise ValueError(f"slots must be >= 1, got {slots}")
+        self.model = model
+        self.params = params
+        self.slots = int(slots)
+        self.max_len = int(max_len or model.max_len)
+        self.cache_dtype = cache_dtype or model.compute_dtype or jnp.float32
+        self.max_waiting = int(max_waiting)
+        self._jax, self._jnp = jax, jnp
+
+        if prompt_buckets is None:
+            from bigdl_tpu.ops.attention_kernel import serving_prefill_buckets
+            head_dim = getattr(
+                model.encoder._modules[0].mha, "head_dim",
+                model.d_model // 4)
+            prompt_buckets = serving_prefill_buckets(
+                self.max_len, head_dim, True, self.cache_dtype)
+        self.prompt_buckets = tuple(sorted(set(int(b)
+                                               for b in prompt_buckets)))
+
+        self._lock = threading.RLock()
+        self._work = threading.Condition(self._lock)
+        self._reqs: list = [None] * self.slots
+        self._waiting: collections.deque = collections.deque()
+        self._cache = model.encoder.init_cache(self.slots, self.max_len,
+                                               self.cache_dtype)
+        self._logits = jnp.zeros((self.slots, model.vocab), jnp.float32)
+        self._pos = np.zeros(self.slots, np.int32)
+        self._temp = np.zeros(self.slots, np.float32)
+        self._key = jax.random.PRNGKey(0)
+        self._thread = None
+        self._closed = False
+
+        if metrics is not None:
+            self._m_tokens = metrics.counter(
+                "generated_tokens_total", "decode tokens emitted")
+            self._m_steps = metrics.counter(
+                "decode_steps_total", "batched decode steps executed")
+            self._m_prefills = metrics.counter(
+                "prefills_total", "prompt prefills executed")
+            self._m_prompt_tokens = metrics.counter(
+                "prompt_tokens_total", "prompt tokens prefilled")
+            self._m_rejected = metrics.counter(
+                "decode_rejected_total",
+                "generate requests fast-rejected (waiting queue full)")
+            metrics.gauge("decode_slots_active", "occupied decode slots",
+                          fn=lambda: sum(r is not None
+                                         for r in self._reqs))
+            metrics.gauge(
+                "decode_tokens_per_second",
+                "lifetime generated_tokens_total / uptime",
+                fn=lambda: (self._m_tokens.value
+                            / max(metrics.uptime_s(), 1e-9)))
+        else:
+            self._m_tokens = self._m_steps = self._m_prefills = None
+            self._m_prompt_tokens = self._m_rejected = None
+
+        # ---- compiled programs -------------------------------------------
+        def _prefill(params, tokens, last):
+            # tokens (1, bucket) int32; last = true_len - 1 (traced)
+            cache = model.encoder.init_cache(1, self.max_len,
+                                             self.cache_dtype)
+            logits, cache = model.prefill_logits(params, tokens, cache,
+                                                 last)
+            return logits[0].astype(jnp.float32), cache
+
+        self._prefill_jit = jax.jit(_prefill)  # one compile per bucket
+        # donation keeps the big cache in place on device backends; CPU
+        # can't honor it and warns on every compile
+        _don = jax.default_backend() != "cpu"
+
+        def _write_slot(cache_full, cache_one, slot):
+            return jax.tree_util.tree_map(
+                lambda f, o: jax.lax.dynamic_update_index_in_dim(
+                    f, o[0].astype(f.dtype), slot, 0),
+                cache_full, cache_one)
+
+        self._write_slot = jax.jit(_write_slot,
+                                   donate_argnums=(0,) if _don else ())
+
+        def _one(params, logits, cache1, pos, temp, key):
+            greedy = jnp.argmax(logits).astype(jnp.int32)
+            safe_t = jnp.where(temp > 0, temp, 1.0)
+            sampled = jax.random.categorical(
+                key, logits / safe_t).astype(jnp.int32)
+            tok = jnp.where(temp > 0, sampled, greedy)
+            cache_b = jax.tree_util.tree_map(lambda a: a[None], cache1)
+            lg, cache_b = model.decode_logits(params, tok[None, None],
+                                              cache_b, pos)
+            return (tok, lg[0].astype(jnp.float32),
+                    jax.tree_util.tree_map(lambda a: a[0], cache_b))
+
+        self._step_jit = jax.jit(
+            jax.vmap(_one, in_axes=(None, 0, 0, 0, 0, 0)),
+            donate_argnums=(1, 2) if _don else ())
+
+    # ------------------------------------------------------------ admission
+    def prompt_bucket_for(self, n: int) -> int:
+        for b in self.prompt_buckets:
+            if b >= n:
+                return b
+        return self.prompt_buckets[-1]
+
+    def submit(self, tokens, max_new_tokens: int,
+               temperature: float = 0.0, stop_token=None) -> _Future:
+        """Queue one generation request; the future resolves to the list
+        of generated token ids. Validates the length budget, fast-rejects
+        when the waiting queue is full."""
+        tokens = list(tokens)
+        if not tokens:
+            raise ValueError("empty prompt")
+        if max_new_tokens < 1:
+            raise ValueError(f"max_new_tokens must be >= 1, "
+                             f"got {max_new_tokens}")
+        if len(tokens) + int(max_new_tokens) > self.max_len:
+            raise ValueError(
+                f"prompt ({len(tokens)}) + max_new_tokens "
+                f"({max_new_tokens}) exceeds max_len {self.max_len}")
+        req = DecodeRequest(tokens, max_new_tokens, temperature, stop_token)
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("decode engine is closed")
+            slot = self._free_slot()
+            if slot is not None:
+                self._install(req, slot)
+            elif len(self._waiting) >= self.max_waiting:
+                if self._m_rejected is not None:
+                    self._m_rejected.inc()
+                raise AdmissionError(
+                    f"decode queue at capacity ({self.max_waiting} waiting)")
+            else:
+                self._waiting.append(req)
+            self._work.notify()
+        return req.future
+
+    def _free_slot(self) -> Optional[int]:
+        for i, r in enumerate(self._reqs):
+            if r is None:
+                return i
+        return None
+
+    # -------------------------------------------------------------- prefill
+    def _install(self, req: DecodeRequest, slot: int) -> None:
+        """Prefill ``req``'s prompt into ``slot`` (lock held)."""
+        jnp = self._jnp
+        s = len(req.tokens)
+        bucket = self.prompt_bucket_for(s)
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :s] = req.tokens
+        logits_vec, cache1 = self._prefill_jit(
+            self.params, jnp.asarray(padded), jnp.int32(s - 1))
+        self._cache = self._write_slot(self._cache, cache1,
+                                       jnp.int32(slot))
+        self._logits = self._logits.at[slot].set(logits_vec)
+        self._pos[slot] = s
+        self._temp[slot] = req.temperature
+        self._reqs[slot] = req
+        if self._m_prefills is not None:
+            self._m_prefills.inc()
+            self._m_prompt_tokens.inc(s)
+
+    # ---------------------------------------------------------------- step
+    def step(self) -> int:
+        """One batched decode step: every active slot emits one token.
+        Returns the number of active slots advanced (0 = idle). Finished
+        requests resolve their futures and hand their slot to the next
+        waiting request."""
+        jax, jnp = self._jax, self._jnp
+        with self._lock:
+            active = [i for i, r in enumerate(self._reqs) if r is not None]
+            if not active:
+                return 0
+            self._key, sub = jax.random.split(self._key)
+            keys = jax.random.split(sub, self.slots)
+            toks, self._logits, self._cache = self._step_jit(
+                self.params, self._logits, self._cache,
+                jnp.asarray(self._pos), jnp.asarray(self._temp), keys)
+            toks_host = np.asarray(toks)
+            if self._m_steps is not None:
+                self._m_steps.inc()
+                self._m_tokens.inc(len(active))
+            for i in active:
+                req = self._reqs[i]
+                tok = int(toks_host[i])
+                req.out.append(tok)
+                self._pos[i] += 1
+                done = (len(req.out) >= req.max_new_tokens
+                        or (req.stop_token is not None
+                            and tok == req.stop_token))
+                if done:
+                    self._reqs[i] = None
+                    req.future.set_result(list(req.out))
+                    if self._waiting:
+                        self._install(self._waiting.popleft(), i)
+            return len(active)
+
+    def generate(self, tokens, max_new_tokens: int,
+                 temperature: float = 0.0, stop_token=None) -> list:
+        """Synchronous single-request convenience: submit + drive the
+        decode loop until this request resolves (other queued requests
+        keep advancing alongside — continuous batching has no 'exclusive'
+        mode)."""
+        fut = self.submit(tokens, max_new_tokens, temperature, stop_token)
+        if self._thread is None:
+            while not fut.done():
+                if self.step() == 0 and not fut.done():
+                    raise RuntimeError(
+                        "decode engine idle with unresolved request")
+        return fut.result()
+
+    # --------------------------------------------------------------- worker
+    def start(self) -> None:
+        """Launch the decode loop thread (server mode)."""
+        if self._thread is not None:
+            return
+
+        def _loop():
+            while True:
+                with self._lock:
+                    while (not self._closed
+                           and not any(r is not None for r in self._reqs)):
+                        self._work.wait()
+                    if self._closed:
+                        return
+                self.step()
+
+        self._thread = threading.Thread(target=_loop, name="decode-loop",
+                                        daemon=True)
+        self._thread.start()
+
+    def close(self, timeout: float = 10.0) -> None:
+        with self._lock:
+            self._closed = True
+            for req in list(self._waiting):
+                req.future.set_exception(
+                    RuntimeError("decode engine closed"))
+            self._waiting.clear()
+            for i, req in enumerate(self._reqs):
+                if req is not None:
+                    self._reqs[i] = None
+                    req.future.set_exception(
+                        RuntimeError("decode engine closed mid-request"))
+            self._work.notify_all()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout)

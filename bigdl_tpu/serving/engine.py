@@ -1,0 +1,268 @@
+"""Online inference engine: bucketed pre-compiled forwards over a
+restored eval-mode module.
+
+The training half of the stack compiles ONE step shape and reuses it for
+hours; serving sees a new batch geometry on every request. Left to
+``jax.jit`` alone that means a fresh XLA compile per distinct request
+count — tens of seconds of p99 on a TPU for a shape the compile cache
+has never seen. The engine therefore admits only a fixed, declared set
+of batch **buckets**: a request of n rows pads up to the smallest bucket
+>= n (chunking through the largest bucket first when n exceeds it), so
+the compile cache is bounded by ``len(buckets)`` programs per input
+geometry and the steady state recompiles nothing. Padding waste is
+metered (``padded_rows_total`` vs ``rows_total``) so the bucket ladder
+can be re-fit to observed traffic.
+
+The same tuned program the perf harness measured is what serves: the
+caller installs ``--fusedBN``/``--convLayout``/``--convGeom``/
+``--autotune`` before construction (cli/serve.py mirrors the perf
+flags), inputs are donated into the jitted forward, activations
+optionally run bf16, and the tpulint pre-flight (`bigdl_tpu.analysis`)
+runs over the exact serving graph BEFORE the first compile — strict mode
+refuses to serve a graph with error-severity findings.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Optional, Sequence
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["InferenceEngine", "power_of_two_buckets"]
+
+
+def power_of_two_buckets(max_batch: int, min_bucket: int = 1) -> tuple:
+    """The default bucket ladder: powers of two from ``min_bucket`` up to
+    and including ``max_batch`` (which is always a member, power of two
+    or not) — log2(max_batch) compiles bound the cache, and tail batches
+    waste at most half a bucket."""
+    if max_batch < 1:
+        raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+    out = []
+    b = max(1, min_bucket)
+    while b < max_batch:
+        out.append(b)
+        b *= 2
+    out.append(max_batch)
+    return tuple(sorted(set(out)))
+
+
+class InferenceEngine:
+    """Eval-mode forward over fixed batch buckets.
+
+    ``predict_scores(x)`` accepts any row count, pads each chunk to a
+    bucket, runs the compiled forward, and strips the padding — output
+    is row-for-row what an unpadded forward would produce (padding rows
+    never influence real rows: eval-mode modules are row-independent;
+    BN runs on frozen stats).
+
+    ``compute_dtype`` (e.g. bf16) casts floating inputs before the
+    module — int inputs (LM tokens) pass through and the module's own
+    ``compute_dtype`` handles the post-embedding cast.
+    """
+
+    def __init__(self, module, params, mod_state=None, *,
+                 buckets: Sequence[int] = (1, 2, 4, 8, 16, 32),
+                 compute_dtype=None, donate_inputs: bool = True,
+                 lint: Optional[str] = None, metrics=None):
+        import jax
+
+        self.module = module
+        self.params = params
+        self.mod_state = (mod_state if mod_state is not None
+                          else module.init_state())
+        self.buckets = tuple(sorted(set(int(b) for b in buckets)))
+        if not self.buckets or self.buckets[0] < 1:
+            raise ValueError(f"buckets must be positive ints, got {buckets}")
+        self.compute_dtype = compute_dtype
+        self.donate_inputs = donate_inputs
+        self.lint_mode = lint if lint in ("on", "strict") else None
+        self.lint_annotation = None
+        self._linted = False
+        self._compiled = {}  # (bucket, feat_shape, dtype_str) -> jitted fn
+        self._compile_lock = threading.Lock()
+
+        if metrics is not None:
+            self._m_rows = metrics.counter(
+                "rows_total", "input rows submitted to the engine")
+            self._m_pad = metrics.counter(
+                "padded_rows_total",
+                "bucket-padding rows (waste) run alongside real rows")
+            self._m_compiles = metrics.counter(
+                "compiles_total", "distinct (bucket, geometry) compiles")
+            metrics.gauge(
+                "padding_waste_fraction",
+                "padded_rows_total / (rows_total + padded_rows_total)",
+                fn=self._padding_waste)
+        else:
+            self._m_rows = self._m_pad = self._m_compiles = None
+
+        def fwd(params, mod_state, x):
+            import jax.numpy as jnp
+            if (self.compute_dtype is not None
+                    and jnp.issubdtype(x.dtype, jnp.floating)):
+                x = x.astype(self.compute_dtype)
+            y, _ = module.apply(params, mod_state, x, training=False)
+            return y
+
+        self._fwd = fwd
+        self._jax = jax
+
+    # -------------------------------------------------------- construction
+    @classmethod
+    def from_checkpoint(cls, module, path: str, **kw) -> "InferenceEngine":
+        """Restore an inference-only view of a training checkpoint
+        (params + mod_state, no optimizer state — single-blob model.<n>
+        or sharded orbax; clean SystemExit on missing/corrupt)."""
+        from bigdl_tpu.utils.orbax_ckpt import restore_for_inference
+        params, mod_state = restore_for_inference(path)
+        return cls(module, params, mod_state, **kw)
+
+    def _padding_waste(self) -> float:
+        if self._m_rows is None:
+            return 0.0
+        real, pad = self._m_rows.value, self._m_pad.value
+        total = real + pad
+        return (pad / total) if total else 0.0
+
+    # --------------------------------------------------------------- lint
+    def preflight_lint(self, feat_shape, dtype) -> int:
+        """tpulint over the exact serving forward (largest bucket) before
+        anything compiles. Returns the report's exit code (0 = serve;
+        nonzero = strict mode found error-severity findings). The
+        summary annotation is kept for provenance stamping either way."""
+        if self.lint_mode is None or self._linted:
+            return 0
+        self._linted = True
+        import jax
+
+        from bigdl_tpu.analysis import lint_fn
+        from bigdl_tpu.cli.common import run_preflight_lint
+
+        x = jax.ShapeDtypeStruct((self.buckets[-1],) + tuple(feat_shape),
+                                 dtype)
+        jitted = jax.jit(self._fwd)
+        report = lint_fn(jitted, self.params, self.mod_state, x)
+        rc, ann = run_preflight_lint(report,
+                                     strict=(self.lint_mode == "strict"))
+        self.lint_annotation = ann if rc == 0 else report.annotation()
+        return rc
+
+    # ------------------------------------------------------------- compile
+    def _get_compiled(self, bucket: int, feat_shape: tuple, dtype):
+        key = (bucket, feat_shape, str(dtype))
+        fn = self._compiled.get(key)
+        if fn is not None:
+            return fn
+        with self._compile_lock:
+            fn = self._compiled.get(key)
+            if fn is None:
+                if self.lint_mode is not None and not self._linted:
+                    rc = self.preflight_lint(feat_shape, dtype)
+                    if rc:
+                        raise SystemExit(rc)
+                # CPU can't donate (XLA copies + warns every compile);
+                # the buffer-reuse win only exists on device backends
+                donate = ((2,) if self.donate_inputs
+                          and self._jax.default_backend() != "cpu" else ())
+                fn = self._jax.jit(self._fwd, donate_argnums=donate)
+                self._compiled[key] = fn
+                if self._m_compiles is not None:
+                    self._m_compiles.inc()
+                logger.info("serving compile: bucket=%d feat=%s dtype=%s "
+                            "(%d cached)", bucket, feat_shape, dtype,
+                            len(self._compiled))
+        return fn
+
+    def warmup(self, feat_shape, dtype=np.float32,
+               buckets: Optional[Sequence[int]] = None) -> None:
+        """Pre-compile (and execute once, so XLA autotuning settles)
+        every bucket at the given input geometry — pays the compile cost
+        at startup instead of on the first unlucky request."""
+        for b in (buckets or self.buckets):
+            x = np.zeros((b,) + tuple(feat_shape), dtype)
+            fn = self._get_compiled(b, tuple(feat_shape), np.dtype(dtype))
+            np.asarray(fn(self.params, self.mod_state,
+                          self._jax.numpy.asarray(x)))
+
+    # ------------------------------------------------------------- predict
+    def bucket_for(self, n: int) -> int:
+        """Smallest bucket >= n, or the largest bucket (callers chunk)."""
+        for b in self.buckets:
+            if b >= n:
+                return b
+        return self.buckets[-1]
+
+    def predict_scores(self, x) -> np.ndarray:
+        """Raw model outputs for every row of ``x`` (any row count)."""
+        x = np.asarray(x)
+        n = len(x)
+        if n == 0:
+            return np.zeros((0,), np.float32)
+        feat_shape = tuple(x.shape[1:])
+        dtype = x.dtype
+        outs = []
+        i = 0
+        while i < n:
+            take = min(n - i, self.buckets[-1])
+            bucket = self.bucket_for(take)
+            chunk = x[i:i + take]
+            pad = bucket - take
+            if pad > 0:
+                # repeat the last real row (a benign, in-distribution
+                # filler — all-zeros can NaN under log/normalization)
+                chunk = np.concatenate(
+                    [chunk, np.repeat(chunk[-1:], pad, axis=0)])
+            fn = self._get_compiled(bucket, feat_shape, dtype)
+            y = fn(self.params, self.mod_state,
+                   self._jax.numpy.asarray(chunk))
+            outs.append(np.asarray(y)[:take])
+            if self._m_rows is not None:
+                self._m_rows.inc(take)
+                self._m_pad.inc(pad)
+            i += take
+        return np.concatenate(outs)
+
+    def predict(self, x) -> np.ndarray:
+        """Argmax class ids (the Classifier-compatible surface)."""
+        scores = self.predict_scores(x)
+        if len(scores) == 0:
+            return np.zeros((0,), np.int64)
+        return np.argmax(scores, axis=-1)
+
+    # ---------------------------------------------------------- provenance
+    def provenance(self) -> dict:
+        """Serving config provenance for /metrics scrapes and bench JSON
+        lines — the same fields the perf harness stamps (bn_fused, conv
+        layout source, autotune mode, lint summary) plus the bucket set,
+        so every latency number is attributable to an exact program."""
+        from bigdl_tpu import tuning
+        from bigdl_tpu.nn.norm import bn_fused_mode
+        from bigdl_tpu.ops.conv2d import (conv_layouts_if_nondefault,
+                                          geom_policy_if_any)
+        out = {
+            "buckets": ",".join(str(b) for b in self.buckets),
+            "compute_dtype": (np.dtype(self.compute_dtype).name
+                              if self.compute_dtype is not None
+                              else "float32"),
+            "bn_fused": bn_fused_mode(self.module),
+            "autotune": tuning.get_mode(),
+        }
+        cl = conv_layouts_if_nondefault()
+        out["conv_layouts"] = ("/".join(f"{k}={v}" for k, v in
+                                        sorted(cl.items()))
+                               if cl else "default")
+        gp = geom_policy_if_any()
+        out["conv_geom_decisions"] = len(gp) if gp else 0
+        ann = self.lint_annotation
+        if isinstance(ann, dict):
+            out["lint"] = (f"{ann.get('errors', 0)}e/"
+                           f"{ann.get('warnings', 0)}w/"
+                           f"{ann.get('infos', 0)}i")
+        elif ann is not None:
+            out["lint"] = str(ann)
+        return out

@@ -1,0 +1,259 @@
+"""Stdlib HTTP serving surface: JSON in, JSON out, no new dependencies.
+
+BigDL 2.0's Cluster Serving put a full streaming stack (Redis + Flink)
+in front of the model; the TPU-native equivalent starts smaller and
+honest: a ``ThreadingHTTPServer`` (one thread per connection, fine at
+micro-batcher concurrency levels) exposing
+
+* ``POST /predict``  — ``{"inputs": [...]}`` -> argmax predictions
+  (scores on request), routed through the dynamic micro-batcher so
+  concurrent callers share bucketed forwards;
+* ``POST /generate`` — ``{"tokens": [...], "max_new_tokens": N}`` ->
+  generated token ids from the continuous-batching KV-cache decoder
+  (LM models only);
+* ``GET /healthz``   — liveness;
+* ``GET /metrics``   — plaintext counters/histograms with the serving
+  config provenance stamped into every scrape.
+
+Error contract: malformed JSON/fields -> 400, admission rejection
+(queue full) -> 429 with ``Retry-After``, engine failure -> 500; every
+error body is ``{"error": ...}``.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+import numpy as np
+
+from bigdl_tpu.serving.batcher import AdmissionError
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["ServingApp", "make_server", "run_server"]
+
+_MAX_BODY = 64 * 1024 * 1024  # refuse absurd payloads before np.asarray
+
+
+class ServingApp:
+    """The wiring between HTTP handlers and the serving stack: engine
+    (+ optional batcher) for /predict, decoder for /generate, one
+    metrics registry for everything. Endpoint handlers return
+    ``(status, payload_dict)`` so they are unit-testable without
+    sockets."""
+
+    def __init__(self, *, name: str, metrics, engine=None, batcher=None,
+                 decoder=None, request_timeout_s: float = 120.0):
+        self.name = name
+        self.metrics = metrics
+        self.engine = engine
+        self.batcher = batcher
+        self.decoder = decoder
+        self.request_timeout_s = float(request_timeout_s)
+        self._m_requests = {
+            ep: metrics.counter(f"requests_{ep}_total",
+                                f"completed /{ep} requests")
+            for ep in ("predict", "generate")}
+        self._m_errors = metrics.counter(
+            "request_errors_total", "requests answered 4xx/5xx")
+        self._m_latency = {
+            ep: metrics.histogram(f"latency_{ep}_ms",
+                                  f"/{ep} request latency (receipt to "
+                                  f"response ready)")
+            for ep in ("predict", "generate")}
+
+    # ------------------------------------------------------------ endpoints
+    def handle_healthz(self):
+        return 200, {"status": "ok", "model": self.name}
+
+    def handle_predict(self, payload: dict):
+        if self.engine is None:
+            return 400, {"error": "no /predict engine for this model"}
+        inputs = payload.get("inputs")
+        if inputs is None:
+            return 400, {"error": "missing 'inputs'"}
+        try:
+            x = np.asarray(inputs)
+            if x.dtype == object:
+                raise ValueError("ragged inputs")
+            if np.issubdtype(x.dtype, np.floating):
+                x = x.astype(np.float32)
+            elif np.issubdtype(x.dtype, np.integer):
+                x = x.astype(np.int32)
+            else:
+                raise ValueError(f"unsupported dtype {x.dtype}")
+        except ValueError as e:
+            return 400, {"error": f"bad inputs: {e}"}
+        if x.ndim < 2:
+            return 400, {"error": "inputs must be a batch (rows on "
+                                  "axis 0)"}
+        if self.batcher is not None:
+            futs = [self.batcher.submit(row) for row in x]
+            scores = np.stack([f.result(self.request_timeout_s)
+                               for f in futs])
+        else:
+            scores = self.engine.predict_scores(x)
+        preds = np.argmax(scores, axis=-1)
+        out = {"predictions": preds.tolist()}
+        if payload.get("return_scores"):
+            out["scores"] = np.asarray(scores, np.float64).tolist()
+        return 200, out
+
+    def handle_generate(self, payload: dict):
+        if self.decoder is None:
+            return 400, {"error": "no /generate decoder for this model "
+                                  "(serve a transformer_lm* model)"}
+        tokens = payload.get("tokens")
+        if (not isinstance(tokens, (list, tuple)) or not tokens
+                or not all(isinstance(t, int) for t in tokens)):
+            return 400, {"error": "'tokens' must be a non-empty list of "
+                                  "ints"}
+        max_new = payload.get("max_new_tokens", 16)
+        temperature = payload.get("temperature", 0.0)
+        stop = payload.get("stop_token")
+        try:
+            fut = self.decoder.submit(tokens, max_new, temperature, stop)
+        except ValueError as e:
+            return 400, {"error": str(e)}
+        out_tokens = fut.result(self.request_timeout_s)
+        return 200, {"tokens": out_tokens,
+                     "prompt_len": len(tokens)}
+
+    def handle_metrics(self) -> str:
+        return self.metrics.render()
+
+    # ------------------------------------------------------------- dispatch
+    def dispatch_post(self, path: str, payload: dict):
+        ep = path.strip("/")
+        handler = {"predict": self.handle_predict,
+                   "generate": self.handle_generate}.get(ep)
+        if handler is None:
+            return 404, {"error": f"unknown endpoint {path}"}
+        import time
+        t0 = time.perf_counter()
+        try:
+            status, body = handler(payload)
+        except AdmissionError as e:
+            self._m_errors.inc()
+            return 429, {"error": str(e)}
+        except TimeoutError as e:
+            self._m_errors.inc()
+            return 503, {"error": str(e)}
+        except Exception as e:
+            logger.exception("/%s failed", ep)
+            self._m_errors.inc()
+            return 500, {"error": f"{type(e).__name__}: {e}"}
+        if status == 200:
+            self._m_requests[ep].inc()
+            self._m_latency[ep].observe((time.perf_counter() - t0) * 1000.0)
+        else:
+            self._m_errors.inc()
+        return status, body
+
+    def close(self) -> None:
+        if self.batcher is not None:
+            self.batcher.close()
+        if self.decoder is not None:
+            self.decoder.close()
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    @property
+    def app(self) -> ServingApp:
+        return self.server.app  # type: ignore[attr-defined]
+
+    def _send_json(self, status: int, body: dict) -> None:
+        data = json.dumps(body).encode()
+        self.send_response(status)
+        if status == 429:
+            self.send_header("Retry-After", "1")
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def do_GET(self):  # noqa: N802 (stdlib naming)
+        if self.path == "/healthz":
+            self._send_json(*self.app.handle_healthz())
+        elif self.path == "/metrics":
+            data = self.app.handle_metrics().encode()
+            self.send_response(200)
+            self.send_header("Content-Type",
+                             "text/plain; version=0.0.4")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+        else:
+            self._send_json(404, {"error": f"unknown path {self.path}"})
+
+    def do_POST(self):  # noqa: N802
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+        except ValueError:
+            length = 0
+        if length <= 0 or length > _MAX_BODY:
+            self._send_json(400, {"error": "missing or oversized body"})
+            return
+        try:
+            payload = json.loads(self.rfile.read(length))
+            if not isinstance(payload, dict):
+                raise ValueError("body must be a JSON object")
+        except (ValueError, json.JSONDecodeError) as e:
+            self._send_json(400, {"error": f"bad JSON: {e}"})
+            return
+        self._send_json(*self.app.dispatch_post(self.path, payload))
+
+    def log_message(self, fmt, *args):  # route access logs to logging
+        logger.debug("%s - %s", self.address_string(), fmt % args)
+
+
+def make_server(app: ServingApp, host: str = "127.0.0.1",
+                port: int = 8000) -> ThreadingHTTPServer:
+    """Bind (port 0 = ephemeral, for tests) and attach the app; the
+    caller runs ``serve_forever`` (or a thread does)."""
+    srv = ThreadingHTTPServer((host, port), _Handler)
+    srv.daemon_threads = True
+    srv.app = app  # type: ignore[attr-defined]
+    return srv
+
+
+def run_server(app: ServingApp, host: str = "127.0.0.1",
+               port: int = 8000,
+               ready_event: Optional[threading.Event] = None) -> int:
+    """Foreground serve loop with clean SIGINT/SIGTERM shutdown (the CI
+    smoke asserts exit code 0 after SIGTERM). Returns 0."""
+    import signal
+
+    srv = make_server(app, host, port)
+    actual = srv.server_address[1]
+    logger.info("serving %s on http://%s:%d (/predict /generate /healthz "
+                "/metrics)", app.name, host, actual)
+    print(f"serving {app.name} on http://{host}:{actual}", flush=True)
+
+    def _stop(signum, frame):
+        # shutdown() must come from another thread than serve_forever's
+        threading.Thread(target=srv.shutdown, daemon=True).start()
+
+    prev = {}
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        try:
+            prev[sig] = signal.signal(sig, _stop)
+        except ValueError:  # non-main thread (tests drive make_server)
+            pass
+    if ready_event is not None:
+        ready_event.set()
+    try:
+        srv.serve_forever(poll_interval=0.2)
+    finally:
+        for sig, h in prev.items():
+            signal.signal(sig, h)
+        srv.server_close()
+        app.close()
+        print("serving shutdown clean", flush=True)
+    return 0

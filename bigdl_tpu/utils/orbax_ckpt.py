@@ -24,7 +24,8 @@ from bigdl_tpu.utils.file import latest_checkpoint as latest_sharded  # noqa: F4
 # orbax snapshots are directories, but the <prefix><n> selection logic is
 # identical to the single-blob case — one helper serves both
 
-__all__ = ["save_sharded", "restore_sharded", "latest_sharded"]
+__all__ = ["save_sharded", "restore_sharded", "latest_sharded",
+           "restore_for_inference"]
 
 
 def save_sharded(tree: Any, path: str, overwrite: bool = False) -> None:
@@ -68,6 +69,53 @@ def save_sharded(tree: Any, path: str, overwrite: bool = False) -> None:
     barrier(f"ckpt-clean:{path}")
     with ocp.Checkpointer(ocp.StandardCheckpointHandler()) as ckptr:
         ckptr.save(path, tree)
+
+
+def restore_for_inference(path: str) -> tuple:
+    """Inference-only restore: ``(params, mod_state)`` from a TRAINING
+    checkpoint, never touching optimizer state — the serving engine
+    (bigdl_tpu.serving) loads ``model.<n>`` artifacts directly, whether
+    they are single-blob ``save_pytree`` files or sharded orbax
+    directories. ``path`` may be:
+
+    * a checkpoint directory — the newest ``model.<n>`` entry is used
+      (``state.<n>`` is ignored by construction: no pairing needed when
+      there is no optimizer to resume);
+    * a single ``model.<n>`` blob file (or a whole-model ``save_module``
+      file — the embedded definition is ignored, weights only);
+    * one orbax snapshot directory written by :func:`save_sharded`.
+
+    A missing or corrupt checkpoint raises a clean ``SystemExit`` with
+    the path and cause — a serving launch must fail with one actionable
+    line, not an np.load/orbax traceback (same contract as the CLI flag
+    validation errors)."""
+    from bigdl_tpu.utils.file import exists, isdir, latest_checkpoint
+
+    if not exists(path):
+        raise SystemExit(f"checkpoint {path}: does not exist")
+    target = path
+    if isdir(path):
+        newest = latest_checkpoint(path, "model.")
+        if newest is not None:
+            target = newest
+        # else: the directory itself may BE one orbax snapshot
+    try:
+        if isdir(target):
+            blob = restore_sharded(target)
+        else:
+            from bigdl_tpu.utils.file import load_pytree
+            blob = load_pytree(target)
+    except SystemExit:
+        raise
+    except Exception as e:  # np/zip/pickle/orbax corruption all land here
+        raise SystemExit(
+            f"checkpoint {target}: failed to load "
+            f"({type(e).__name__}: {e})")
+    if not isinstance(blob, dict) or "params" not in blob:
+        raise SystemExit(
+            f"checkpoint {target}: not a model checkpoint (no 'params' "
+            f"entry — did you point at a state.<n> optimizer blob?)")
+    return blob["params"], blob.get("mod_state")
 
 
 def restore_sharded(path: str, like: Optional[Any] = None) -> Any:

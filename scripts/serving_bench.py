@@ -1,0 +1,288 @@
+#!/usr/bin/env python
+"""Closed-loop load generator for the `bigdl-tpu serve` endpoint
+(ISSUE 5 satellite) — the serving analog of the perf harness: drive
+/predict or /generate at a fixed concurrency, report client-side latency
+quantiles (p50/p95/p99) and throughput, and stamp the SERVER's config
+provenance (scraped from /metrics) into the emitted JSON line so every
+result is attributable to an exact program — the perf-JSON contract from
+PRs 2-4 extended to serving.
+
+    # spawn a server on an ephemeral port, bench, shut down
+    python scripts/serving_bench.py --model lenet5 --randomInit \
+        --requests 64 --concurrency 4 --platform cpu
+
+    # bench an already-running server
+    python scripts/serving_bench.py --url http://127.0.0.1:8000 \
+        --model resnet50 --endpoint predict --batch 4
+
+    # CI smoke: tiny config, asserts endpoints + metrics + clean shutdown
+    python scripts/serving_bench.py --smoke --model transformer_lm \
+        --platform cpu
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# input geometry per perf-zoo family (serving payload synthesis); LMs
+# take their length from --seq
+_SHAPES = {"lenet5": (28, 28, 1), "resnet20_cifar": (32, 32, 3)}
+_DEFAULT_SHAPE = (224, 224, 3)
+
+# tiny-LM dims for --smoke / --randomInit LM runs: CPU-fast, same code
+# path as the 32k-vocab production config
+_SMOKE_LM = ["--vocabSize", "64", "--dModel", "32", "--numLayers", "2",
+             "--numHeads", "2", "--seq", "64", "--slots", "2",
+             "--buckets", "1,2,4", "--maxWaitMs", "2"]
+
+
+def _post(url, body, timeout=120.0):
+    req = urllib.request.Request(
+        url, data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, json.loads(r.read())
+
+
+def _get(url, timeout=30.0):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.status, r.read().decode()
+
+
+def _percentile(sorted_vals, q):
+    if not sorted_vals:
+        return None
+    i = min(int(q * len(sorted_vals)), len(sorted_vals) - 1)
+    return sorted_vals[i]
+
+
+def spawn_server(args, extra):
+    """Launch `bigdl-tpu serve` as a child on an ephemeral port; parse
+    the bound port from its stdout. Returns (proc, base_url, log_lines).
+    """
+    cmd = [sys.executable, "-m", "bigdl_tpu.cli.main", "serve",
+           args.model, "--port", "0"]
+    if args.ckpt:
+        cmd += ["--model", args.ckpt]
+    else:
+        cmd += ["--randomInit"]
+    if args.platform:
+        cmd += ["--platform", args.platform]
+    if args.model.startswith("transformer_lm") and (args.smoke
+                                                    or not args.ckpt):
+        cmd += _SMOKE_LM
+    cmd += extra
+    proc = subprocess.Popen(cmd, cwd=REPO, stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT, text=True)
+    lines, port = [], None
+    port_re = re.compile(r"serving .+ on http://[^:]+:(\d+)")
+    ready = threading.Event()
+
+    def _reader():
+        nonlocal port
+        for line in proc.stdout:
+            lines.append(line.rstrip())
+            m = port_re.search(line)
+            if m:
+                port = int(m.group(1))
+                ready.set()
+        ready.set()  # EOF: unblock the waiter even on startup failure
+
+    threading.Thread(target=_reader, daemon=True).start()
+    if not ready.wait(timeout=300) or port is None:
+        proc.kill()
+        raise SystemExit("server never reported its port; log tail:\n"
+                         + "\n".join(lines[-20:]))
+    url = f"http://127.0.0.1:{port}"
+    deadline = time.time() + 120
+    while time.time() < deadline:
+        try:
+            if _get(url + "/healthz", timeout=5)[0] == 200:
+                return proc, url, lines
+        except (urllib.error.URLError, ConnectionError, OSError):
+            time.sleep(0.2)
+    proc.kill()
+    raise SystemExit("server bound but /healthz never answered")
+
+
+def make_payload(args):
+    import numpy as np
+    rng = np.random.RandomState(0)
+    if args.endpoint == "generate":
+        seq = args.promptLen
+        return {"tokens": rng.randint(1, 50, seq).tolist(),
+                "max_new_tokens": args.maxNewTokens}
+    if args.model.startswith("transformer_lm"):
+        seq = 64 if (args.smoke or not args.ckpt) else (args.seq or 512)
+        x = rng.randint(0, 50, (args.batch, seq)).tolist()
+    else:
+        shape = _SHAPES.get(args.model, _DEFAULT_SHAPE)
+        x = rng.randn(args.batch, *shape).astype("float32").tolist()
+    return {"inputs": x}
+
+
+def closed_loop(url, args):
+    """N workers, each fire-wait-fire until the shared budget drains."""
+    payload = make_payload(args)
+    path = f"{url}/{args.endpoint}"
+    lat, errors, lock = [], [0], threading.Lock()
+    budget = [args.requests]
+    new_tokens = [0]
+
+    def worker():
+        while True:
+            with lock:
+                if budget[0] <= 0:
+                    return
+                budget[0] -= 1
+            t0 = time.perf_counter()
+            try:
+                _, out = _post(path, payload)
+                dt = (time.perf_counter() - t0) * 1000.0
+                with lock:
+                    lat.append(dt)
+                    if args.endpoint == "generate":
+                        new_tokens[0] += len(out.get("tokens", []))
+            except Exception:
+                with lock:
+                    errors[0] += 1
+
+    t0 = time.perf_counter()
+    threads = [threading.Thread(target=worker)
+               for _ in range(args.concurrency)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    lat.sort()
+    out = {
+        "bench": "serving",
+        "model": args.model,
+        "endpoint": args.endpoint,
+        "requests": args.requests,
+        "concurrency": args.concurrency,
+        "batch": args.batch if args.endpoint == "predict" else None,
+        "wall_s": round(wall, 4),
+        "rps": round(len(lat) / wall, 2) if wall else None,
+        "errors": errors[0],
+        "latency_ms": {
+            "p50": round(_percentile(lat, 0.50), 3) if lat else None,
+            "p95": round(_percentile(lat, 0.95), 3) if lat else None,
+            "p99": round(_percentile(lat, 0.99), 3) if lat else None,
+            "mean": round(sum(lat) / len(lat), 3) if lat else None,
+            "max": round(lat[-1], 3) if lat else None,
+        },
+    }
+    if args.endpoint == "generate":
+        out["tokens_per_second"] = (round(new_tokens[0] / wall, 1)
+                                    if wall else None)
+    return out
+
+
+def scrape_provenance(url):
+    _, page = _get(url + "/metrics")
+    for line in page.splitlines():
+        if line.startswith("# provenance "):
+            return json.loads(line[len("# provenance "):]), page
+    return None, page
+
+
+def run_smoke(url, args, page_checks=True):
+    """Tiny assertion pass: every endpoint answers, metrics count."""
+    st, _ = _get(url + "/healthz")
+    assert st == 200, f"/healthz -> {st}"
+    args.endpoint, args.batch, args.requests = "predict", 2, 4
+    args.concurrency = 2
+    res = closed_loop(url, args)
+    assert res["errors"] == 0, f"predict errors: {res}"
+    if args.model.startswith("transformer_lm"):
+        args.endpoint = "generate"
+        args.promptLen, args.maxNewTokens = 5, 4
+        gen = closed_loop(url, args)
+        assert gen["errors"] == 0, f"generate errors: {gen}"
+        assert gen["tokens_per_second"], gen
+    prov, page = scrape_provenance(url)
+    assert prov is not None, "metrics page lost its provenance line"
+    assert "requests_predict_total" in page
+    for needle in ("bn_fused", "autotune", "buckets", "conv_layouts"):
+        assert needle in prov, f"provenance missing {needle}: {prov}"
+    count = [l for l in page.splitlines()
+             if l.startswith("bigdl_serving_requests_predict_total ")]
+    assert count and float(count[0].split()[-1]) >= 4, count
+    print("smoke: endpoints + metrics provenance OK", flush=True)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser("serving_bench")
+    p.add_argument("--model", default="lenet5",
+                   help="perf-zoo name (payload geometry + spawn target)")
+    p.add_argument("--url", default=None,
+                   help="bench an already-running server instead of "
+                        "spawning one")
+    p.add_argument("--ckpt", default=None,
+                   help="checkpoint for the spawned server (default "
+                        "--randomInit)")
+    p.add_argument("--endpoint", default="predict",
+                   choices=["predict", "generate"])
+    p.add_argument("--requests", type=int, default=64)
+    p.add_argument("--concurrency", type=int, default=4)
+    p.add_argument("--batch", type=int, default=1,
+                   help="rows per /predict request")
+    p.add_argument("--promptLen", type=int, default=16)
+    p.add_argument("--maxNewTokens", type=int, default=16)
+    p.add_argument("--seq", type=int, default=None)
+    p.add_argument("--platform", default=None, choices=["cpu", "tpu"])
+    p.add_argument("--smoke", action="store_true",
+                   help="assertion pass + clean-shutdown check (CI)")
+    p.add_argument("--serveArg", action="append", default=[],
+                   metavar="ARG",
+                   help="extra flag forwarded to the spawned serve CLI "
+                        "(repeatable), e.g. --serveArg=--fusedBN "
+                        "--serveArg=apply")
+    args = p.parse_args(argv)
+
+    proc = None
+    if args.url:
+        url = args.url.rstrip("/")
+    else:
+        proc, url, log_lines = spawn_server(args, args.serveArg)
+    try:
+        if args.smoke:
+            run_smoke(url, args)
+        else:
+            res = closed_loop(url, args)
+            prov, _ = scrape_provenance(url)
+            res["provenance"] = prov
+            print(json.dumps(res), flush=True)
+    finally:
+        if proc is not None:
+            proc.send_signal(signal.SIGTERM)
+            try:
+                rc = proc.wait(timeout=60)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                raise SystemExit("server ignored SIGTERM")
+            if args.smoke:
+                assert rc == 0, f"server exit code {rc} after SIGTERM"
+                assert any("serving shutdown clean" in l
+                           for l in log_lines), \
+                    "missing clean-shutdown marker in server log"
+                print("smoke: clean shutdown OK (rc=0)", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,76 @@
+#!/usr/bin/env bash
+# Round-10 capture: ISSUE 5 (serving) chip evidence. The serving path is
+# CPU-verified end-to-end (tests/test_serving.py, tier1 smoke); what only
+# a chip can tell us is the LATENCY/THROUGHPUT shape of the tuned program
+# under load — p50/p95/p99 vs batch size through the micro-batcher,
+# decode tokens/s vs slot count, and whether the tuned config
+# (--fusedBN apply / --autotune cached / probe conv layouts) moves
+# serving latency the way it moved training MFU. Every bench JSON line
+# carries the server's /metrics provenance, so tuned-vs-default rows are
+# self-describing (PERF.md §13 slots). Appends to $OUT, mirrored into
+# the repo per step.
+
+set -uo pipefail
+cd "$(dirname "$0")/.."
+OUT="${OUT:-/tmp/tpu_capture_r10.log}"
+REPO_LOG="${REPO_LOG:-TPU_CAPTURE_r10.log}"
+trap 'cp -f "$OUT" "$REPO_LOG" 2>/dev/null || true' EXIT
+
+step() {
+  local name="$1" tmo="$2"; shift 2
+  echo "=== $name ($(date -u +%H:%M:%SZ))" | tee -a "$OUT"
+  timeout "$tmo" "$@" 2>&1 | tail -30 | tee -a "$OUT"
+  echo "=== end $name rc=$?" | tee -a "$OUT"
+  cp -f "$OUT" "$REPO_LOG" 2>/dev/null || true
+}
+
+# 0. compiled-path tests first (serving reuses the Pallas kernels; a
+#    broken flash/BN path would poison every number below)
+step "pytest_tpu_marked" 1200 env BIGDL_TPU_TESTS=1 python -m pytest tests/ -m tpu -q
+step "pytest_serving" 600 python -m pytest tests/test_serving.py -q
+
+# 1. lenet5 sanity leg: the smallest model isolates the HTTP + batcher
+#    overhead floor from model compute (compare its p50 against the
+#    resnet legs below)
+step "serve_lenet5_b1" 900 python scripts/serving_bench.py \
+  --model lenet5 --requests 256 --concurrency 8 --batch 1
+step "serve_lenet5_b8" 900 python scripts/serving_bench.py \
+  --model lenet5 --requests 256 --concurrency 8 --batch 8
+
+# 2. resnet50 A/B: default config vs the tuned program the training
+#    benchmarks measured (fused BN apply + cached autotune decisions).
+#    Same bucket ladder both legs; provenance in each JSON line is the
+#    diff. b1 = latency-bound, b8 = bucket-throughput-bound.
+for B in 1 8; do
+  step "serve_resnet50_default_b${B}" 1800 python scripts/serving_bench.py \
+    --model resnet50 --requests 128 --concurrency 8 --batch "$B"
+  step "serve_resnet50_tuned_b${B}" 1800 python scripts/serving_bench.py \
+    --model resnet50 --requests 128 --concurrency 8 --batch "$B" \
+    --serveArg=--fusedBN --serveArg=apply --serveArg=--autotune \
+    --serveArg=cached
+done
+
+# 3. transformer_lm decode: tokens/s vs continuous-batching slot count
+#    (1 slot = sequential baseline; 4/8 = shared decode batches), then
+#    the tuned-config A/B at the production 512-seq config.
+for S in 1 4 8; do
+  step "serve_lm_slots${S}" 1800 python scripts/serving_bench.py \
+    --model transformer_lm --endpoint generate --requests 64 \
+    --concurrency "$S" --promptLen 64 --maxNewTokens 64 \
+    --serveArg=--slots --serveArg="$S"
+done
+step "serve_lm_tuned" 1800 python scripts/serving_bench.py \
+  --model transformer_lm --endpoint generate --requests 64 \
+  --concurrency 4 --promptLen 64 --maxNewTokens 64 \
+  --serveArg=--slots --serveArg=4 --serveArg=--bf16 \
+  --serveArg=--autotune --serveArg=cached
+
+# 4. padding-waste + admission behavior under overload: concurrency far
+#    above maxBatch exercises the 429 fast-reject path; the final
+#    /metrics scrape (inside each bench line's provenance) records the
+#    padding_waste_fraction the bucket ladder produced.
+step "serve_resnet50_overload" 1800 python scripts/serving_bench.py \
+  --model resnet50 --requests 256 --concurrency 32 --batch 4 \
+  --serveArg=--maxQueue --serveArg=64
+
+echo "capture r10 complete -> $REPO_LOG" | tee -a "$OUT"
