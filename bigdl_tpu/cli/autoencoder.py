@@ -35,10 +35,13 @@ def main(argv=None):
                          shuffle=True)
 
     model = autoencoder(32)
-    method = Adagrad(learning_rate=args.learningRate) if args.adagrad else None
-    opt = common.build_optimizer(model, train, nn.MSECriterion(), args,
-                                 optim_method=method)
-    return opt.optimize()
+
+    def _make():
+        method = (Adagrad(learning_rate=args.learningRate)
+                  if args.adagrad else None)
+        return common.build_optimizer(model, train, nn.MSECriterion(),
+                                      args, optim_method=method)
+    return common.run_optimize(_make, args)
 
 
 if __name__ == "__main__":

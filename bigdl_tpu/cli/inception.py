@@ -65,14 +65,17 @@ def main(argv=None):
         train = _train_dataset(args.folder, args.batchSize)
         val = _val_dataset(args.folder, args.batchSize)
         # reference hyperparams: lr 0.0898, Poly(0.5, 62000)
-        method = SGD(learning_rate=args.learningRate,
-                     schedule=Poly(0.5, args.maxIteration))
-        opt = common.build_optimizer(model, train, nn.ClassNLLCriterion(),
-                                     args, optim_method=method)
-        if val is not None:
-            opt.set_validation(Trigger.every_epoch(), val,
-                               [Top1Accuracy(), Top5Accuracy()])
-        return opt.optimize()
+        def _make():
+            method = SGD(learning_rate=args.learningRate,
+                         schedule=Poly(0.5, args.maxIteration))
+            opt = common.build_optimizer(model, train,
+                                         nn.ClassNLLCriterion(), args,
+                                         optim_method=method)
+            if val is not None:
+                opt.set_validation(Trigger.every_epoch(), val,
+                                   [Top1Accuracy(), Top5Accuracy()])
+            return opt
+        return common.run_optimize(_make, args)
     params, mod_state = common.load_trained(model, args.model)
     val = _val_dataset(args.folder, args.batchSize)
     if val is None:

@@ -238,11 +238,27 @@ def _annotate_bn_fused(out: dict, model) -> None:
     out["bn_fused"] = bn_fused_mode(model)
 
 
+def _annotate_supervisor(out: dict, supervisor) -> None:
+    """Stamp the structured fault/recovery log next to bn_fused/lint
+    (ISSUE 6): under --supervise the full supervisor annotation
+    (attempts/retries/events incl. injected faults); with only a
+    --faultPlan active, the raw injected-fault events — either way a
+    perf row produced under faults says so."""
+    if supervisor is not None:
+        out["supervisor"] = supervisor.annotation()
+        return
+    from bigdl_tpu.resilience.faults import injected_events
+    ev = injected_events()
+    if ev:
+        out["faults"] = ev
+
+
 def run(model_name: str, batch: int, iterations: int, data_type: str,
         use_bf16: bool = True, data_parallel: bool = False,
         data_source: str | None = None, inner_steps: int = 1,
         profile_dir: str | None = None, autotune: str | None = None,
-        fused_bn: str | None = None, lint: dict | None = None):
+        fused_bn: str | None = None, lint: dict | None = None,
+        supervisor=None):
     """Throughput harness entry. ``autotune`` optionally installs the
     tuning mode (the CLI does it via --autotune/apply_platform; bench.py
     children pass it directly). ``fused_bn`` ('off'/'stats'/'apply')
@@ -262,7 +278,7 @@ def run(model_name: str, batch: int, iterations: int, data_type: str,
                           use_bf16=use_bf16, data_parallel=data_parallel,
                           data_source=data_source, inner_steps=inner_steps,
                           profile_dir=profile_dir, fused_bn=fused_bn,
-                          lint=lint)
+                          lint=lint, supervisor=supervisor)
     finally:
         conv2d.restore_policy(snap)
 
@@ -271,7 +287,8 @@ def _run_timed(model_name: str, batch: int, iterations: int, data_type: str,
                use_bf16: bool = True, data_parallel: bool = False,
                data_source: str | None = None, inner_steps: int = 1,
                profile_dir: str | None = None,
-               fused_bn: str | None = None, lint: dict | None = None):
+               fused_bn: str | None = None, lint: dict | None = None,
+               supervisor=None):
     import os
 
     import jax
@@ -438,6 +455,7 @@ def _run_timed(model_name: str, batch: int, iterations: int, data_type: str,
         # style analysis; view with tensorboard or xprof tooling)
         trace_cm = jax.profiler.trace(profile_dir)
 
+    from bigdl_tpu.resilience.faults import hook as _fault_hook
     t0 = time.perf_counter()
     with trace_cm:
         for _ in range(iterations):
@@ -445,6 +463,10 @@ def _run_timed(model_name: str, batch: int, iterations: int, data_type: str,
                 mb = next(feed)
                 x = jnp.asarray(mb.input)   # host->device each step, as
                 y = jnp.asarray(mb.target)  # in a real training epoch
+            # fault site (one pointer check when no --faultPlan): the
+            # supervised-overhead A/B in scripts/tpu_capture_r11.sh
+            # bounds its cost
+            _fault_hook("step")
             params, mod_state, opt_state, loss = step(params, mod_state,
                                                       opt_state, x, y, k)
         float(loss)  # scalar host read = true device sync (note above)
@@ -481,6 +503,7 @@ def _run_timed(model_name: str, batch: int, iterations: int, data_type: str,
     _annotate_bn_fused(out, model)
     if lint is not None:  # --lint pre-flight summary rides in the JSON
         out["lint"] = lint  # line like bn_fused/autotune decisions do
+    _annotate_supervisor(out, supervisor)
     if flops_error is not None:
         out["flops_analytic_error"] = flops_error
     if flops_analytic and flops_hlo:
@@ -590,7 +613,8 @@ def run_time_to_acc(model_name: str, batch: int, target: float,
                     lift: float | None = None, noise: float | None = None,
                     weight_decay: float = 1e-4,
                     fused_bn: str | None = None,
-                    lint: dict | None = None):
+                    lint: dict | None = None,
+                    supervisor=None):
     """Time-to-accuracy harness (BASELINE.json metric: images/sec/chip
     **+ time-to-76%-top1**; reference recipe models/inception/Train.scala
     :77-83 + scripts/run.example.sh:54). Trains ``model_name`` from
@@ -704,6 +728,7 @@ def run_time_to_acc(model_name: str, batch: int, target: float,
     _annotate_bn_fused(out, model)
     if lint is not None:
         out["lint"] = lint
+    _annotate_supervisor(out, supervisor)
     print(json.dumps(out))
     return out
 
@@ -787,13 +812,15 @@ def main(argv=None):
                         "conv_geom in the result JSON")
     from bigdl_tpu.cli.common import (_add_platform_arg, add_autotune_arg,
                                       add_fused_bn_arg, add_lint_arg,
-                                      apply_platform, run_preflight_lint)
+                                      add_resilience_args, apply_platform,
+                                      run_preflight_lint)
     _add_platform_arg(p)
     add_autotune_arg(p)
     add_fused_bn_arg(p)
     add_lint_arg(p)
+    add_resilience_args(p)
     args = p.parse_args(argv)
-    apply_platform(args)
+    apply_platform(args)  # also installs --faultPlan
     if args.convLayout:
         # apply_platform already installed the spec (SystemExit on a bad
         # one); just surface what's active for the capture logs
@@ -815,25 +842,39 @@ def main(argv=None):
             report, strict=(args.lint == "strict"))
         if rc:
             return rc
-    if args.timeToAcc is not None:
-        data_dir = None
-        if args.data and args.data.startswith("record:"):
-            data_dir = args.data[len("record:"):]
-        run_time_to_acc(args.model, args.batchSize, args.timeToAcc,
-                        max_epochs=args.maxEpoch,
-                        image_size=args.imageSize, classes=args.classes,
-                        train_per_class=args.trainPerClass,
-                        val_per_class=args.valPerClass,
-                        use_bf16=not args.f32, data_dir=data_dir,
-                        hard=args.ttaHard, val_every_iters=args.valEvery,
-                        lift=args.ttaLift, noise=args.ttaNoise,
-                        weight_decay=args.ttaWd, fused_bn=args.fusedBN,
-                        lint=lint_ann)
+    def _go(supervisor=None):
+        if args.timeToAcc is not None:
+            data_dir = None
+            if args.data and args.data.startswith("record:"):
+                data_dir = args.data[len("record:"):]
+            run_time_to_acc(args.model, args.batchSize, args.timeToAcc,
+                            max_epochs=args.maxEpoch,
+                            image_size=args.imageSize,
+                            classes=args.classes,
+                            train_per_class=args.trainPerClass,
+                            val_per_class=args.valPerClass,
+                            use_bf16=not args.f32, data_dir=data_dir,
+                            hard=args.ttaHard,
+                            val_every_iters=args.valEvery,
+                            lift=args.ttaLift, noise=args.ttaNoise,
+                            weight_decay=args.ttaWd, fused_bn=args.fusedBN,
+                            lint=lint_ann, supervisor=supervisor)
+            return
+        run(args.model, args.batchSize, args.iteration, args.dataType,
+            use_bf16=not args.f32, data_parallel=args.dataParallel,
+            data_source=args.data, inner_steps=args.innerSteps,
+            profile_dir=args.profile, fused_bn=args.fusedBN,
+            lint=lint_ann, supervisor=supervisor)
+
+    if args.supervise is not None:
+        # supervised perf: transient injected faults retry with backoff
+        # and the fault/recovery log rides in the JSON line; fault-free,
+        # the timed loop is unchanged (one pointer check per step)
+        from bigdl_tpu.resilience.supervisor import RetryPolicy, Supervisor
+        sup = Supervisor(RetryPolicy(budget=args.supervise), name="perf")
+        sup.run(lambda _n: _go(sup))
         return
-    run(args.model, args.batchSize, args.iteration, args.dataType,
-        use_bf16=not args.f32, data_parallel=args.dataParallel,
-        data_source=args.data, inner_steps=args.innerSteps,
-        profile_dir=args.profile, fused_bn=args.fusedBN, lint=lint_ann)
+    _go()
 
 
 if __name__ == "__main__":

@@ -153,14 +153,17 @@ def main(argv=None):
                      weight_decay=args.weightDecay,
                      momentum=args.momentum, dampening=0.0,
                      nesterov=args.momentum > 0, schedule=sched)
-        opt = common.build_optimizer(model, train, nn.ClassNLLCriterion(),
-                                     args, optim_method=method)
-        if test is not None:
-            metrics = [Top1Accuracy()]
-            if args.dataset == "imagenet":
-                metrics.append(Top5Accuracy())
-            opt.set_validation(Trigger.every_epoch(), test, metrics)
-        return opt.optimize()
+        def _make():
+            opt = common.build_optimizer(model, train,
+                                         nn.ClassNLLCriterion(), args,
+                                         optim_method=method)
+            if test is not None:
+                metrics = [Top1Accuracy()]
+                if args.dataset == "imagenet":
+                    metrics.append(Top5Accuracy())
+                opt.set_validation(Trigger.every_epoch(), test, metrics)
+            return opt
+        return common.run_optimize(_make, args)
     params, mod_state = common.load_trained(model, args.model)
     if args.dataset == "imagenet":
         _, test = _imagenet_datasets(args.folder, args.batchSize)

@@ -62,8 +62,9 @@ def main(argv=None):
         *simple_rnn(vocab, args.hiddenSize, vocab).children(),
         name="SimpleRNN-LM",
     )
-    opt = common.build_optimizer(model, train, nn.ClassNLLCriterion(), args)
-    trained = opt.optimize()
+    trained = common.run_optimize(
+        lambda: common.build_optimizer(model, train, nn.ClassNLLCriterion(),
+                                       args), args)
     # report perplexity on the held-out tail (reference loss = perplexity)
     import jax.numpy as jnp
     logp = trained.module.forward(trained.params, jnp.asarray(x_val))

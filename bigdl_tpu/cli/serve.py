@@ -85,6 +85,26 @@ def build_parser() -> argparse.ArgumentParser:
                         "(first requests then pay the compiles)")
     p.add_argument("--timeout", type=float, default=120.0,
                    help="per-request wall timeout (503 past it)")
+    p.add_argument("--deadlineMs", type=float, default=None,
+                   help="default per-request deadline: rows/requests "
+                        "still queued past it are dropped BEFORE "
+                        "compute and answered 504 (a request-body "
+                        "'deadline_ms' overrides per request)")
+    p.add_argument("--shedAt", type=float, default=0.75,
+                   help="tiered overload degradation: past this "
+                        "fraction of queue capacity /generate sheds "
+                        "with 429 while /predict keeps admitting")
+    p.add_argument("--watchdogStallS", type=float, default=30.0,
+                   help="watchdog verdict threshold: a worker busy with "
+                        "no heartbeat this long is declared wedged — "
+                        "pending requests fail fast (503) and /readyz "
+                        "goes 503 while /healthz stays 200")
+    p.add_argument("--faultPlan", default=None, metavar="SPEC|FILE",
+                   help="deterministic fault injection on the serving "
+                        "path (bigdl_tpu.resilience.faults), e.g. "
+                        "'worker_kill@infer:3' kills the batcher worker "
+                        "on its 3rd flush — the watchdog/fast-fail "
+                        "drill. No-op unless set")
     # custom-dims LM (matches cli/transformerlm.py checkpoints)
     p.add_argument("--vocabSize", type=int, default=None,
                    help="build a custom transformer_lm (with --dModel/"
@@ -117,7 +137,7 @@ def build_app(args):
 
     from bigdl_tpu.serving import (DecodeEngine, InferenceEngine,
                                    MetricsRegistry, MicroBatcher,
-                                   ServingApp)
+                                   ServingApp, Watchdog)
 
     name = args.model
     is_lm = name.startswith("transformer_lm")
@@ -174,22 +194,38 @@ def build_app(args):
                                metrics=metrics)
         decoder.start()
 
+    # watchdog over every worker thread: dead/wedged -> pending futures
+    # fail fast, /readyz flips 503, /healthz stays live (ISSUE 6)
+    watchdog = Watchdog(stall_timeout_s=args.watchdogStallS,
+                        metrics=metrics)
+    watchdog.watch("batcher", batcher)
+    if decoder is not None:
+        watchdog.watch("decoder", decoder)
+    watchdog.start()
+
     prov = engine.provenance()
     prov.update({
         "model": name,
         "max_batch": args.maxBatch,
         "max_wait_ms": args.maxWaitMs,
         "max_queue": args.maxQueue,
+        "deadline_ms": args.deadlineMs if args.deadlineMs else "none",
+        "shed_at": args.shedAt,
     })
     if decoder is not None:
         prov["decode_slots"] = args.slots
         prov["prompt_buckets"] = ",".join(
             str(b) for b in decoder.prompt_buckets)
+    if getattr(args, "faultPlan", None):
+        prov["fault_plan"] = args.faultPlan
     metrics.set_provenance(prov)
 
     app = ServingApp(name=name, metrics=metrics, engine=engine,
                      batcher=batcher, decoder=decoder,
-                     request_timeout_s=args.timeout)
+                     request_timeout_s=args.timeout,
+                     default_deadline_ms=args.deadlineMs,
+                     shed_generate_frac=args.shedAt,
+                     watchdog=watchdog)
     return app, engine, in_shape, in_dtype
 
 

@@ -177,9 +177,12 @@ def main(argv=None):
     train = BatchDataSet(x[:n_train], y[:n_train], args.batchSize,
                          shuffle=True)
     val = BatchDataSet(x[n_train:], y[n_train:], args.batchSize)
-    opt = common.build_optimizer(model, train, nn.ClassNLLCriterion(), args)
-    opt.set_validation(Trigger.every_epoch(), val, [Top1Accuracy()])
-    return opt.optimize()
+    def _make():
+        opt = common.build_optimizer(model, train, nn.ClassNLLCriterion(),
+                                     args)
+        opt.set_validation(Trigger.every_epoch(), val, [Top1Accuracy()])
+        return opt
+    return common.run_optimize(_make, args)
 
 
 if __name__ == "__main__":

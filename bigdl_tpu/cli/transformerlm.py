@@ -107,9 +107,12 @@ def main(argv=None):
         compute_dtype=jnp.bfloat16 if args.bf16 else None)
     crit = nn.TimeDistributedCriterion(nn.ClassNLLCriterion())
     train = BatchDataSet(x, y, args.batchSize, shuffle=True)
-    opt = common.build_optimizer(model, train, crit, args)
-    opt.accum_steps = max(1, args.accumSteps)
-    trained = opt.optimize()
+
+    def _make():
+        opt = common.build_optimizer(model, train, crit, args)
+        opt.accum_steps = max(1, args.accumSteps)
+        return opt
+    trained = common.run_optimize(_make, args)
 
     logp = trained.module.forward(trained.params, jnp.asarray(x_val))
     lp = np.asarray(logp)
@@ -191,9 +194,12 @@ def _train_packed(args, d, tokens):
     crit = lambda logp, y: base(logp, (y[:, 0].astype(jnp.int32),
                                        y[:, 1]))
     train = BatchDataSet(f_tr, l_tr, args.batchSize, shuffle=True)
-    opt = common.build_optimizer(_PackedLM(), train, crit, args)
-    opt.accum_steps = max(1, args.accumSteps)
-    trained = opt.optimize()
+
+    def _make():
+        opt = common.build_optimizer(_PackedLM(), train, crit, args)
+        opt.accum_steps = max(1, args.accumSteps)
+        return opt
+    trained = common.run_optimize(_make, args)
 
     logp = trained.module.forward(trained.params, jnp.asarray(f_val))
     lp = np.asarray(logp)

@@ -50,10 +50,14 @@ def main(argv=None):
     model = vgg_for_cifar10(10)
     if args.cmd == "train":
         train, test = _datasets(args.folder, args.batchSize, train_aug=True)
-        opt = common.build_optimizer(model, train, nn.ClassNLLCriterion(),
-                                     args)
-        opt.set_validation(Trigger.every_epoch(), test, [Top1Accuracy()])
-        return opt.optimize()
+
+        def _make():
+            opt = common.build_optimizer(model, train,
+                                         nn.ClassNLLCriterion(), args)
+            opt.set_validation(Trigger.every_epoch(), test,
+                               [Top1Accuracy()])
+            return opt
+        return common.run_optimize(_make, args)
     params, mod_state = common.load_trained(model, args.model)
     test = _one_split(args.folder, args.batchSize, False, False)
     return common.evaluate(model, params, mod_state, test)

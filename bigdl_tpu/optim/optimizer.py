@@ -33,6 +33,7 @@ from bigdl_tpu.optim.method import OptimMethod, SGD
 from bigdl_tpu.optim.metrics import Metrics
 from bigdl_tpu.optim.triggers import Trigger
 from bigdl_tpu.optim.validation import ValidationMethod
+from bigdl_tpu.resilience.faults import hook as _fault_hook
 from bigdl_tpu.utils.file import (save_pytree, load_pytree,
                                   exists as file_exists)
 
@@ -157,7 +158,8 @@ class Optimizer:
     def set_checkpoint(self, trigger: Trigger, path: str,
                        overwrite: bool = False,
                        sharded: bool = False,
-                       async_save: bool = False) -> "Optimizer":
+                       async_save: bool = False,
+                       keep_last: Optional[int] = None) -> "Optimizer":
         """(reference Optimizer.setCheckpoint :87-94 +
         overWriteCheckpoint flag: refuse to clobber an existing snapshot
         unless ``overwrite``). ``sharded=True`` writes orbax shards
@@ -167,16 +169,22 @@ class Optimizer:
         background thread, so the step loop only pays the device->host
         copy, not the disk/remote write (single-blob path only; a prior
         in-flight write is joined — and its errors re-raised — before
-        the next snapshot starts and at the end of optimize())."""
+        the next snapshot starts and at the end of optimize()).
+        ``keep_last=k`` garbage-collects older snapshots after each
+        write, never deleting the newest checksum-VALID pair
+        (utils/file.gc_checkpoints)."""
         if async_save and sharded:
             raise ValueError("async_save supports the single-blob path; "
                              "orbax sharded writes are per-host streaming "
                              "already")
+        if keep_last is not None and keep_last < 1:
+            raise ValueError(f"keep_last must be >= 1, got {keep_last}")
         self._ckpt_trigger = trigger
         self._ckpt_path = path
         self._ckpt_overwrite = overwrite
         self._ckpt_sharded = sharded
         self._ckpt_async = async_save
+        self._ckpt_keep_last = keep_last
         return self
 
     def set_gradient_clipping_by_l2_norm(self, max_norm: float
@@ -221,15 +229,26 @@ class Optimizer:
         older snapshots without the counters resume with a fresh stream
         from the seed (counters-only semantics, as before)."""
         from bigdl_tpu.utils.file import (isdir, latest_checkpoint,
-                                          latest_checkpoint_pair)
-        # newest MATCHED pair: a kill between the model.<n> and state.<n>
-        # writes must not mix params from n with optimizer state from n-k
-        m, s = latest_checkpoint_pair(checkpoint_dir)
+                                          latest_valid_checkpoint_pair,
+                                          verify_checkpoint)
+        # newest MATCHED *VALID* pair: a kill between the model.<n> and
+        # state.<n> writes must not mix params from n with optimizer
+        # state from n-k, and a checksum-mismatched (torn/bit-rotted)
+        # pair must fall back to the previous one instead of crashing at
+        # deserialize (ISSUE 6: recovery costs one checkpoint interval,
+        # not the run)
+        m, s = latest_valid_checkpoint_pair(checkpoint_dir)
         if m is None:
             # accept a model-only snapshot (predict/eval-style dirs with
-            # no optimizer state at all)
+            # no optimizer state at all) — still checksum-gated
             m = latest_checkpoint(checkpoint_dir, "model.")
             s = None
+            if m is not None and not verify_checkpoint(m):
+                from bigdl_tpu.resilience.faults import ChecksumError
+                raise ChecksumError(
+                    f"the only snapshot in {checkpoint_dir} ({m}) fails "
+                    f"checksum verification and there is no earlier one "
+                    f"to fall back to")
         if m and isdir(m):  # orbax checkpoints are directories
             from bigdl_tpu.utils.orbax_ckpt import restore_sharded
             blob = restore_sharded(m)
@@ -566,9 +585,12 @@ class Optimizer:
                 t_fetch = time.time()
                 buf = []
                 while len(buf) < K:
-                    b = pending if pending is not None else next(
-                        data_iter, _end)
-                    pending = None
+                    if pending is not None:
+                        b, pending = pending, None
+                    else:
+                        b = next(data_iter, _end)
+                        if b is not _end:
+                            _fault_hook("data")  # one visit per fetch
                     if b is _end:
                         epoch_done = True
                         break
@@ -585,6 +607,10 @@ class Optimizer:
                     ys = jax.tree_util.tree_map(
                         lambda *ls: jnp.stack([jnp.asarray(l) for l in ls]),
                         *[by for _, by in buf])
+                    # fault site BEFORE the dispatch and BEFORE the rng
+                    # splits: a preemption here loses the whole chunk,
+                    # exactly like a kill between dispatches would
+                    _fault_hook("step")
                     # same host key sequence as K=1 (counted for resume)
                     keys = [_next_key() for _ in range(K)]
                     params, mod_state, opt_state, loss = chunk_fn(
@@ -600,6 +626,9 @@ class Optimizer:
                     continue
                 for x, y in buf:  # K == 1, or a ragged/short group
                     t0 = time.time()
+                    # fault site before the step's rng split + dispatch:
+                    # a preemption loses this step, as a real kill would
+                    _fault_hook("step")
                     if self.strategy is not None:
                         x, y = self.strategy.shard_batch(x, y)
                     else:
@@ -731,6 +760,7 @@ class Optimizer:
                 def _write():
                     save_pytree(snap_model, target)
                     save_pytree(snap_opt, state_target)
+                    self._gc_ckpts()
                     logger.info("Checkpoint written at iteration %d to %s "
                                 "(async)", n, self._ckpt_path)
 
@@ -742,8 +772,17 @@ class Optimizer:
             save_pytree({"params": params, "mod_state": mod_state,
                          "driver": drv}, target)
             save_pytree(opt_state, state_target)
+        self._gc_ckpts()
         logger.info("Checkpoint written at iteration %d to %s", n,
                     self._ckpt_path)
+
+    def _gc_ckpts(self):
+        """keep-last-k snapshot GC (set_checkpoint keep_last) — the
+        newest checksum-valid pair survives unconditionally."""
+        k = getattr(self, "_ckpt_keep_last", None)
+        if k:
+            from bigdl_tpu.utils.file import gc_checkpoints
+            gc_checkpoints(self._ckpt_path, k)
 
     def _ckpt_worker(self, write_fn):
         try:

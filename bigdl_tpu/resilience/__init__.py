@@ -1,0 +1,44 @@
+"""Fault injection + supervised recovery (ISSUE 6).
+
+BigDL's headline operational property — survive executor loss, task
+failure, slow nodes — came free with Spark. The single-process JAX
+stack has the *ingredients* (bit-identical step-equivalent resume,
+corruption-safe caches, admission control) but nothing that exercises
+or automates them. This package closes that gap:
+
+* :mod:`faults`     — a deterministic, seeded fault injector: a plan
+  (``--faultPlan``) fires simulated preemptions, transient dispatch
+  errors, checkpoint I/O errors, corrupted-checkpoint bytes, and
+  slow-step stalls at instrumented sites in the training loop,
+  checkpoint I/O, and the serving request path — all no-ops unless a
+  plan is installed;
+* :mod:`supervisor` — retry with exponential backoff + deterministic
+  jitter under an injectable clock, auto-resume from the newest VALID
+  (checksum-verified) checkpoint, a bounded retry budget, and a
+  structured fault/recovery log stamped into result JSON; plus
+  :func:`~supervisor.supervise_command` for process-fatal preemptions
+  (the engine of ``scripts/chaos_run.py``).
+
+The serving-side hardening (per-request deadlines, dead-worker
+fast-fail, the watchdog, tiered shedding) lives in
+:mod:`bigdl_tpu.serving` next to the components it protects.
+"""
+
+from bigdl_tpu.resilience.faults import (ChecksumError, FaultInjector,
+                                         FaultPlan, FaultRule, PREEMPT_RC,
+                                         SimulatedPreemption,
+                                         TransientFault, WorkerKillFault,
+                                         clear_plan, hook, injected_events,
+                                         install_plan, parse_plan)
+from bigdl_tpu.resilience.supervisor import (RETRYABLE_EXCEPTIONS,
+                                             RetryPolicy, Supervisor,
+                                             SupervisorGaveUp,
+                                             supervise_command)
+
+__all__ = [
+    "ChecksumError", "FaultInjector", "FaultPlan", "FaultRule",
+    "PREEMPT_RC", "RETRYABLE_EXCEPTIONS", "RetryPolicy",
+    "SimulatedPreemption", "Supervisor", "SupervisorGaveUp",
+    "TransientFault", "WorkerKillFault", "clear_plan", "hook",
+    "injected_events", "install_plan", "parse_plan", "supervise_command",
+]

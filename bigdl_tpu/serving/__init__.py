@@ -19,19 +19,25 @@ Modules:
   continuous-batching slots for ``transformer_lm``;
 * :mod:`metrics` — lock-cheap counters + latency histograms with a
   plaintext exposition format and config-provenance stamping;
+* :mod:`watchdog` — dead/wedged-worker detection: pending futures fail
+  fast, ``/readyz`` flips, ``/healthz`` stays live (ISSUE 6);
 * :mod:`server`  — stdlib ThreadingHTTPServer JSON endpoints
-  (``/predict`` ``/generate`` ``/healthz`` ``/metrics``), wired to the
-  ``bigdl-tpu serve`` CLI.
+  (``/predict`` ``/generate`` ``/healthz`` ``/readyz`` ``/metrics``)
+  with per-request deadlines (504), tiered overload shedding (429 on
+  ``/generate`` first), wired to the ``bigdl-tpu serve`` CLI.
 """
 
-from bigdl_tpu.serving.batcher import AdmissionError, MicroBatcher
+from bigdl_tpu.serving.batcher import (AdmissionError, DeadlineExceeded,
+                                       MicroBatcher, WorkerDied)
 from bigdl_tpu.serving.decode import DecodeEngine, DecodeRequest
 from bigdl_tpu.serving.engine import InferenceEngine, power_of_two_buckets
 from bigdl_tpu.serving.metrics import (Counter, Gauge, Histogram,
                                        MetricsRegistry)
 from bigdl_tpu.serving.server import ServingApp, make_server, run_server
+from bigdl_tpu.serving.watchdog import Watchdog
 
-__all__ = ["AdmissionError", "MicroBatcher", "DecodeEngine",
-           "DecodeRequest", "InferenceEngine", "power_of_two_buckets",
+__all__ = ["AdmissionError", "DeadlineExceeded", "MicroBatcher",
+           "WorkerDied", "DecodeEngine", "DecodeRequest",
+           "InferenceEngine", "power_of_two_buckets",
            "Counter", "Gauge", "Histogram", "MetricsRegistry",
-           "ServingApp", "make_server", "run_server"]
+           "ServingApp", "make_server", "run_server", "Watchdog"]

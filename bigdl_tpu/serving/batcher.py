@@ -1,4 +1,5 @@
-"""Dynamic micro-batching with backpressure-based admission control.
+"""Dynamic micro-batching with backpressure-based admission control,
+per-row deadlines, and dead-worker fast-fail.
 
 Online traffic arrives one row at a time; the accelerator wants bucketed
 batches (serving/engine.py). The micro-batcher sits between: requests
@@ -15,9 +16,28 @@ rows are already pending, ``submit`` raises :class:`AdmissionError`
 IMMEDIATELY (fast-reject) instead of letting latency grow without bound
 — the caller (server.py) maps it to HTTP 429 so load sheds at the edge.
 
+Robustness (ISSUE 6):
+
+* **deadlines** — ``submit(row, deadline=t)`` marks the row with an
+  absolute expiry on the batcher's clock; expired rows are dropped at
+  drain time BEFORE any compute is spent on them (and a row already
+  past its deadline is rejected at submit), resolving their futures
+  with :class:`DeadlineExceeded` — server.py maps it to HTTP 504;
+* **dead-worker fast-fail** — if the worker thread dies (a
+  ``worker_fatal`` exception out of the engine, or any bug in the loop
+  itself), every pending future is failed with :class:`WorkerDied` and
+  subsequent ``submit`` calls raise it immediately, instead of
+  enqueueing into a queue nobody drains until the caller's own timeout;
+* **deterministic close** — ``close()`` either flushes every pending
+  row (live worker / no worker) or fails them all with
+  :class:`WorkerDied` (dead or wedged worker); nothing is left hanging;
+* **watchdog surface** — ``alive()``/``busy()``/``heartbeat_age()``/
+  ``declare_dead()`` let serving/watchdog.py detect a wedged (alive but
+  stuck) worker and fail it fast.
+
 Determinism for tests: the flush decision is a pure function of the
-injected ``clock`` (``_flush_ready``/``pump``), so the trigger semantics
-are testable without threads or real time.
+injected ``clock`` (``_flush_ready``/``pump``), so trigger, deadline,
+and expiry semantics are testable without threads or real time.
 """
 
 from __future__ import annotations
@@ -29,11 +49,22 @@ from typing import Callable, Optional
 
 import numpy as np
 
-__all__ = ["AdmissionError", "MicroBatcher"]
+__all__ = ["AdmissionError", "DeadlineExceeded", "WorkerDied",
+           "MicroBatcher"]
 
 
 class AdmissionError(RuntimeError):
     """Queue at capacity — request rejected at admission (HTTP 429)."""
+
+
+class DeadlineExceeded(RuntimeError):
+    """Request deadline expired before compute (HTTP 504) — the row was
+    dropped unprocessed, so the caller may safely retry elsewhere."""
+
+
+class WorkerDied(RuntimeError):
+    """The serving worker thread is dead or wedged; the request failed
+    fast instead of waiting out its timeout (HTTP 503)."""
 
 
 class _Future:
@@ -67,10 +98,11 @@ class _Future:
 
 
 class _Pending:
-    __slots__ = ("row", "future", "t_enqueue")
+    __slots__ = ("row", "future", "t_enqueue", "deadline")
 
-    def __init__(self, row, future, t):
+    def __init__(self, row, future, t, deadline=None):
         self.row, self.future, self.t_enqueue = row, future, t
+        self.deadline = deadline
 
 
 class MicroBatcher:
@@ -103,6 +135,9 @@ class MicroBatcher:
         self._wakeup = threading.Condition(self._lock)
         self._closed = False
         self._thread = None
+        self._worker_error: Optional[BaseException] = None
+        self._last_beat = clock()
+        self._in_flush = False
 
         if metrics is not None:
             self._m_submitted = metrics.counter(
@@ -110,15 +145,24 @@ class MicroBatcher:
             self._m_rejected = metrics.counter(
                 "batcher_rows_rejected_total",
                 "rows fast-rejected at admission (queue full)")
+            self._m_expired = metrics.counter(
+                "batcher_rows_expired_total",
+                "rows dropped before compute (deadline exceeded)")
+            self._m_dead = metrics.counter(
+                "batcher_dead_submit_total",
+                "submits fast-failed because the worker is dead")
             self._m_flushes = metrics.counter(
                 "batcher_flushes_total", "micro-batches dispatched")
             self._m_wait = metrics.histogram(
                 "batcher_queue_wait_ms", "enqueue -> flush wait per row")
             metrics.gauge("batcher_queue_depth", "rows currently queued",
                           fn=lambda: len(self._pending))
+            metrics.gauge("batcher_worker_up",
+                          "1 while the flush worker is healthy",
+                          fn=lambda: 0.0 if self._worker_error else 1.0)
         else:
             self._m_submitted = self._m_rejected = self._m_flushes = None
-            self._m_wait = None
+            self._m_expired = self._m_dead = self._m_wait = None
 
         if start:
             self._thread = threading.Thread(target=self._worker,
@@ -127,20 +171,39 @@ class MicroBatcher:
             self._thread.start()
 
     # --------------------------------------------------------------- submit
-    def submit(self, row) -> _Future:
+    def submit(self, row, deadline: Optional[float] = None) -> _Future:
         """Queue one input row; returns a future resolving to its score
-        row. Raises :class:`AdmissionError` without blocking when the
-        queue is at ``max_queue`` (backpressure fast-reject)."""
+        row. ``deadline`` is an absolute time on the batcher's clock —
+        rows past it are dropped before compute (future raises
+        :class:`DeadlineExceeded`). Raises :class:`AdmissionError`
+        without blocking when the queue is at ``max_queue``
+        (backpressure fast-reject) and :class:`WorkerDied` when the
+        worker thread is gone (nothing would ever drain the queue)."""
         fut = _Future()
+        now = self.clock()
         with self._lock:
             if self._closed:
                 raise RuntimeError("batcher is closed")
+            if self._worker_error is not None or (
+                    self._thread is not None
+                    and not self._thread.is_alive()):
+                if self._m_dead is not None:
+                    self._m_dead.inc()
+                raise WorkerDied(
+                    "micro-batcher worker is dead: "
+                    f"{self._worker_error or 'thread exited'}")
+            if deadline is not None and now >= deadline:
+                if self._m_expired is not None:
+                    self._m_expired.inc()
+                raise DeadlineExceeded(
+                    f"deadline expired {now - deadline:.3f}s before "
+                    f"submit")
             if len(self._pending) >= self.max_queue:
                 if self._m_rejected is not None:
                     self._m_rejected.inc()
                 raise AdmissionError(
                     f"queue at capacity ({self.max_queue} rows pending)")
-            self._pending.append(_Pending(row, fut, self.clock()))
+            self._pending.append(_Pending(row, fut, now, deadline))
             if self._m_submitted is not None:
                 self._m_submitted.inc()
             self._wakeup.notify()
@@ -150,23 +213,74 @@ class MicroBatcher:
     def queue_depth(self) -> int:
         return len(self._pending)
 
+    # ------------------------------------------------------ watchdog surface
+    def alive(self) -> bool:
+        """False once the worker thread has died or been declared dead
+        (threadless test mode counts as alive — pump() is the worker)."""
+        if self._worker_error is not None:
+            return False
+        return self._thread is None or self._thread.is_alive()
+
+    def busy(self) -> bool:
+        """True while there is work a healthy worker should be making
+        progress on (queued rows or an in-flight flush)."""
+        return bool(self._pending) or self._in_flush
+
+    def heartbeat_age(self, now: Optional[float] = None) -> float:
+        """Seconds since the worker last proved liveness."""
+        return (self.clock() if now is None else now) - self._last_beat
+
+    @property
+    def worker_error(self) -> Optional[BaseException]:
+        return self._worker_error
+
+    def declare_dead(self, exc: BaseException) -> None:
+        """Mark the worker dead (watchdog verdict on a wedged thread, or
+        the worker's own epitaph): every pending future fails with
+        ``exc`` and subsequent submits raise :class:`WorkerDied` fast."""
+        with self._lock:
+            if self._worker_error is None:
+                self._worker_error = exc
+            dead = list(self._pending)
+            self._pending.clear()
+            self._wakeup.notify_all()
+        for p in dead:
+            p.future.set_exception(
+                exc if isinstance(exc, WorkerDied)
+                else WorkerDied(f"micro-batcher worker died: {exc}"))
+
     # ---------------------------------------------------------- flush logic
     def _flush_ready(self, now: float) -> bool:
-        """Pure trigger decision: full batch waiting, or the oldest row
-        has aged past max_wait."""
+        """Pure trigger decision: full batch waiting, the oldest row has
+        aged past max_wait, or expired rows need dropping."""
         if not self._pending:
             return False
         if len(self._pending) >= self.max_batch:
             return True
-        return (now - self._pending[0].t_enqueue) >= self.max_wait_s
+        head = self._pending[0]
+        if head.deadline is not None and now >= head.deadline:
+            return True
+        return (now - head.t_enqueue) >= self.max_wait_s
 
-    def _drain(self) -> list:
+    def _drain(self, now: float) -> list:
+        """Pop up to max_batch live rows, expiring dead-on-arrival ones
+        (deadline passed) BEFORE any compute is spent on them."""
         batch = []
         while self._pending and len(batch) < self.max_batch:
-            batch.append(self._pending.popleft())
+            p = self._pending.popleft()
+            if p.deadline is not None and now >= p.deadline:
+                if self._m_expired is not None:
+                    self._m_expired.inc()
+                p.future.set_exception(DeadlineExceeded(
+                    f"deadline expired {now - p.deadline:.3f}s before "
+                    f"compute (queued {now - p.t_enqueue:.3f}s)"))
+                continue
+            batch.append(p)
         return batch
 
     def _flush(self, batch: list, now: float) -> None:
+        if not batch:
+            return
         if self._m_wait is not None:
             for p in batch:
                 self._m_wait.observe((now - p.t_enqueue) * 1000.0)
@@ -176,6 +290,8 @@ class MicroBatcher:
         except BaseException as e:  # resolve every waiter, never hang them
             for p in batch:
                 p.future.set_exception(e)
+            if getattr(e, "worker_fatal", False):
+                raise  # fatal to the WORKER: die so submits fast-fail
             return
         if self._m_flushes is not None:
             self._m_flushes.inc()
@@ -184,47 +300,76 @@ class MicroBatcher:
 
     def pump(self, now: Optional[float] = None) -> int:
         """Flush at most one micro-batch if a trigger fired; returns the
-        number of rows flushed. The worker thread calls this in a loop;
-        tests call it directly with an injected ``now``."""
+        number of rows flushed (expired rows count — they were resolved).
+        The worker thread calls this in a loop; tests call it directly
+        with an injected ``now``."""
         now = self.clock() if now is None else now
         with self._lock:
             if not self._flush_ready(now):
                 return 0
-            batch = self._drain()
+            depth0 = len(self._pending)
+            batch = self._drain(now)
+            settled = depth0 - len(self._pending)  # flushed + expired
         # engine call happens OUTSIDE the lock: submits stay wait-free
         # while the forward runs
         self._flush(batch, now)
-        return len(batch)
+        return settled
 
     # --------------------------------------------------------------- worker
     def _worker(self) -> None:
-        while True:
-            with self._lock:
-                while not self._pending and not self._closed:
-                    self._wakeup.wait()
-                if self._closed and not self._pending:
-                    return
-                now = self.clock()
-                if not self._flush_ready(now):
-                    # sleep until the oldest row's deadline (or an earlier
-                    # submit fills the batch and notifies)
-                    deadline = self._pending[0].t_enqueue + self.max_wait_s
-                    self._wakeup.wait(timeout=max(deadline - now, 0.0))
-                    continue
-                batch = self._drain()
-            self._flush(batch, self.clock())
+        try:
+            while True:
+                with self._lock:
+                    self._last_beat = self.clock()
+                    while not self._pending and not self._closed:
+                        self._wakeup.wait()
+                        self._last_beat = self.clock()
+                    if self._closed and not self._pending:
+                        return
+                    now = self.clock()
+                    if not self._flush_ready(now):
+                        # sleep until the oldest row's deadline (or an
+                        # earlier submit fills the batch and notifies)
+                        head = self._pending[0]
+                        wake = head.t_enqueue + self.max_wait_s
+                        if head.deadline is not None:
+                            wake = min(wake, head.deadline)
+                        self._wakeup.wait(timeout=max(wake - now, 0.0))
+                        continue
+                    batch = self._drain(now)
+                    self._in_flush = True
+                try:
+                    self._flush(batch, self.clock())
+                finally:
+                    self._in_flush = False
+        except BaseException as e:
+            # the worker is the only drain: record the cause, fail every
+            # waiter, and let submit() fast-fail from here on
+            self.declare_dead(e)
 
     def close(self, timeout: float = 10.0) -> None:
-        """Stop accepting work, flush what is queued, join the worker."""
+        """Stop accepting work, then deterministically settle every
+        pending row: flush it (live worker, or no worker at all) or fail
+        it with :class:`WorkerDied` (dead/wedged worker). Nothing is
+        left for callers to time out on."""
         with self._lock:
             self._closed = True
             self._wakeup.notify_all()
-        if self._thread is not None:
-            self._thread.join(timeout)
-            self._thread = None
-        # no worker (tests / start=False): drain synchronously
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout)
+            if t.is_alive():
+                # wedged mid-flush: its waiters cannot be flushed twice,
+                # but everything still queued gets a deterministic error
+                self.declare_dead(WorkerDied(
+                    f"worker did not drain within {timeout}s at close"))
+                return
+        if self._worker_error is not None:
+            self.declare_dead(self._worker_error)
+            return
+        # no worker (tests / start=False) or clean worker exit that left
+        # rows (closed while flushing): drain synchronously
         while self._pending:
             with self._lock:
-                batch = self._drain()
-            if batch:
-                self._flush(batch, self.clock())
+                batch = self._drain(self.clock())
+            self._flush(batch, self.clock())

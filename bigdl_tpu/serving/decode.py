@@ -37,21 +37,23 @@ from typing import Optional, Sequence
 
 import numpy as np
 
-from bigdl_tpu.serving.batcher import AdmissionError, _Future
+from bigdl_tpu.serving.batcher import (AdmissionError, DeadlineExceeded,
+                                       WorkerDied, _Future)
 
 __all__ = ["DecodeEngine", "DecodeRequest"]
 
 
 class DecodeRequest:
     __slots__ = ("tokens", "max_new_tokens", "temperature", "stop_token",
-                 "future", "out")
+                 "future", "out", "deadline")
 
     def __init__(self, tokens, max_new_tokens, temperature=0.0,
-                 stop_token=None):
+                 stop_token=None, deadline=None):
         self.tokens = [int(t) for t in tokens]
         self.max_new_tokens = int(max_new_tokens)
         self.temperature = float(temperature)
         self.stop_token = stop_token
+        self.deadline = deadline
         self.future = _Future()
         self.out: list = []
 
@@ -71,12 +73,17 @@ class DecodeEngine:
     def __init__(self, model, params, *, slots: int = 4,
                  max_len: Optional[int] = None, cache_dtype=None,
                  prompt_buckets: Optional[Sequence[int]] = None,
-                 max_waiting: int = 64, metrics=None):
+                 max_waiting: int = 64, metrics=None,
+                 clock=None):
         import jax
         import jax.numpy as jnp
+        import time as _time
 
         if slots < 1:
             raise ValueError(f"slots must be >= 1, got {slots}")
+        self.clock = clock or _time.monotonic
+        self._worker_error: Optional[BaseException] = None
+        self._last_beat = self.clock()
         self.model = model
         self.params = params
         self.slots = int(slots)
@@ -120,6 +127,15 @@ class DecodeEngine:
             self._m_rejected = metrics.counter(
                 "decode_rejected_total",
                 "generate requests fast-rejected (waiting queue full)")
+            self._m_expired = metrics.counter(
+                "decode_expired_total",
+                "generate requests dropped on deadline expiry")
+            self._m_dead = metrics.counter(
+                "decode_dead_submit_total",
+                "generate submits fast-failed (decode worker dead)")
+            metrics.gauge("decode_worker_up",
+                          "1 while the decode loop is healthy",
+                          fn=lambda: 0.0 if self._worker_error else 1.0)
             metrics.gauge("decode_slots_active", "occupied decode slots",
                           fn=lambda: sum(r is not None
                                          for r in self._reqs))
@@ -131,6 +147,7 @@ class DecodeEngine:
         else:
             self._m_tokens = self._m_steps = self._m_prefills = None
             self._m_prompt_tokens = self._m_rejected = None
+            self._m_expired = self._m_dead = None
 
         # ---- compiled programs -------------------------------------------
         def _prefill(params, tokens, last):
@@ -179,10 +196,14 @@ class DecodeEngine:
         return self.prompt_buckets[-1]
 
     def submit(self, tokens, max_new_tokens: int,
-               temperature: float = 0.0, stop_token=None) -> _Future:
+               temperature: float = 0.0, stop_token=None,
+               deadline: Optional[float] = None) -> _Future:
         """Queue one generation request; the future resolves to the list
         of generated token ids. Validates the length budget, fast-rejects
-        when the waiting queue is full."""
+        when the waiting queue is full, when the decode worker is dead
+        (:class:`WorkerDied` — nothing would ever drain the queue), or
+        when ``deadline`` (absolute, on the engine's clock) has already
+        passed (:class:`DeadlineExceeded`)."""
         tokens = list(tokens)
         if not tokens:
             raise ValueError("empty prompt")
@@ -193,10 +214,23 @@ class DecodeEngine:
             raise ValueError(
                 f"prompt ({len(tokens)}) + max_new_tokens "
                 f"({max_new_tokens}) exceeds max_len {self.max_len}")
-        req = DecodeRequest(tokens, max_new_tokens, temperature, stop_token)
+        req = DecodeRequest(tokens, max_new_tokens, temperature,
+                            stop_token, deadline)
         with self._lock:
             if self._closed:
                 raise RuntimeError("decode engine is closed")
+            if self._worker_error is not None or (
+                    self._thread is not None
+                    and not self._thread.is_alive()):
+                if self._m_dead is not None:
+                    self._m_dead.inc()
+                raise WorkerDied(
+                    "decode worker is dead: "
+                    f"{self._worker_error or 'thread exited'}")
+            if deadline is not None and self.clock() >= deadline:
+                if self._m_expired is not None:
+                    self._m_expired.inc()
+                raise DeadlineExceeded("deadline expired before submit")
             slot = self._free_slot()
             if slot is not None:
                 self._install(req, slot)
@@ -236,14 +270,46 @@ class DecodeEngine:
             self._m_prefills.inc()
             self._m_prompt_tokens.inc(s)
 
+    # ------------------------------------------------------------- deadlines
+    def _expire(self, now: float) -> None:
+        """Drop expired requests BEFORE compute is spent on them (lock
+        held): waiting-queue entries simply resolve with
+        :class:`DeadlineExceeded`; active slots free up and hand off to
+        the next (still-live) waiting request."""
+        if self._waiting:
+            live = collections.deque()
+            for req in self._waiting:
+                if req.deadline is not None and now >= req.deadline:
+                    if self._m_expired is not None:
+                        self._m_expired.inc()
+                    req.future.set_exception(DeadlineExceeded(
+                        "deadline expired while waiting for a decode "
+                        "slot"))
+                else:
+                    live.append(req)
+            self._waiting = live
+        for i, req in enumerate(self._reqs):
+            if (req is not None and req.deadline is not None
+                    and now >= req.deadline):
+                self._reqs[i] = None
+                if self._m_expired is not None:
+                    self._m_expired.inc()
+                req.future.set_exception(DeadlineExceeded(
+                    f"deadline expired after {len(req.out)} of "
+                    f"{req.max_new_tokens} tokens"))
+                if self._waiting:
+                    self._install(self._waiting.popleft(), i)
+
     # ---------------------------------------------------------------- step
     def step(self) -> int:
         """One batched decode step: every active slot emits one token.
         Returns the number of active slots advanced (0 = idle). Finished
         requests resolve their futures and hand their slot to the next
-        waiting request."""
+        waiting request; expired ones are dropped before compute."""
         jax, jnp = self._jax, self._jnp
         with self._lock:
+            self._last_beat = self.clock()
+            self._expire(self.clock())
             active = [i for i, r in enumerate(self._reqs) if r is not None]
             if not active:
                 return 0
@@ -285,6 +351,46 @@ class DecodeEngine:
                         "decode engine idle with unresolved request")
         return fut.result()
 
+    # ------------------------------------------------------ watchdog surface
+    def alive(self) -> bool:
+        """False once the decode loop has died or been declared dead
+        (threadless caller-driven mode counts as alive)."""
+        if self._worker_error is not None:
+            return False
+        return self._thread is None or self._thread.is_alive()
+
+    def busy(self) -> bool:
+        """True while there is work a healthy decode loop should be
+        advancing (active slots or waiting requests)."""
+        return (any(r is not None for r in self._reqs)
+                or bool(self._waiting))
+
+    def heartbeat_age(self, now: Optional[float] = None) -> float:
+        return (self.clock() if now is None else now) - self._last_beat
+
+    @property
+    def worker_error(self) -> Optional[BaseException]:
+        return self._worker_error
+
+    def declare_dead(self, exc: BaseException) -> None:
+        """Fail every in-flight and waiting request with
+        :class:`WorkerDied` and make subsequent submits fast-fail —
+        the watchdog's verdict on a wedged loop, or the loop's own."""
+        with self._lock:
+            if self._worker_error is None:
+                self._worker_error = exc
+            dead = list(self._waiting)
+            self._waiting.clear()
+            for i, req in enumerate(self._reqs):
+                if req is not None:
+                    self._reqs[i] = None
+                    dead.append(req)
+            self._work.notify_all()
+        err = (exc if isinstance(exc, WorkerDied)
+               else WorkerDied(f"decode worker died: {exc}"))
+        for req in dead:
+            req.future.set_exception(err)
+
     # --------------------------------------------------------------- worker
     def start(self) -> None:
         """Launch the decode loop thread (server mode)."""
@@ -292,14 +398,22 @@ class DecodeEngine:
             return
 
         def _loop():
-            while True:
-                with self._lock:
-                    while (not self._closed
-                           and not any(r is not None for r in self._reqs)):
-                        self._work.wait()
-                    if self._closed:
-                        return
-                self.step()
+            try:
+                while True:
+                    with self._lock:
+                        self._last_beat = self.clock()
+                        while (not self._closed
+                               and not any(r is not None
+                                           for r in self._reqs)):
+                            self._work.wait()
+                            self._last_beat = self.clock()
+                        if self._closed:
+                            return
+                    self.step()
+            except BaseException as e:
+                # the loop is the only thing advancing decode: record
+                # the cause, fail every waiter, fast-fail future submits
+                self.declare_dead(e)
 
         self._thread = threading.Thread(target=_loop, name="decode-loop",
                                         daemon=True)

@@ -30,6 +30,8 @@ from typing import Optional, Sequence
 
 import numpy as np
 
+from bigdl_tpu.resilience.faults import hook as _fault_hook
+
 logger = logging.getLogger(__name__)
 
 __all__ = ["InferenceEngine", "power_of_two_buckets"]
@@ -199,6 +201,10 @@ class InferenceEngine:
 
     def predict_scores(self, x) -> np.ndarray:
         """Raw model outputs for every row of ``x`` (any row count)."""
+        # fault-injection site for the serving forward (no-op unless a
+        # --faultPlan is installed): a `worker_kill` here is fatal to
+        # the batcher worker — the dead-worker/watchdog drill
+        _fault_hook("infer")
         x = np.asarray(x)
         n = len(x)
         if n == 0:

@@ -160,12 +160,14 @@ class MetricsRegistry:
 
     QUANTILES = (0.5, 0.95, 0.99)
 
-    def __init__(self, namespace: str = "bigdl_serving"):
+    def __init__(self, namespace: str = "bigdl_serving",
+                 clock: Callable[[], float] = time.time):
         self.namespace = namespace
         self._lock = threading.Lock()
         self._metrics: Dict[str, object] = {}
         self._provenance: dict = {}
-        self._t0 = time.time()
+        self._clock = clock  # injectable: uptime-derived gauges (tokens/s)
+        self._t0 = clock()   # become deterministic under test
 
     def _register(self, name, factory):
         with self._lock:
@@ -205,7 +207,7 @@ class MetricsRegistry:
             return dict(self._provenance)
 
     def uptime_s(self) -> float:
-        return time.time() - self._t0
+        return self._clock() - self._t0
 
     # ------------------------------------------------------------ exposition
     def render(self) -> str:

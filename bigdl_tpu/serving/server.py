@@ -11,13 +11,27 @@ micro-batcher concurrency levels) exposing
 * ``POST /generate`` — ``{"tokens": [...], "max_new_tokens": N}`` ->
   generated token ids from the continuous-batching KV-cache decoder
   (LM models only);
-* ``GET /healthz``   — liveness;
+* ``GET /healthz``   — LIVENESS: 200 while the process can answer HTTP
+  at all (a degraded server is alive — restarting it would lose the
+  still-working endpoints);
+* ``GET /readyz``    — READINESS: 200 only while every worker is
+  healthy and no deliberate overload shed is active — the signal a load
+  balancer drains on;
 * ``GET /metrics``   — plaintext counters/histograms with the serving
   config provenance stamped into every scrape.
 
-Error contract: malformed JSON/fields -> 400, admission rejection
-(queue full) -> 429 with ``Retry-After``, engine failure -> 500; every
-error body is ``{"error": ...}``.
+Error contract: malformed JSON/fields -> 400, admission rejection or
+overload shed (queue full / tiered degradation) -> 429 with
+``Retry-After``, request deadline expired before compute -> 504, dead
+or wedged worker -> 503 (fast, via the watchdog — not after the
+client's timeout), engine failure -> 500; every error body is
+``{"error": ...}``.
+
+Graceful degradation is TIERED: under overload the server sheds
+``/generate`` first (decode holds slots for seconds; one shed frees
+real capacity) while ``/predict`` — cheap, micro-batched — keeps
+admitting until its own queue limit; ``/healthz`` stays green
+throughout so the process is drained, not killed.
 """
 
 from __future__ import annotations
@@ -25,12 +39,15 @@ from __future__ import annotations
 import json
 import logging
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 import numpy as np
 
-from bigdl_tpu.serving.batcher import AdmissionError
+from bigdl_tpu.resilience.faults import TransientFault, hook as _fault_hook
+from bigdl_tpu.serving.batcher import (AdmissionError, DeadlineExceeded,
+                                       WorkerDied)
 
 logger = logging.getLogger(__name__)
 
@@ -44,31 +61,105 @@ class ServingApp:
     (+ optional batcher) for /predict, decoder for /generate, one
     metrics registry for everything. Endpoint handlers return
     ``(status, payload_dict)`` so they are unit-testable without
-    sockets."""
+    sockets.
+
+    ``default_deadline_ms`` bounds every request (a per-request
+    ``"deadline_ms"`` field overrides it); ``shed_generate_frac`` is the
+    overload tier: when the predict queue or the decode waiting queue
+    passes that fraction of its capacity, ``/generate`` sheds with 429
+    while ``/predict`` keeps admitting. ``watchdog`` supplies the
+    readiness verdict for ``/readyz``."""
 
     def __init__(self, *, name: str, metrics, engine=None, batcher=None,
-                 decoder=None, request_timeout_s: float = 120.0):
+                 decoder=None, request_timeout_s: float = 120.0,
+                 default_deadline_ms: Optional[float] = None,
+                 shed_generate_frac: float = 0.75,
+                 watchdog=None, clock=time.monotonic):
         self.name = name
         self.metrics = metrics
         self.engine = engine
         self.batcher = batcher
         self.decoder = decoder
+        self.watchdog = watchdog
+        self.clock = clock
         self.request_timeout_s = float(request_timeout_s)
+        self.default_deadline_ms = (float(default_deadline_ms)
+                                    if default_deadline_ms else None)
+        if not 0.0 < shed_generate_frac <= 1.0:
+            raise ValueError(f"shed_generate_frac must be in (0, 1], "
+                             f"got {shed_generate_frac}")
+        self.shed_generate_frac = float(shed_generate_frac)
         self._m_requests = {
             ep: metrics.counter(f"requests_{ep}_total",
                                 f"completed /{ep} requests")
             for ep in ("predict", "generate")}
         self._m_errors = metrics.counter(
             "request_errors_total", "requests answered 4xx/5xx")
+        self._m_expired = metrics.counter(
+            "requests_expired_total",
+            "requests answered 504 (deadline expired before compute)")
+        self._m_shed = metrics.counter(
+            "requests_shed_total",
+            "requests shed 429 by tiered overload degradation")
+        self._m_worker_dead = metrics.counter(
+            "requests_worker_dead_total",
+            "requests answered 503 fast (dead/wedged worker)")
+        self._m_injected = metrics.counter(
+            "faults_injected_requests_total",
+            "requests failed by an installed --faultPlan")
         self._m_latency = {
             ep: metrics.histogram(f"latency_{ep}_ms",
                                   f"/{ep} request latency (receipt to "
                                   f"response ready)")
             for ep in ("predict", "generate")}
 
+    # ------------------------------------------------------------ deadlines
+    def _deadline_from(self, payload: dict) -> Optional[float]:
+        """Absolute per-request deadline on the app clock, from the
+        request's ``deadline_ms`` or the server default (None = no
+        deadline)."""
+        ms = payload.get("deadline_ms", self.default_deadline_ms)
+        if ms is None:
+            return None
+        return self.clock() + float(ms) / 1000.0
+
+    # ------------------------------------------------------------- overload
+    def _shed_generate(self) -> bool:
+        """Tiered degradation: past ``shed_generate_frac`` of either
+        queue's capacity, /generate sheds so /predict keeps breathing."""
+        frac = self.shed_generate_frac
+        if (self.batcher is not None
+                and self.batcher.queue_depth
+                >= frac * self.batcher.max_queue):
+            return True
+        if (self.decoder is not None
+                and len(self.decoder._waiting)
+                >= frac * self.decoder.max_waiting):
+            return True
+        return False
+
     # ------------------------------------------------------------ endpoints
     def handle_healthz(self):
+        """Liveness only — a degraded-but-serving process answers 200
+        here (and 503 on /readyz) so orchestrators drain it instead of
+        killing it."""
         return 200, {"status": "ok", "model": self.name}
+
+    def handle_readyz(self):
+        detail = {"model": self.name}
+        ok = True
+        if self.watchdog is not None and not self.watchdog.ready():
+            ok = False
+            detail["failed_workers"] = self.watchdog.failures
+        for comp_name, comp in (("batcher", self.batcher),
+                                ("decoder", self.decoder)):
+            if comp is not None and not comp.alive():
+                ok = False
+                detail.setdefault("dead", []).append(comp_name)
+        if self._shed_generate():
+            detail["shedding"] = "generate"
+        detail["status"] = "ready" if ok else "unready"
+        return (200 if ok else 503), detail
 
     def handle_predict(self, payload: dict):
         if self.engine is None:
@@ -91,11 +182,15 @@ class ServingApp:
         if x.ndim < 2:
             return 400, {"error": "inputs must be a batch (rows on "
                                   "axis 0)"}
+        deadline = self._deadline_from(payload)
         if self.batcher is not None:
-            futs = [self.batcher.submit(row) for row in x]
+            futs = [self.batcher.submit(row, deadline=deadline)
+                    for row in x]
             scores = np.stack([f.result(self.request_timeout_s)
                                for f in futs])
         else:
+            if deadline is not None and self.clock() >= deadline:
+                raise DeadlineExceeded("deadline expired before compute")
             scores = self.engine.predict_scores(x)
         preds = np.argmax(scores, axis=-1)
         out = {"predictions": preds.tolist()}
@@ -116,7 +211,8 @@ class ServingApp:
         temperature = payload.get("temperature", 0.0)
         stop = payload.get("stop_token")
         try:
-            fut = self.decoder.submit(tokens, max_new, temperature, stop)
+            fut = self.decoder.submit(tokens, max_new, temperature, stop,
+                                      deadline=self._deadline_from(payload))
         except ValueError as e:
             return 400, {"error": str(e)}
         out_tokens = fut.result(self.request_timeout_s)
@@ -133,13 +229,32 @@ class ServingApp:
                    "generate": self.handle_generate}.get(ep)
         if handler is None:
             return 404, {"error": f"unknown endpoint {path}"}
-        import time
+        if ep == "generate" and self._shed_generate():
+            # tiered degradation: /generate sheds first so /predict
+            # keeps its admission headroom under overload
+            self._m_shed.inc()
+            self._m_errors.inc()
+            return 429, {"error": "overloaded: shedding /generate "
+                                  "(retry, or use /predict capacity)"}
         t0 = time.perf_counter()
         try:
+            _fault_hook("request")  # no-op unless --faultPlan installed
             status, body = handler(payload)
         except AdmissionError as e:
             self._m_errors.inc()
             return 429, {"error": str(e)}
+        except DeadlineExceeded as e:
+            self._m_expired.inc()
+            self._m_errors.inc()
+            return 504, {"error": f"deadline exceeded: {e}"}
+        except WorkerDied as e:
+            self._m_worker_dead.inc()
+            self._m_errors.inc()
+            return 503, {"error": str(e)}
+        except TransientFault as e:
+            self._m_injected.inc()
+            self._m_errors.inc()
+            return 503, {"error": f"injected fault: {e}"}
         except TimeoutError as e:
             self._m_errors.inc()
             return 503, {"error": str(e)}
@@ -155,6 +270,8 @@ class ServingApp:
         return status, body
 
     def close(self) -> None:
+        if self.watchdog is not None:
+            self.watchdog.stop()
         if self.batcher is not None:
             self.batcher.close()
         if self.decoder is not None:
@@ -181,6 +298,8 @@ class _Handler(BaseHTTPRequestHandler):
     def do_GET(self):  # noqa: N802 (stdlib naming)
         if self.path == "/healthz":
             self._send_json(*self.app.handle_healthz())
+        elif self.path == "/readyz":
+            self._send_json(*self.app.handle_readyz())
         elif self.path == "/metrics":
             data = self.app.handle_metrics().encode()
             self.send_response(200)
@@ -233,7 +352,7 @@ def run_server(app: ServingApp, host: str = "127.0.0.1",
     srv = make_server(app, host, port)
     actual = srv.server_address[1]
     logger.info("serving %s on http://%s:%d (/predict /generate /healthz "
-                "/metrics)", app.name, host, actual)
+                "/readyz /metrics)", app.name, host, actual)
     print(f"serving {app.name} on http://{host}:{actual}", flush=True)
 
     def _stop(signum, frame):

@@ -1,0 +1,143 @@
+"""Serving watchdog: detect dead or wedged worker threads and fail
+fast (ISSUE 6 serving hardening).
+
+Two failure shapes escape the per-component guards:
+
+* a worker thread that DIED outside its own try/except (component
+  ``alive()`` goes false — submits already fast-fail, but readiness
+  must flip and pending futures must be settled);
+* a worker that is alive but WEDGED — stuck inside a single engine call
+  (a hung device transfer, an injected stall) while work queues behind
+  it. No exception ever fires; only the combination "busy, but the
+  heartbeat hasn't moved in ``stall_timeout_s``" reveals it.
+
+The watchdog polls each registered component (anything exposing
+``alive()``, ``busy()``, ``heartbeat_age(now)``, ``declare_dead(exc)``
+— MicroBatcher and DecodeEngine both do) and on either verdict calls
+``declare_dead``: pending futures resolve with
+:class:`~bigdl_tpu.serving.batcher.WorkerDied`, later submits fail
+immediately, and :meth:`ready` goes false so ``/readyz`` returns 503
+and the load balancer drains this replica while ``/healthz`` (liveness)
+keeps answering 200 — degraded, not dead.
+
+``check(now)`` is a pure function of the injected clock so the verdict
+logic is unit-testable without threads; ``start()`` runs it on a
+daemon-thread interval for the real server.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+from bigdl_tpu.serving.batcher import WorkerDied
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["Watchdog"]
+
+
+class Watchdog:
+    def __init__(self, *, interval_s: float = 0.5,
+                 stall_timeout_s: float = 30.0,
+                 clock: Callable[[], float] = time.monotonic,
+                 metrics=None):
+        if stall_timeout_s <= 0:
+            raise ValueError(
+                f"stall_timeout_s must be > 0, got {stall_timeout_s}")
+        self.interval_s = float(interval_s)
+        self.stall_timeout_s = float(stall_timeout_s)
+        self.clock = clock
+        self._targets: Dict[str, object] = {}
+        self._failed: Dict[str, str] = {}  # name -> verdict
+        self._lock = threading.Lock()
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        if metrics is not None:
+            self._m_failures = metrics.counter(
+                "watchdog_failures_total",
+                "workers declared dead or wedged by the watchdog")
+            metrics.gauge("watchdog_ready",
+                          "1 while every watched worker is healthy",
+                          fn=lambda: 1.0 if self.ready() else 0.0)
+        else:
+            self._m_failures = None
+
+    def watch(self, name: str, target) -> "Watchdog":
+        """Register a component exposing ``alive/busy/heartbeat_age/
+        declare_dead`` (MicroBatcher, DecodeEngine)."""
+        for attr in ("alive", "busy", "heartbeat_age", "declare_dead"):
+            if not callable(getattr(target, attr, None)):
+                raise TypeError(f"{name}: watch target lacks {attr}()")
+        self._targets[name] = target
+        return self
+
+    # --------------------------------------------------------------- verdict
+    def check(self, now: Optional[float] = None) -> Dict[str, str]:
+        """One poll: returns ``{name: "ok" | "dead" | "wedged"}`` and
+        acts on new failures (declare_dead + counter). Pure in its
+        verdict given ``now``; safe to call from tests without start()."""
+        now = self.clock() if now is None else now
+        out: Dict[str, str] = {}
+        for name, t in self._targets.items():
+            prior = self._failed.get(name)
+            if prior:
+                out[name] = prior
+                continue
+            if not t.alive():
+                verdict = "dead"
+                exc = WorkerDied(
+                    f"{name}: worker thread died "
+                    f"({getattr(t, 'worker_error', None) or 'unknown'})")
+            elif t.busy() and t.heartbeat_age(now) > self.stall_timeout_s:
+                verdict = "wedged"
+                exc = WorkerDied(
+                    f"{name}: worker wedged — busy with no heartbeat "
+                    f"for {t.heartbeat_age(now):.1f}s "
+                    f"(> {self.stall_timeout_s}s)")
+            else:
+                out[name] = "ok"
+                continue
+            with self._lock:
+                self._failed[name] = verdict
+            logger.error("watchdog: %s", exc)
+            if self._m_failures is not None:
+                self._m_failures.inc()
+            t.declare_dead(exc)
+            out[name] = verdict
+        return out
+
+    def ready(self) -> bool:
+        """Readiness verdict for ``/readyz``: no watched worker has
+        failed."""
+        return not self._failed
+
+    @property
+    def failures(self) -> Dict[str, str]:
+        with self._lock:
+            return dict(self._failed)
+
+    # ---------------------------------------------------------------- thread
+    def start(self) -> "Watchdog":
+        if self._thread is not None:
+            return self
+
+        def _loop():
+            while not self._stop.wait(self.interval_s):
+                try:
+                    self.check()
+                except Exception:  # the watchdog must not die of a bug
+                    logger.exception("watchdog poll failed")
+
+        self._thread = threading.Thread(target=_loop, name="watchdog",
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(5.0)

@@ -17,7 +17,9 @@ cross-version archival, export leaves by name instead.
 
 from __future__ import annotations
 
+import hashlib
 import io
+import logging
 import os
 import pickle
 from typing import Any
@@ -25,8 +27,46 @@ from typing import Any
 import jax
 import numpy as np
 
+# fault-injection sites for the resilience layer (no-ops unless a
+# --faultPlan is installed); ChecksumError lives with the fault taxonomy
+from bigdl_tpu.resilience.faults import (ChecksumError, hook as _fault_hook,
+                                         post_write_hook as _post_write_hook)
+
+logger = logging.getLogger("bigdl_tpu")
+
 __all__ = ["save_pytree", "load_pytree", "latest_checkpoint", "is_remote",
-           "isdir", "exists"]
+           "isdir", "exists", "ChecksumError", "checksum_path",
+           "verify_checkpoint", "latest_valid_checkpoint_pair",
+           "gc_checkpoints"]
+
+# every save_pytree/save_module writes `<path>.sha256` next to the blob;
+# load verifies it, so a torn-then-renamed or bit-rotted checkpoint is
+# caught at restore (ChecksumError) instead of producing silent garbage
+CHECKSUM_SUFFIX = ".sha256"
+
+
+def checksum_path(path: str) -> str:
+    return path + CHECKSUM_SUFFIX
+
+
+def _file_sha256(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def _write_sidecar(path: str, digest: str) -> None:
+    if is_remote(path):
+        fs, p = _fs_for(path)
+        with fs.open(p + CHECKSUM_SUFFIX, "wb") as f:
+            f.write(digest.encode())
+        return
+    tmp = checksum_path(path) + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(digest)
+    os.replace(tmp, checksum_path(path))
 
 
 def is_remote(path: str) -> bool:
@@ -60,9 +100,13 @@ def _fs_for(path: str):
 
 
 def save_pytree(tree: Any, path: str) -> None:
-    """Write a pytree of arrays to ``path`` (.npz + embedded treedef).
-    Local writes are atomic (tmp + rename); remote writes are single puts
-    (object stores don't expose rename, but puts are all-or-nothing)."""
+    """Write a pytree of arrays to ``path`` (.npz + embedded treedef)
+    plus a ``<path>.sha256`` checksum sidecar. Local writes are atomic
+    (tmp + rename, sidecar written AFTER the blob so a sidecar's
+    presence implies a complete blob existed); remote writes are single
+    puts (object stores don't expose rename, but puts are
+    all-or-nothing)."""
+    _fault_hook("ckpt_save")
     leaves, treedef = jax.tree_util.tree_flatten(tree)
     arrays = {f"leaf_{i}": np.asarray(x) for i, x in enumerate(leaves)}
     meta = np.frombuffer(pickle.dumps(treedef), dtype=np.uint8)
@@ -76,6 +120,8 @@ def save_pytree(tree: Any, path: str) -> None:
         np.savez(payload, __treedef__=meta, **arrays)
         with fs.open(p, "wb") as f:
             f.write(payload.getbuffer())
+        _write_sidecar(path, hashlib.sha256(payload.getbuffer()).hexdigest())
+        _post_write_hook("ckpt_save", path)
         return
     # local: stream straight to the tmp file (no in-RAM archive copy —
     # checkpoints can be multi-GB), then atomic rename
@@ -83,20 +129,80 @@ def save_pytree(tree: Any, path: str) -> None:
     tmp = path + ".tmp"
     with open(tmp, "wb") as f:
         np.savez(f, __treedef__=meta, **arrays)
+    digest = _file_sha256(tmp)
     os.replace(tmp, path)
+    _write_sidecar(path, digest)
+    _post_write_hook("ckpt_save", path)
 
 
-def load_pytree(path: str) -> Any:
+def _read_sidecar(path: str):
+    """The expected digest, or None when no sidecar exists (pre-ISSUE-6
+    snapshots stay loadable — they just can't be *verified*)."""
+    try:
+        if is_remote(path):
+            fs, p = _fs_for(path)
+            if not fs.exists(p + CHECKSUM_SUFFIX):
+                return None
+            with fs.open(p + CHECKSUM_SUFFIX, "rb") as f:
+                return f.read().decode().strip()
+        if not os.path.exists(checksum_path(path)):
+            return None
+        with open(checksum_path(path)) as f:
+            return f.read().strip()
+    except OSError:
+        return None
+
+
+def load_pytree(path: str, verify: bool = True) -> Any:
+    """Load a pytree; when ``verify`` and a checksum sidecar exists, the
+    blob is digested first and a mismatch raises :class:`ChecksumError`
+    — a torn or bit-rotted checkpoint fails loudly at restore instead of
+    deserializing garbage."""
+    _fault_hook("ckpt_restore")
+    expected = _read_sidecar(path) if verify else None
     if is_remote(path):
         fs, p = _fs_for(path)
         with fs.open(p, "rb") as f:
             buf = io.BytesIO(f.read())
+        if expected is not None:
+            got = hashlib.sha256(buf.getbuffer()).hexdigest()
+            if got != expected:
+                raise ChecksumError(
+                    f"{path}: checksum mismatch (sidecar {expected[:12]}…, "
+                    f"blob {got[:12]}…) — torn write or bit-rot")
     else:
+        if expected is not None:
+            got = _file_sha256(path)
+            if got != expected:
+                raise ChecksumError(
+                    f"{path}: checksum mismatch (sidecar {expected[:12]}…, "
+                    f"blob {got[:12]}…) — torn write or bit-rot")
         buf = path
     with np.load(buf, allow_pickle=False) as z:
         treedef = pickle.loads(z["__treedef__"].tobytes())
         leaves = [z[f"leaf_{i}"] for i in range(len(z.files) - 1)]
     return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+def verify_checkpoint(path: str) -> bool:
+    """True when ``path`` is usable: its sidecar (if any) matches the
+    blob. Sidecar-less artifacts (legacy snapshots, orbax directories)
+    verify True — they cannot be checked, only trusted, as before."""
+    try:
+        if isdir(path):
+            return True  # orbax sharded dirs carry no single-blob digest
+        expected = _read_sidecar(path)
+        if expected is None:
+            return True
+        if is_remote(path):
+            fs, p = _fs_for(path)
+            with fs.open(p, "rb") as f:
+                got = hashlib.sha256(f.read()).hexdigest()
+        else:
+            got = _file_sha256(path)
+        return got == expected
+    except OSError:
+        return False
 
 
 def save_module(module, params, mod_state, path: str) -> None:
@@ -184,6 +290,107 @@ def latest_checkpoint_pair(directory: str):
         return None, None
     n = max(common)
     return join(f"model.{n}"), join(f"state.{n}")
+
+
+def _dir_listing(directory: str):
+    """(names, join) for local dirs and fsspec URIs — None when the
+    directory does not exist. The shared base of the pair/GC helpers."""
+    if is_remote(directory):
+        fs, d = _fs_for(directory)
+        if not fs.isdir(d):
+            return None
+        scheme = directory.split("://", 1)[0]
+        names = [e.rsplit("/", 1)[-1] for e in fs.ls(d, detail=False)]
+        return names, (lambda f: f"{scheme}://{d.rstrip('/')}/{f}")
+    if not os.path.isdir(directory):
+        return None
+    names = os.listdir(directory)
+    return names, (lambda f: os.path.join(directory, f))
+
+
+def _snapshot_indices(names, prefix):
+    out = set()
+    for f in names:
+        if f.startswith(prefix):
+            try:
+                out.add(int(f[len(prefix):]))
+            except ValueError:
+                pass  # .sha256 sidecars, .tmp leftovers
+    return out
+
+
+def latest_valid_checkpoint_pair(directory: str):
+    """Newest iteration n whose ``model.n``/``state.n`` pair BOTH verify
+    against their checksum sidecars, as ``(model_path, state_path)`` —
+    ``(None, None)`` if none. Corrupt (checksum-mismatched) pairs are
+    skipped with a warning, falling back to the previous pair: the
+    recovery contract a supervised resume relies on (a bit-rotted newest
+    snapshot must cost one checkpoint interval, not the run)."""
+    listing = _dir_listing(directory)
+    if listing is None:
+        return None, None
+    names, join = listing
+    common = (_snapshot_indices(names, "model.")
+              & _snapshot_indices(names, "state."))
+    for n in sorted(common, reverse=True):
+        m, s = join(f"model.{n}"), join(f"state.{n}")
+        if verify_checkpoint(m) and verify_checkpoint(s):
+            return m, s
+        logger.warning("checkpoint pair %d in %s fails checksum "
+                       "verification — falling back to the previous "
+                       "snapshot", n, directory)
+    return None, None
+
+
+def gc_checkpoints(directory: str, keep_last: int,
+                   prefixes=("model.", "state.")):
+    """Delete all but the newest ``keep_last`` snapshot iterations (blobs
+    + sidecars). The newest VALID pair is never deleted, even when
+    corrupt newer snapshots push it outside the keep window — the GC
+    must not destroy the only recovery point. Returns deleted paths."""
+    if keep_last < 1:
+        raise ValueError(f"keep_last must be >= 1, got {keep_last}")
+    listing = _dir_listing(directory)
+    if listing is None:
+        return []
+    names, join = listing
+    all_idx = set()
+    for prefix in prefixes:
+        all_idx |= _snapshot_indices(names, prefix)
+    keep = set(sorted(all_idx, reverse=True)[:keep_last])
+    m_valid, _ = latest_valid_checkpoint_pair(directory)
+    if m_valid is not None:
+        tail = str(m_valid).rstrip("/").rsplit(".", 1)[-1]
+        if tail.isdigit():
+            keep.add(int(tail))
+    deleted = []
+    remote = is_remote(directory)
+    for n in sorted(all_idx - keep):
+        for prefix in prefixes:
+            if n not in _snapshot_indices(names, prefix):
+                continue
+            for path in (join(f"{prefix}{n}"),
+                         join(f"{prefix}{n}") + CHECKSUM_SUFFIX):
+                try:
+                    if remote:
+                        fs, p = _fs_for(path)
+                        if fs.exists(p):
+                            fs.rm(p, recursive=True)
+                            deleted.append(path)
+                    elif os.path.isdir(path):
+                        import shutil
+                        shutil.rmtree(path)
+                        deleted.append(path)
+                    elif os.path.exists(path):
+                        os.remove(path)
+                        deleted.append(path)
+                except OSError as e:
+                    logger.warning("checkpoint GC: could not delete %s: "
+                                   "%s", path, e)
+    if deleted:
+        logger.info("checkpoint GC: removed %d artifact(s), kept "
+                    "iterations %s", len(deleted), sorted(keep))
+    return deleted
 
 
 def orphaned_snapshots(directory: str, newer_than: int):

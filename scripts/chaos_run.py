@@ -1,0 +1,274 @@
+#!/usr/bin/env python
+"""Chaos harness (ISSUE 6): kill a real training run at injected steps,
+restart it under the supervisor, and assert the final params are
+BIT-IDENTICAL to an uninterrupted run — PR 2's resume-equivalence test
+turned into an end-to-end CI property that covers the whole stack:
+fault injector -> process death (os._exit, nothing flushes) ->
+checksum-verified newest-valid-pair resume -> step-equivalent replay.
+
+    # CI: 2 preemptions, equivalence asserted, rc 0/1
+    python scripts/chaos_run.py --kills 2 --platform cpu
+
+    # kill mid-checkpoint too (torn pair -> fallback to previous)
+    python scripts/chaos_run.py --kills 1 --kill-in-ckpt --platform cpu
+
+The trainee (``--worker`` mode, same file) is a deterministic tiny
+model with Dropout — rng-SENSITIVE on purpose, so a resume that
+replayed the wrong key stream would diverge measurably, not silently.
+Checkpoints land every ``--ckpt-every`` iterations; each restart
+resumes from the newest checksum-valid pair. The parent is
+``resilience.supervise_command``: restart while the child dies with
+``PREEMPT_RC`` (75), bounded budget, deterministic backoff.
+
+Emits one JSON line: {"equal": bool, "kills": [...], "restarts": N,
+"fault_events": [...]} and exits nonzero on any mismatch or missing
+fault-log entry.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+# ------------------------------------------------------------------ worker
+def worker_main(args) -> int:
+    """One training attempt: resume from the newest valid pair (if any),
+    train to --max-it, write final params to --out."""
+    if args.platform:
+        import jax
+        jax.config.update("jax_platforms", args.platform)
+    if args.faultPlan:
+        from bigdl_tpu.resilience.faults import install_plan, parse_plan
+        install_plan(parse_plan(args.faultPlan),
+                     log_path=args.faultLog or None)
+
+    import numpy as np
+
+    from bigdl_tpu import nn
+    from bigdl_tpu.dataset.dataset import BatchDataSet
+    from bigdl_tpu.optim import Optimizer, SGD, Trigger
+    from bigdl_tpu.utils.file import save_pytree
+
+    # deterministic data + a Dropout layer: the same trainee as
+    # tests/test_resume_equivalence.py — rng-sensitive, so a wrong
+    # resume diverges instead of passing by luck
+    rs = np.random.RandomState(0)
+    x = rs.randn(64, 8).astype(np.float32)
+    y = rs.randint(0, 3, 64).astype(np.int32)
+    model = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Dropout(0.5),
+                          nn.Linear(16, 3), nn.LogSoftMax())
+    ds = BatchDataSet(x, y, 16)  # 4 iterations/epoch
+    opt = Optimizer(model, ds, nn.ClassNLLCriterion(),
+                    optim_method=SGD(learning_rate=0.1),
+                    end_when=Trigger.max_iteration(args.maxIt), seed=7,
+                    log_every=100)
+    opt.set_checkpoint(Trigger.several_iteration(args.ckptEvery),
+                       args.ckpt)
+    # resume() is a no-op on an empty dir, picks the newest checksum-
+    # VALID pair otherwise (falling back past torn/corrupt snapshots),
+    # and accepts a model-only blob when a kill landed between the
+    # model.<n> and state.<n> writes
+    opt.resume(args.ckpt)
+    trained = opt.optimize()
+    save_pytree({"params": trained.params}, args.out)
+    return 0
+
+
+# ------------------------------------------------------------------ parent
+def _resumed_iteration(ckpt_dir: str) -> int:
+    """Mirror Optimizer.resume's selection exactly (valid pair, else a
+    checksum-valid model-only blob) so the parent's local-visit math
+    targets the same global step the worker will actually resume at."""
+    from bigdl_tpu.utils.file import (latest_checkpoint,
+                                      latest_valid_checkpoint_pair,
+                                      verify_checkpoint)
+    m, _s = latest_valid_checkpoint_pair(ckpt_dir)
+    if m is None:
+        m = latest_checkpoint(ckpt_dir, "model.")
+        if m is None or not verify_checkpoint(m):
+            return 0
+    tail = str(m).rstrip("/").rsplit(".", 1)[-1]
+    return int(tail) if tail.isdigit() else 0
+
+
+def _worker_argv(args, ckpt: str, out: str, plan: str = "",
+                 fault_log: str = "") -> list:
+    argv = [sys.executable, os.path.abspath(__file__), "--worker",
+            "--max-it", str(args.maxIt), "--ckpt-every",
+            str(args.ckptEvery), "--ckpt", ckpt, "--out", out]
+    if args.platform:
+        argv += ["--platform", args.platform]
+    if plan:
+        argv += ["--faultPlan", plan]
+    if fault_log:
+        argv += ["--faultLog", fault_log]
+    return argv
+
+
+def _load_params(path: str):
+    from bigdl_tpu.utils.file import load_pytree
+    return load_pytree(path)["params"]
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser("chaos_run")
+    p.add_argument("--worker", action="store_true",
+                   help="internal: run one training attempt")
+    p.add_argument("--kills", type=int, default=2,
+                   help="process-fatal preemptions to inject at evenly "
+                        "spaced steps")
+    p.add_argument("--kill-steps", default=None,
+                   help="explicit comma-separated global kill steps "
+                        "(overrides --kills spacing)")
+    p.add_argument("--kill-in-ckpt", action="store_true",
+                   help="also preempt INSIDE a checkpoint write on the "
+                        "first attempt (torn pair -> previous-pair "
+                        "fallback)")
+    p.add_argument("--max-it", dest="maxIt", type=int, default=12)
+    p.add_argument("--ckpt-every", dest="ckptEvery", type=int, default=3)
+    p.add_argument("--budget", type=int, default=8,
+                   help="restart budget for the supervising parent")
+    p.add_argument("--platform", default=None, choices=["cpu", "tpu"])
+    p.add_argument("--workdir", default=None,
+                   help="keep artifacts here instead of a fresh tempdir")
+    # worker-only flags
+    p.add_argument("--ckpt", default=None)
+    p.add_argument("--out", default=None)
+    p.add_argument("--faultPlan", default=None)
+    p.add_argument("--faultLog", default=None)
+    args = p.parse_args(argv)
+
+    if args.worker:
+        return worker_main(args)
+
+    import numpy as np
+
+    from bigdl_tpu.resilience.supervisor import (RetryPolicy,
+                                                 supervise_command)
+
+    wd = args.workdir or tempfile.mkdtemp(prefix="chaos_")
+    os.makedirs(wd, exist_ok=True)
+    if args.kill_steps:
+        kills = sorted(int(t) for t in args.kill_steps.split(",") if t)
+    else:
+        n = max(args.kills, 0)
+        kills = sorted({max(1, round(args.maxIt * (i + 1) / (n + 1)))
+                        for i in range(n)})
+    print(f"chaos: max_it={args.maxIt} ckpt_every={args.ckptEvery} "
+          f"kills_at={kills} kill_in_ckpt={args.kill_in_ckpt} "
+          f"workdir={wd}", flush=True)
+
+    # 1. the uninterrupted reference run
+    base_out = os.path.join(wd, "base.npz")
+    rc = __import__("subprocess").call(
+        _worker_argv(args, os.path.join(wd, "ck_base"), base_out))
+    if rc != 0:
+        print(f"chaos: baseline run failed rc={rc}", flush=True)
+        return 2
+
+    # 2. the chaos run: inject preemptions, restart + resume each time
+    chaos_ck = os.path.join(wd, "ck_chaos")
+    chaos_out = os.path.join(wd, "chaos.npz")
+    fault_log = os.path.join(wd, "faults.jsonl")
+
+    def _fired() -> tuple:
+        """(step_kills_fired, ckpt_kill_fired) read from the fault log —
+        the dying child's own record, so accounting survives any
+        fire-order interleaving of the step and ckpt rules."""
+        steps, ckpt = 0, False
+        if os.path.exists(fault_log):
+            with open(fault_log) as f:
+                for line in f:
+                    if not line.strip():
+                        continue
+                    e = json.loads(line)
+                    if e.get("fault") != "preempt":
+                        continue
+                    if e.get("site") == "step":
+                        steps += 1
+                    elif e.get("site") == "ckpt_save":
+                        ckpt = True
+        return steps, ckpt
+
+    def make_argv(attempt: int) -> list:
+        resumed = _resumed_iteration(chaos_ck)
+        step_fired, ckpt_fired = _fired()
+        entries = []
+        remaining = kills[step_fired:]
+        if remaining:
+            # the injector counts per-process step visits; after a
+            # resume at iteration r, global step k is local visit k - r
+            local = remaining[0] - resumed
+            if local >= 1:
+                entries.append(f"preempt@step:{local}")
+        if args.kill_in_ckpt and not ckpt_fired:
+            # visit 2 = the state.<n> write of this attempt's FIRST
+            # snapshot: the pair is torn mid-write, resume must fall
+            # back (model-only or previous pair)
+            entries.append("preempt@ckpt_save:2")
+        plan = ";".join(entries)
+        print(f"chaos: attempt {attempt + 1} resumed_at={resumed} "
+              f"plan={plan or '(none)'}", flush=True)
+        return _worker_argv(args, chaos_ck, chaos_out, plan, fault_log)
+
+    expected_kills = len(kills) + (1 if args.kill_in_ckpt else 0)
+    rc, events = supervise_command(
+        make_argv,
+        policy=RetryPolicy(budget=args.budget, base_s=0.05, max_s=0.5),
+    )
+    if rc != 0:
+        print(f"chaos: supervised run did not converge rc={rc} "
+              f"events={json.dumps(events)}", flush=True)
+        return 2
+
+    # 3. every injected fault must appear in the fault log (written by
+    #    the dying child BEFORE os._exit)
+    fault_events = []
+    if os.path.exists(fault_log):
+        with open(fault_log) as f:
+            fault_events = [json.loads(line) for line in f if line.strip()]
+    restarts = sum(1 for e in events if e.get("event") == "restart")
+
+    # 4. the acceptance bit: params identical to the uninterrupted run
+    import jax
+    a = jax.tree_util.tree_leaves(_load_params(base_out))
+    b = jax.tree_util.tree_leaves(_load_params(chaos_out))
+    equal = (len(a) == len(b)
+             and all(np.array_equal(np.asarray(x), np.asarray(y))
+                     for x, y in zip(a, b)))
+
+    out = {
+        "chaos": "kill_resume_equivalence",
+        "max_it": args.maxIt,
+        "ckpt_every": args.ckptEvery,
+        "kills": kills,
+        "kill_in_ckpt": args.kill_in_ckpt,
+        "restarts": restarts,
+        "equal": equal,
+        "fault_events": fault_events,
+        "supervisor_events": events,
+    }
+    print(json.dumps(out), flush=True)
+    ok = (equal and restarts == expected_kills
+          and len(fault_events) == expected_kills
+          and all(e.get("fault") == "preempt" for e in fault_events))
+    if not ok:
+        print(f"chaos: FAILED (equal={equal}, restarts={restarts}/"
+              f"{expected_kills}, logged_faults={len(fault_events)}/"
+              f"{expected_kills})", flush=True)
+        return 1
+    print(f"chaos: OK — {expected_kills} preemption(s), {restarts} "
+          f"supervised restart(s), final params bit-identical",
+          flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -56,6 +56,25 @@ def _post(url, body, timeout=120.0):
         return r.status, json.loads(r.read())
 
 
+def _post_status(url, body, timeout=120.0):
+    """Like _post but 4xx/5xx return (status, body) instead of raising
+    — the chaos smoke asserts exact error codes."""
+    try:
+        return _post(url, body, timeout)
+    except urllib.error.HTTPError as e:
+        try:
+            return e.code, json.loads(e.read())
+        except (ValueError, json.JSONDecodeError):
+            return e.code, {}
+
+
+def _get_status(url, timeout=30.0):
+    try:
+        return _get(url, timeout)
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
 def _get(url, timeout=30.0):
     with urllib.request.urlopen(url, timeout=timeout) as r:
         return r.status, r.read().decode()
@@ -225,6 +244,81 @@ def run_smoke(url, args, page_checks=True):
     print("smoke: endpoints + metrics provenance OK", flush=True)
 
 
+def _shutdown_clean(proc, log_lines):
+    proc.send_signal(signal.SIGTERM)
+    try:
+        rc = proc.wait(timeout=60)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        raise SystemExit("server ignored SIGTERM")
+    assert rc == 0, f"server exit code {rc} after SIGTERM"
+    assert any("serving shutdown clean" in l for l in log_lines), \
+        "missing clean-shutdown marker in server log"
+
+
+def run_chaos_smoke(args):
+    """ISSUE 6 serving-hardening assertions (CI chaos-smoke job):
+
+    leg 1 — deadline expiry: a request with deadline_ms=0 is dropped
+    BEFORE compute and answered 504 (distinct from admission 429),
+    while normal requests keep answering 200;
+
+    leg 2 — worker kill: a --faultPlan kills the batcher worker on its
+    2nd flush; the in-flight request errors (500), the NEXT submit
+    fast-fails 503 in well under a second (no hanging until client
+    timeout), /readyz flips 503 while /healthz stays 200, the fault
+    counters land in /metrics, and SIGTERM still shuts down rc=0."""
+    rng_payload = make_payload(args)
+
+    # ---- leg 1: deadline expiry -> 504, healthy path unaffected
+    proc, url, log_lines = spawn_server(args, list(args.serveArg))
+    try:
+        st, _ = _post_status(url + "/predict", rng_payload)
+        assert st == 200, f"healthy predict -> {st}"
+        st, body = _post_status(url + "/predict",
+                                {**rng_payload, "deadline_ms": 0})
+        assert st == 504, f"expired-deadline predict -> {st} ({body})"
+        assert "deadline" in body.get("error", ""), body
+        st, _ = _post_status(url + "/predict", rng_payload)
+        assert st == 200, f"predict after 504 -> {st}"
+        st, _ = _get_status(url + "/readyz")
+        assert st == 200, f"/readyz (healthy) -> {st}"
+        print("chaos-smoke: deadline expiry -> 504, healthy path OK",
+              flush=True)
+    finally:
+        _shutdown_clean(proc, log_lines)
+
+    # ---- leg 2: worker kill -> fast 503 + readiness flip
+    proc, url, log_lines = spawn_server(
+        args, list(args.serveArg)
+        + ["--faultPlan", "worker_kill@infer:2", "--watchdogStallS", "5"])
+    try:
+        st, _ = _post_status(url + "/predict", rng_payload)
+        assert st == 200, f"predict before kill -> {st}"
+        st, body = _post_status(url + "/predict", rng_payload)
+        assert st == 500, f"killed-flush predict -> {st} ({body})"
+        t0 = time.perf_counter()
+        st, body = _post_status(url + "/predict", rng_payload)
+        dt = time.perf_counter() - t0
+        assert st == 503, f"post-kill predict -> {st} ({body})"
+        assert dt < 2.0, f"dead-worker 503 took {dt:.2f}s (not fast)"
+        st, _ = _get_status(url + "/readyz")
+        assert st == 503, f"/readyz (dead worker) -> {st}"
+        st, _ = _get_status(url + "/healthz")
+        assert st == 200, f"/healthz must stay live, got {st}"
+        _, page = _get(url + "/metrics")
+        for needle in ("batcher_worker_up 0",
+                       "requests_worker_dead_total"):
+            assert needle in page, f"metrics missing {needle!r}"
+        print(f"chaos-smoke: worker kill -> 500 then fast 503 "
+              f"({dt * 1000:.0f} ms), /readyz 503, /healthz 200 OK",
+              flush=True)
+    finally:
+        _shutdown_clean(proc, log_lines)
+    print("chaos-smoke: all serving-hardening assertions OK", flush=True)
+    return 0
+
+
 def main(argv=None):
     p = argparse.ArgumentParser("serving_bench")
     p.add_argument("--model", default="lenet5",
@@ -247,12 +341,21 @@ def main(argv=None):
     p.add_argument("--platform", default=None, choices=["cpu", "tpu"])
     p.add_argument("--smoke", action="store_true",
                    help="assertion pass + clean-shutdown check (CI)")
+    p.add_argument("--chaosSmoke", action="store_true",
+                   help="serving-hardening assertion pass (ISSUE 6): "
+                        "deadline-expiry 504, worker-kill fast 503 + "
+                        "watchdog readiness flip (spawns its own "
+                        "servers)")
     p.add_argument("--serveArg", action="append", default=[],
                    metavar="ARG",
                    help="extra flag forwarded to the spawned serve CLI "
                         "(repeatable), e.g. --serveArg=--fusedBN "
                         "--serveArg=apply")
     args = p.parse_args(argv)
+
+    if args.chaosSmoke:
+        args.endpoint, args.batch = "predict", 2
+        return run_chaos_smoke(args)
 
     proc = None
     if args.url:

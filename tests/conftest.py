@@ -61,6 +61,10 @@ def pytest_configure(config):
         "markers",
         "tpu: needs a real TPU backend (compiled Pallas path); skipped on "
         "the CPU test platform, run manually in the bench environment")
+    config.addinivalue_line(
+        "markers",
+        "slow: excluded from the tier-1 sweep (-m 'not slow'); run by "
+        "dedicated CI jobs (chaos-smoke) or manually")
 
 
 @pytest.fixture
