@@ -96,6 +96,113 @@ def add_resilience_args(p: argparse.ArgumentParser) -> None:
                         "No-op when unset")
 
 
+def add_obs_args(p: argparse.ArgumentParser) -> None:
+    """--obs/--traceDir/--traceSteps/--metricsPort (ISSUE 7): the
+    unified observability layer, shared by perf and every training
+    CLI."""
+    p.add_argument("--obs", action="store_true",
+                   help="step-phase observability (bigdl_tpu.obs): span "
+                        "tracing around the loop's real phases "
+                        "(data_wait/h2d/dispatch/device/ckpt), per-step "
+                        "phase histograms in the shared metrics "
+                        "registry, and phase columns stamped into perf "
+                        "JSON lines. Off: zero-cost no-ops, output "
+                        "byte-identical modulo null columns")
+    p.add_argument("--traceDir", default=None, metavar="DIR",
+                   help="observability artifact dir: the Chrome-trace "
+                        "span timeline (spans.trace.json — load in "
+                        "chrome://tracing or ui.perfetto.dev) plus any "
+                        "on-demand profile capture windows. Implies "
+                        "--obs")
+    p.add_argument("--traceSteps", default=None, metavar="N@M",
+                   help="capture a jax.profiler trace of steps M..M+N-1 "
+                        "mid-run into --traceDir (verified parseable "
+                        "with utils/xplane on close). Independently, "
+                        "SIGUSR2 or `touch DIR/CAPTURE` opens a bounded "
+                        "window on a run already in flight")
+    p.add_argument("--metricsPort", type=int, default=None, metavar="PORT",
+                   help="start a live /metrics listener (serving's "
+                        "Prometheus exposition format) for this "
+                        "training/perf run; 0 = ephemeral (printed)")
+
+
+class ObsState:
+    """What install_observability wired up for this process: whether
+    span tracing is on, the capture controller (--traceSteps/SIGUSR2/
+    touch-file), the live metrics listener, and where artifacts land.
+    ``finalize()`` is idempotent — the perf harness calls it before
+    stamping its JSON line, the training path after optimize()."""
+
+    def __init__(self, enabled: bool, trace_dir: Optional[str],
+                 capture, server):
+        self.enabled = enabled
+        self.trace_dir = trace_dir
+        self.capture = capture
+        self.server = server
+        self._final: Optional[dict] = None
+
+    def finalize(self) -> dict:
+        """Close any open capture window and export the span timeline;
+        returns ``{trace_json, span_events, captures}`` (present keys
+        only)."""
+        if self._final is not None:
+            return self._final
+        from bigdl_tpu import obs
+        info: dict = {}
+        if self.capture is not None:
+            self.capture.finish()
+            ann = self.capture.annotation()
+            if ann:
+                info["captures"] = ann
+        tracer = obs.get_tracer()
+        if tracer is not None and self.trace_dir:
+            path = os.path.join(self.trace_dir, "spans.trace.json")
+            try:
+                n = tracer.export_chrome_trace(path)
+            except OSError as e:
+                logging.getLogger(__name__).warning(
+                    "obs: span export to %s failed: %s", path, e)
+            else:
+                info["trace_json"] = path
+                info["span_events"] = n
+                print(f"obs: wrote {n} span(s) to {path}", flush=True)
+        self._final = info
+        return info
+
+
+def install_observability(args) -> Optional[ObsState]:
+    """Activate the --obs/--traceDir/--traceSteps/--metricsPort surface
+    (no-op returning None when none are set). --traceDir implies span
+    tracing; --traceSteps needs --traceDir (captures need a home). The
+    state is also stashed on ``args`` for downstream wiring."""
+    obs_flag = getattr(args, "obs", False)
+    trace_dir = getattr(args, "traceDir", None)
+    trace_steps = getattr(args, "traceSteps", None)
+    port = getattr(args, "metricsPort", None)
+    if not (obs_flag or trace_dir or trace_steps or port is not None):
+        return None
+    if trace_steps and not trace_dir:
+        raise SystemExit("--traceSteps needs --traceDir DIR (somewhere "
+                         "for the capture windows to land)")
+    from bigdl_tpu import obs
+    enabled = bool(obs_flag or trace_dir)
+    if enabled and not obs.enabled():
+        obs.enable()
+    capture = None
+    if trace_dir:
+        try:
+            capture = obs.CaptureController(trace_dir,
+                                            trace_steps=trace_steps)
+        except ValueError as e:
+            raise SystemExit(str(e))
+    server = None
+    if port is not None:
+        server = obs.start_metrics_server(obs.get_registry(), port=port)
+    state = ObsState(enabled, trace_dir, capture, server)
+    args._obs = state
+    return state
+
+
 def install_fault_plan(args) -> None:
     """Activate --faultPlan process-wide (BIGDL_FAULT_LOG names a JSONL
     file every fired fault is appended to — written before process-fatal
@@ -118,16 +225,28 @@ def run_optimize(make_optimizer, args):
     and resumes from the newest checksum-valid snapshot in
     --checkpoint, replaying the exact rng/batch stream of an
     uninterrupted run (the PR 2 step-equivalence contract)."""
+    obs_state = getattr(args, "_obs", None)
+
+    def _make():
+        opt = make_optimizer()
+        if obs_state is not None and obs_state.capture is not None:
+            opt.set_capture(obs_state.capture)
+        return opt
+
     budget = getattr(args, "supervise", None)
     if budget is None:
-        return make_optimizer().optimize()
+        try:
+            return _make().optimize()
+        finally:
+            if obs_state is not None:
+                obs_state.finalize()
     from bigdl_tpu.resilience.supervisor import RetryPolicy, Supervisor
     ckpt_dir = getattr(args, "checkpoint", None)
     sup = Supervisor(RetryPolicy(budget=int(budget),
                                  seed=getattr(args, "seed", 0)))
 
     def attempt(n):
-        opt = make_optimizer()
+        opt = _make()
         if n > 0 and ckpt_dir:
             # resume() is a no-op on an empty dir, picks the newest
             # checksum-valid pair otherwise, and falls back to a
@@ -136,7 +255,11 @@ def run_optimize(make_optimizer, args):
             opt.resume(ckpt_dir)
         return opt.optimize()
 
-    result = sup.run(attempt)
+    try:
+        result = sup.run(attempt)
+    finally:
+        if obs_state is not None:
+            obs_state.finalize()
     ann = sup.annotation()
     if ann["retries"] or ann["events"]:
         logging.getLogger(__name__).info(
@@ -208,6 +331,7 @@ def apply_platform(args) -> None:
         jax.config.update("jax_platforms", platform)
     enable_compile_cache()
     install_fault_plan(args)  # --faultPlan (no-op when unset)
+    install_observability(args)  # --obs family (no-op when unset)
     mode = getattr(args, "autotune", None)
     if mode:
         from bigdl_tpu import tuning
@@ -290,6 +414,7 @@ def add_train_args(p: argparse.ArgumentParser) -> None:
                         "(GC after each write; the newest checksum-"
                         "VALID pair is never deleted)")
     add_resilience_args(p)
+    add_obs_args(p)
     p.add_argument("--dataParallel", action="store_true",
                    help="shard the batch over all visible devices")
     add_autotune_arg(p)

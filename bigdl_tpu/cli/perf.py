@@ -238,6 +238,46 @@ def _annotate_bn_fused(out: dict, model) -> None:
     out["bn_fused"] = bn_fused_mode(model)
 
 
+_PHASE_COLUMNS = ("data_wait_s", "h2d_s", "dispatch_s", "device_s",
+                  "ckpt_s", "stall_frac")
+
+
+def _annotate_obs_phases(out: dict, obs_state, phase: dict | None = None,
+                         wall_s: float | None = None) -> None:
+    """Stamp the step-phase columns into a result dict (ISSUE 7). The
+    columns are ALWAYS present so the JSON schema is stable: null in an
+    obs-off run (whose output stays byte-identical to pre-obs output
+    modulo exactly these nulls), measured cumulative seconds under
+    --obs. ``stall_frac`` is the feed-stall fraction of wall time — the
+    number PERF.md §4 could previously only infer. Under --obs the
+    trace/capture artifacts ride along as ``obs``."""
+    on = (obs_state is not None and obs_state.enabled
+          and phase is not None)
+    if not on:
+        for c in _PHASE_COLUMNS:
+            out[c] = None
+        return
+    out["data_wait_s"] = round(phase.get("data_wait", 0.0), 4)
+    out["h2d_s"] = round(phase.get("h2d", 0.0), 4)
+    out["dispatch_s"] = round(phase.get("dispatch", 0.0), 4)
+    out["device_s"] = round(phase.get("device", 0.0), 4)
+    out["ckpt_s"] = round(phase.get("ckpt", 0.0), 4)
+    out["stall_frac"] = (round(phase.get("data_wait", 0.0) / wall_s, 4)
+                         if wall_s else None)
+    info = obs_state.finalize()
+    o: dict = {}
+    if "trace_json" in info:
+        o["trace_json"] = info["trace_json"]
+        o["span_events"] = info["span_events"]
+    if "captures" in info:
+        o["captures"] = [
+            {k: c[k] for k in ("start_step", "stop_step", "trigger",
+                               "ok", "dir", "error") if k in c}
+            for c in info["captures"]]
+    if o:
+        out["obs"] = o
+
+
 def _annotate_supervisor(out: dict, supervisor) -> None:
     """Stamp the structured fault/recovery log next to bn_fused/lint
     (ISSUE 6): under --supervise the full supervisor annotation
@@ -258,7 +298,7 @@ def run(model_name: str, batch: int, iterations: int, data_type: str,
         data_source: str | None = None, inner_steps: int = 1,
         profile_dir: str | None = None, autotune: str | None = None,
         fused_bn: str | None = None, lint: dict | None = None,
-        supervisor=None):
+        supervisor=None, obs_state=None):
     """Throughput harness entry. ``autotune`` optionally installs the
     tuning mode (the CLI does it via --autotune/apply_platform; bench.py
     children pass it directly). ``fused_bn`` ('off'/'stats'/'apply')
@@ -278,7 +318,8 @@ def run(model_name: str, batch: int, iterations: int, data_type: str,
                           use_bf16=use_bf16, data_parallel=data_parallel,
                           data_source=data_source, inner_steps=inner_steps,
                           profile_dir=profile_dir, fused_bn=fused_bn,
-                          lint=lint, supervisor=supervisor)
+                          lint=lint, supervisor=supervisor,
+                          obs_state=obs_state)
     finally:
         conv2d.restore_policy(snap)
 
@@ -288,7 +329,7 @@ def _run_timed(model_name: str, batch: int, iterations: int, data_type: str,
                data_source: str | None = None, inner_steps: int = 1,
                profile_dir: str | None = None,
                fused_bn: str | None = None, lint: dict | None = None,
-               supervisor=None):
+               supervisor=None, obs_state=None):
     import os
 
     import jax
@@ -456,20 +497,74 @@ def _run_timed(model_name: str, batch: int, iterations: int, data_type: str,
         trace_cm = jax.profiler.trace(profile_dir)
 
     from bigdl_tpu.resilience.faults import hook as _fault_hook
+
+    # --obs: per-step phase metering (ISSUE 7). The obs-on loop is a
+    # separate branch so the obs-off loop stays UNTOUCHED — obs-off
+    # output must be byte-identical to pre-obs output (modulo the null
+    # phase columns), and the per-step block_until_ready that makes
+    # device time exact costs dispatch pipelining (that delta IS the
+    # obs overhead, measured by scripts/tpu_capture_r12.sh's A/B).
+    obs_on = obs_state is not None and obs_state.enabled
+    capture = obs_state.capture if obs_state is not None else None
+    phase = None
     t0 = time.perf_counter()
     with trace_cm:
-        for _ in range(iterations):
-            if feed is not None:
-                mb = next(feed)
-                x = jnp.asarray(mb.input)   # host->device each step, as
-                y = jnp.asarray(mb.target)  # in a real training epoch
-            # fault site (one pointer check when no --faultPlan): the
-            # supervised-overhead A/B in scripts/tpu_capture_r11.sh
-            # bounds its cost
-            _fault_hook("step")
-            params, mod_state, opt_state, loss = step(params, mod_state,
-                                                      opt_state, x, y, k)
-        float(loss)  # scalar host read = true device sync (note above)
+        if obs_on:
+            from bigdl_tpu.obs import (get_registry, phase_histograms,
+                                       span)
+            phase = {p: 0.0 for p in ("data_wait", "h2d", "dispatch",
+                                      "device", "ckpt")}
+            hists = phase_histograms(get_registry(), "train")
+            pc = time.perf_counter
+
+            def _meter(name, t_start):
+                d = pc() - t_start
+                phase[name] += d
+                hists[name].observe(d * 1000.0)
+
+            for i in range(iterations):
+                if capture is not None:
+                    capture.on_step(i)
+                if feed is not None:
+                    t = pc()
+                    with span("data_wait"):
+                        mb = next(feed)
+                    _meter("data_wait", t)
+                    t = pc()
+                    with span("h2d"):
+                        x = jnp.asarray(mb.input)
+                        y = jnp.asarray(mb.target)
+                    _meter("h2d", t)
+                _fault_hook("step")
+                t = pc()
+                with span("dispatch"):
+                    params, mod_state, opt_state, loss = step(
+                        params, mod_state, opt_state, x, y, k)
+                _meter("dispatch", t)
+                t = pc()
+                with span("device"):
+                    jax.block_until_ready(loss)
+                _meter("device", t)
+            float(loss)
+            reg = get_registry()
+            for p_name, secs in phase.items():
+                if secs > 0.0:
+                    reg.counter(
+                        f"train_phase_{p_name}_seconds_total",
+                        f"cumulative {p_name} phase seconds").inc(secs)
+        else:
+            for _ in range(iterations):
+                if feed is not None:
+                    mb = next(feed)
+                    x = jnp.asarray(mb.input)   # host->device each step,
+                    y = jnp.asarray(mb.target)  # as in a real epoch
+                # fault site (one pointer check when no --faultPlan):
+                # the supervised-overhead A/B in tpu_capture_r11.sh
+                # bounds its cost
+                _fault_hook("step")
+                params, mod_state, opt_state, loss = step(
+                    params, mod_state, opt_state, x, y, k)
+            float(loss)  # scalar host read = true device sync (above)
     dt = time.perf_counter() - t0
 
     total_steps = iterations * inner_steps
@@ -498,6 +593,7 @@ def _run_timed(model_name: str, batch: int, iterations: int, data_type: str,
         "step_gflops_hlo": round(flops_hlo / 1e9, 3),
         "device": getattr(jax.devices()[0], "device_kind", "unknown"),
     }
+    _annotate_obs_phases(out, obs_state, phase, dt)
     _annotate_conv_layouts(out)
     _annotate_autotune(out)
     _annotate_bn_fused(out, model)
@@ -614,7 +710,7 @@ def run_time_to_acc(model_name: str, batch: int, target: float,
                     weight_decay: float = 1e-4,
                     fused_bn: str | None = None,
                     lint: dict | None = None,
-                    supervisor=None):
+                    supervisor=None, obs_state=None):
     """Time-to-accuracy harness (BASELINE.json metric: images/sec/chip
     **+ time-to-76%-top1**; reference recipe models/inception/Train.scala
     :77-83 + scripts/run.example.sh:54). Trains ``model_name`` from
@@ -683,6 +779,8 @@ def run_time_to_acc(model_name: str, batch: int, target: float,
                     if val_every_iters else Trigger.every_epoch())
         opt.set_validation(val_trig, val_ds, [Top1Accuracy()])
         opt.set_summary(summary_dir)
+        if obs_state is not None and obs_state.capture is not None:
+            opt.set_capture(obs_state.capture)
 
         t_train = time.time()
         opt.optimize()
@@ -723,6 +821,7 @@ def run_time_to_acc(model_name: str, batch: int, target: float,
         "curve": [{"wall_s": r.get("wall_s"),
                    "top1": r.get("top1_accuracy")} for r in curve],
     }
+    _annotate_obs_phases(out, obs_state, opt.phase_totals(), wall)
     _annotate_conv_layouts(out)
     _annotate_autotune(out)
     _annotate_bn_fused(out, model)
@@ -812,15 +911,16 @@ def main(argv=None):
                         "conv_geom in the result JSON")
     from bigdl_tpu.cli.common import (_add_platform_arg, add_autotune_arg,
                                       add_fused_bn_arg, add_lint_arg,
-                                      add_resilience_args, apply_platform,
-                                      run_preflight_lint)
+                                      add_obs_args, add_resilience_args,
+                                      apply_platform, run_preflight_lint)
     _add_platform_arg(p)
     add_autotune_arg(p)
     add_fused_bn_arg(p)
     add_lint_arg(p)
     add_resilience_args(p)
+    add_obs_args(p)
     args = p.parse_args(argv)
-    apply_platform(args)  # also installs --faultPlan
+    apply_platform(args)  # also installs --faultPlan and --obs
     if args.convLayout:
         # apply_platform already installed the spec (SystemExit on a bad
         # one); just surface what's active for the capture logs
@@ -842,6 +942,8 @@ def main(argv=None):
             report, strict=(args.lint == "strict"))
         if rc:
             return rc
+    obs_state = getattr(args, "_obs", None)
+
     def _go(supervisor=None):
         if args.timeToAcc is not None:
             data_dir = None
@@ -858,13 +960,14 @@ def main(argv=None):
                             val_every_iters=args.valEvery,
                             lift=args.ttaLift, noise=args.ttaNoise,
                             weight_decay=args.ttaWd, fused_bn=args.fusedBN,
-                            lint=lint_ann, supervisor=supervisor)
+                            lint=lint_ann, supervisor=supervisor,
+                            obs_state=obs_state)
             return
         run(args.model, args.batchSize, args.iteration, args.dataType,
             use_bf16=not args.f32, data_parallel=args.dataParallel,
             data_source=args.data, inner_steps=args.innerSteps,
             profile_dir=args.profile, fused_bn=args.fusedBN,
-            lint=lint_ann, supervisor=supervisor)
+            lint=lint_ann, supervisor=supervisor, obs_state=obs_state)
 
     if args.supervise is not None:
         # supervised perf: transient injected faults retry with backoff

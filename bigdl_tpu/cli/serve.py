@@ -171,6 +171,11 @@ def build_app(args):
             "runs")
 
     metrics = MetricsRegistry()
+    # install as the process-global registry (ISSUE 7): resilience
+    # fault/retry counters and any training-side phase publishes in this
+    # process land on the SAME /metrics page the server exposes
+    from bigdl_tpu.obs.metrics import set_registry
+    set_registry(metrics)
     engine = InferenceEngine(
         model, params, mod_state, buckets=_parse_buckets(args.buckets),
         compute_dtype=compute_dtype, lint=getattr(args, "lint", None),

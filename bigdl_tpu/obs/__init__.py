@@ -1,0 +1,51 @@
+"""Unified observability layer (ISSUE 7).
+
+BigDL's observability story — per-module wall-time counters
+(``AbstractModule.getTimes``) + cluster-wide named counters aggregated
+through Spark accumulators (``optim/Metrics.scala``, paper §4) — was
+reproduced in fragments: hand-rolled ``time.time()`` deltas in the
+Optimizer, a serving-only metrics registry, an offline-only xplane
+reader. This package is the substrate built once:
+
+* :mod:`spans`   — structured step-phase tracing: ``span("data_wait")``
+  around the real phases of training and serving, thread-safe,
+  ring-buffered, near-zero cost disabled, Chrome-trace/Perfetto export;
+* :mod:`metrics` — the shared process-global registry
+  (Counter/Gauge/Histogram + Prometheus exposition + provenance
+  stamping), promoted from ``serving/metrics.py`` and now fed by
+  training (step-phase histograms), resilience (fault/retry counters),
+  and serving alike;
+* :mod:`capture` — on-demand ``jax.profiler`` windows mid-run
+  (``--traceSteps N@M``, SIGUSR2, touch-file), verified parseable with
+  ``utils/xplane`` on close;
+* :mod:`http`    — a live ``/metrics`` listener for training runs,
+  reusing serving's exposition format.
+
+Wired as ``--obs``/``--traceDir``/``--traceSteps``/``--metricsPort`` on
+the perf + training CLIs (``cli/common.py``), with per-step phase
+columns (``data_wait_s``, ``h2d_s``, ``dispatch_s``, ``device_s``,
+``ckpt_s``, ``stall_frac``) stamped into every perf JSON line next to
+``bn_fused``/``lint``/``supervisor``. ROADMAP items 2 (collective time
+broken out) and 4 (feed-stall metering) read from this layer.
+"""
+
+from bigdl_tpu.obs.capture import (CaptureController, parse_trace_steps,
+                                   TOUCH_FILE_NAME)
+from bigdl_tpu.obs.http import MetricsServer, start_metrics_server
+from bigdl_tpu.obs.metrics import (Counter, DEFAULT_LATENCY_BUCKETS_MS,
+                                   Gauge, Histogram, MetricsRegistry,
+                                   PHASE_BUCKETS_MS, TRAIN_PHASES,
+                                   get_registry, phase_histograms,
+                                   reset_registry, set_registry)
+from bigdl_tpu.obs.spans import (NOOP_SPAN, Tracer, disable, enable,
+                                 enabled, get_tracer, set_tracer, span)
+
+__all__ = [
+    "CaptureController", "parse_trace_steps", "TOUCH_FILE_NAME",
+    "MetricsServer", "start_metrics_server",
+    "Counter", "Gauge", "Histogram", "MetricsRegistry",
+    "DEFAULT_LATENCY_BUCKETS_MS", "PHASE_BUCKETS_MS", "TRAIN_PHASES",
+    "get_registry", "phase_histograms", "reset_registry", "set_registry",
+    "NOOP_SPAN", "Tracer", "disable", "enable", "enabled", "get_tracer",
+    "set_tracer", "span",
+]

@@ -1,0 +1,99 @@
+"""Live ``/metrics`` for training runs (ISSUE 7 tentpole #4).
+
+Serving got scrape-based observability in PR 5; training runs still
+reported nothing until the process exited. This starts the same
+plaintext exposition (``obs.metrics.MetricsRegistry.render`` — one
+format, one set of dashboards) on a daemon-thread HTTP listener inside
+any training/perf process:
+
+    bigdl-tpu perf -m resnet50 --obs --metricsPort 9100 &
+    curl localhost:9100/metrics     # step-phase histograms, live
+
+Deliberately minimal: GET ``/metrics`` (Prometheus text) and
+``/healthz`` (liveness JSON) only, bound to localhost by default, one
+thread per connection via the stdlib ``ThreadingHTTPServer``. The
+listener never blocks training — scrapes read instrument snapshots
+under their own short locks — and dies with the process (daemon
+threads), so a crashed run can't leak a port.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+logger = logging.getLogger("bigdl_tpu")
+
+__all__ = ["MetricsServer", "start_metrics_server"]
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    def do_GET(self):  # noqa: N802 (stdlib naming)
+        if self.path == "/metrics":
+            try:
+                data = self.server.registry.render().encode()
+            except Exception as e:  # a broken gauge fn must not 500-loop
+                data = f"# render error: {e}\n".encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "text/plain; version=0.0.4")
+        elif self.path == "/healthz":
+            data = json.dumps({"status": "ok"}).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+        else:
+            data = json.dumps(
+                {"error": f"unknown path {self.path}"}).encode()
+            self.send_response(404)
+            self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def log_message(self, fmt, *args):
+        logger.debug("%s - %s", self.address_string(), fmt % args)
+
+
+class MetricsServer:
+    """A running training-side metrics listener; ``close()`` to stop."""
+
+    def __init__(self, registry, host: str = "127.0.0.1", port: int = 0):
+        self._srv = ThreadingHTTPServer((host, port), _Handler)
+        self._srv.daemon_threads = True
+        self._srv.registry = registry  # type: ignore[attr-defined]
+        self.host = host
+        self.port = self._srv.server_address[1]
+        self._thread = threading.Thread(
+            target=self._srv.serve_forever, kwargs={"poll_interval": 0.5},
+            name="obs-metrics", daemon=True)
+        self._thread.start()
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}/metrics"
+
+    def close(self) -> None:
+        self._srv.shutdown()
+        self._srv.server_close()
+        self._thread.join(timeout=5.0)
+
+
+def start_metrics_server(registry, port: int = 0,
+                         host: str = "127.0.0.1"
+                         ) -> Optional[MetricsServer]:
+    """Start the listener (port 0 = ephemeral); returns None instead of
+    raising when the bind fails — observability must never kill the run
+    it observes."""
+    try:
+        srv = MetricsServer(registry, host=host, port=port)
+    except OSError as e:
+        logger.warning("obs metrics listener failed to bind %s:%d: %s",
+                       host, port, e)
+        return None
+    logger.info("obs metrics listening on %s", srv.url)
+    print(f"obs metrics listening on {srv.url}", flush=True)
+    return srv

@@ -1,0 +1,220 @@
+"""Low-overhead structured step-phase tracing (ISSUE 7 tentpole #1).
+
+The reference framework answers "where did the time go" twice: per-module
+wall-time counters (``AbstractModule.getTimes``) and cluster-wide named
+counters aggregated through Spark accumulators (``optim/Metrics.scala``).
+Both are *sums* — they can say data fetch cost 12 s total, but not that
+step 847 stalled 300 ms waiting on the feed while its neighbors didn't.
+This module is the timeline half: named spans around the real phases of
+the training loop (data fetch, host→device transfer, dispatch, device
+wait, checkpoint) and the serving request path (queue wait, batch
+assembly, compute, decode step), ring-buffered and exportable as a
+Chrome-trace / Perfetto JSON for ``chrome://tracing`` or ``ui.perfetto.dev``.
+
+Design constraints, in priority order:
+
+1. **Near-zero cost when disabled.** ``span(name)`` with no tracer
+   installed is one global load, one ``None`` check, and returns a
+   shared singleton no-op context manager — no allocation, no clock
+   read. Instrumented hot loops pay nothing until ``--obs`` turns the
+   tracer on (the same contract as ``resilience.faults.hook``).
+2. **Thread-safe.** Spans from HTTP handler threads, the micro-batcher
+   worker, and the training loop interleave; each thread keeps its own
+   nesting stack (``threading.local``) and completed spans append into
+   one lock-guarded ring buffer.
+3. **Bounded memory.** The ring (default 2^16 events) drops the OLDEST
+   events on overflow and counts the drops, so a week-long run can keep
+   the tracer on and still export the most recent window.
+4. **Deterministic under test.** The clock is injectable; tests drive a
+   fake clock and assert exact timestamps/durations.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+__all__ = ["Tracer", "span", "enable", "disable", "enabled",
+           "get_tracer", "set_tracer"]
+
+
+class Tracer:
+    """Ring-buffered span collector with Chrome-trace export.
+
+    Completed spans are dicts ``{name, ts, dur, tid, depth, args}`` with
+    ``ts``/``dur`` in SECONDS on the tracer's clock (conversion to the
+    Chrome format's microseconds happens at export). ``tid`` is a small
+    stable per-thread integer, 0 for the first thread seen."""
+
+    def __init__(self, capacity: int = 65536,
+                 clock: Callable[[], float] = time.perf_counter):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.clock = clock
+        self.capacity = int(capacity)
+        self._ring: collections.deque = collections.deque(maxlen=capacity)
+        self._lock = threading.Lock()
+        self._local = threading.local()
+        self._tids: Dict[int, int] = {}
+        self._recorded = 0  # total ever, to report drops
+
+    # ---------------------------------------------------------- span stack
+    def _stack(self) -> list:
+        st = getattr(self._local, "stack", None)
+        if st is None:
+            st = self._local.stack = []
+        return st
+
+    def _tid(self) -> int:
+        ident = threading.get_ident()
+        with self._lock:
+            tid = self._tids.get(ident)
+            if tid is None:
+                tid = self._tids[ident] = len(self._tids)
+            return tid
+
+    def record(self, name: str, t0: float, t1: float, depth: int,
+               args: Optional[dict] = None) -> None:
+        ev = {"name": name, "ts": t0, "dur": max(t1 - t0, 0.0),
+              "tid": self._tid(), "depth": depth}
+        if args:
+            ev["args"] = args
+        with self._lock:
+            self._ring.append(ev)
+            self._recorded += 1
+
+    # ------------------------------------------------------------ snapshot
+    def events(self) -> List[dict]:
+        with self._lock:
+            return list(self._ring)
+
+    @property
+    def dropped(self) -> int:
+        with self._lock:
+            return max(0, self._recorded - len(self._ring))
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+            self._recorded = 0
+
+    # -------------------------------------------------------------- export
+    def chrome_trace(self) -> dict:
+        """The Chrome Trace Event Format object (``traceEvents`` of
+        ``"ph": "X"`` complete events, timestamps in microseconds).
+        Loadable by chrome://tracing and Perfetto; nesting is inferred
+        by the viewer from interval containment per (pid, tid)."""
+        pid = os.getpid()
+        evs = []
+        for e in self.events():
+            ev = {"name": e["name"], "cat": "bigdl", "ph": "X",
+                  "ts": round(e["ts"] * 1e6, 3),
+                  "dur": round(e["dur"] * 1e6, 3),
+                  "pid": pid, "tid": e["tid"]}
+            if "args" in e:
+                ev["args"] = e["args"]
+            evs.append(ev)
+        # stable viewer ordering (and easier assertions): by ts, with
+        # parents before their children at equal ts (larger dur first)
+        evs.sort(key=lambda ev: (ev["tid"], ev["ts"], -ev["dur"]))
+        return {"traceEvents": evs, "displayTimeUnit": "ms",
+                "otherData": {"dropped_events": self.dropped}}
+
+    def export_chrome_trace(self, path: str) -> int:
+        """Write the Chrome-trace JSON to ``path``; returns the event
+        count written."""
+        trace = self.chrome_trace()
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        with open(path, "w") as f:
+            json.dump(trace, f)
+        return len(trace["traceEvents"])
+
+
+class _Span:
+    """Active span context manager (only allocated when a tracer is
+    installed)."""
+
+    __slots__ = ("_tracer", "_name", "_args", "_t0")
+
+    def __init__(self, tracer: Tracer, name: str, args: Optional[dict]):
+        self._tracer = tracer
+        self._name = name
+        self._args = args
+
+    def __enter__(self) -> "_Span":
+        self._tracer._stack().append(self._name)
+        self._t0 = self._tracer.clock()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        t1 = self._tracer.clock()
+        st = self._tracer._stack()
+        st.pop()
+        self._tracer.record(self._name, self._t0, t1, depth=len(st),
+                            args=self._args)
+
+
+class _NoopSpan:
+    """Shared do-nothing context manager — what ``span()`` returns when
+    tracing is disabled. A singleton: the disabled path allocates
+    nothing."""
+
+    __slots__ = ()
+
+    def __enter__(self) -> "_NoopSpan":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        pass
+
+
+NOOP_SPAN = _NoopSpan()
+
+_TRACER: Optional[Tracer] = None
+
+
+def span(name: str, **args):
+    """``with span("data_wait"): ...`` — time a named phase.
+
+    Disabled (no tracer installed): one global load + ``None`` check,
+    returns the shared no-op singleton. Enabled: records a completed
+    span into the tracer's ring on exit, nested under any enclosing
+    spans of the same thread."""
+    t = _TRACER
+    if t is None:
+        return NOOP_SPAN
+    return _Span(t, name, args or None)
+
+
+def enable(capacity: int = 65536,
+           clock: Callable[[], float] = time.perf_counter) -> Tracer:
+    """Install (and return) a fresh global tracer."""
+    global _TRACER
+    _TRACER = Tracer(capacity=capacity, clock=clock)
+    return _TRACER
+
+
+def disable() -> None:
+    global _TRACER
+    _TRACER = None
+
+
+def enabled() -> bool:
+    return _TRACER is not None
+
+
+def get_tracer() -> Optional[Tracer]:
+    return _TRACER
+
+
+def set_tracer(tracer: Optional[Tracer]) -> None:
+    """Install an externally constructed tracer (tests inject a fake
+    clock this way)."""
+    global _TRACER
+    _TRACER = tracer

@@ -29,6 +29,7 @@ import numpy as np
 
 from bigdl_tpu.core.module import Module
 from bigdl_tpu.core.criterion import Criterion
+from bigdl_tpu.obs.spans import enabled as _obs_enabled, span as _span
 from bigdl_tpu.optim.method import OptimMethod, SGD
 from bigdl_tpu.optim.metrics import Metrics
 from bigdl_tpu.optim.triggers import Trigger
@@ -137,6 +138,15 @@ class Optimizer:
         self.log_every = max(1, log_every)
         self._last_val_iter = -1
         self._last_ckpt_iter = -1
+        # step-phase accounting (ISSUE 7): cumulative seconds per phase
+        # (obs.metrics.TRAIN_PHASES taxonomy). data_wait/dispatch/ckpt
+        # are metered in EVERY run (the measurements were already being
+        # taken — the reported feed-stall gap, PERF.md §4, was dropped on
+        # the floor); h2d and the true device wait need a per-step sync
+        # and are only split out when the span tracer is on (--obs).
+        self._phase_totals: dict = {}
+        self._obs_hists = None
+        self._obs_capture = None  # CaptureController (cli wiring)
 
     # ---------------------------------------------------------------- setters
     def set_end_when(self, trigger: Trigger) -> "Optimizer":
@@ -237,35 +247,36 @@ class Optimizer:
         # pair must fall back to the previous one instead of crashing at
         # deserialize (ISSUE 6: recovery costs one checkpoint interval,
         # not the run)
-        m, s = latest_valid_checkpoint_pair(checkpoint_dir)
-        if m is None:
-            # accept a model-only snapshot (predict/eval-style dirs with
-            # no optimizer state at all) — still checksum-gated
-            m = latest_checkpoint(checkpoint_dir, "model.")
-            s = None
-            if m is not None and not verify_checkpoint(m):
-                from bigdl_tpu.resilience.faults import ChecksumError
-                raise ChecksumError(
-                    f"the only snapshot in {checkpoint_dir} ({m}) fails "
-                    f"checksum verification and there is no earlier one "
-                    f"to fall back to")
-        if m and isdir(m):  # orbax checkpoints are directories
-            from bigdl_tpu.utils.orbax_ckpt import restore_sharded
-            blob = restore_sharded(m)
-            self._init_params = blob["params"]
-            self._init_mod_state = blob["mod_state"]
-            self._set_resume_driver(blob, m)
+        with _span("ckpt_restore", dir=str(checkpoint_dir)):
+            m, s = latest_valid_checkpoint_pair(checkpoint_dir)
+            if m is None:
+                # accept a model-only snapshot (predict/eval-style dirs
+                # with no optimizer state at all) — still checksum-gated
+                m = latest_checkpoint(checkpoint_dir, "model.")
+                s = None
+                if m is not None and not verify_checkpoint(m):
+                    from bigdl_tpu.resilience.faults import ChecksumError
+                    raise ChecksumError(
+                        f"the only snapshot in {checkpoint_dir} ({m}) "
+                        f"fails checksum verification and there is no "
+                        f"earlier one to fall back to")
+            if m and isdir(m):  # orbax checkpoints are directories
+                from bigdl_tpu.utils.orbax_ckpt import restore_sharded
+                blob = restore_sharded(m)
+                self._init_params = blob["params"]
+                self._init_mod_state = blob["mod_state"]
+                self._set_resume_driver(blob, m)
+                if s:
+                    self._init_opt_state = restore_sharded(s)
+                return self
+            if m:
+                blob = load_pytree(m)
+                self._init_params = blob["params"]
+                self._init_mod_state = blob["mod_state"]
+                self._set_resume_driver(blob, m)
             if s:
-                self._init_opt_state = restore_sharded(s)
+                self._init_opt_state = load_pytree(s)
             return self
-        if m:
-            blob = load_pytree(m)
-            self._init_params = blob["params"]
-            self._init_mod_state = blob["mod_state"]
-            self._set_resume_driver(blob, m)
-        if s:
-            self._init_opt_state = load_pytree(s)
-        return self
 
     def _set_resume_driver(self, blob, model_path: str) -> None:
         """Resumed training continues the epoch/iteration numbering
@@ -434,6 +445,30 @@ class Optimizer:
         from bigdl_tpu.optim.validator import build_eval_fn
         return build_eval_fn(self.model, self._val_methods, self.strategy)
 
+    # ------------------------------------------------------------ obs phases
+    def _obs_phase(self, name: str, dt: float) -> None:
+        """Account ``dt`` seconds to a step phase: always into the
+        cumulative totals (a dict add), and into the shared registry's
+        per-step histograms when --obs is on."""
+        self._phase_totals[name] = self._phase_totals.get(name, 0.0) + dt
+        h = self._obs_hists
+        if h is not None:
+            hist = h.get(name)
+            if hist is not None:
+                hist.observe(dt * 1000.0)
+
+    def phase_totals(self) -> dict:
+        """Cumulative per-phase seconds for this run — what the perf
+        harness stamps as the ``*_s`` phase columns (ISSUE 7)."""
+        return dict(self._phase_totals)
+
+    def set_capture(self, controller) -> "Optimizer":
+        """Attach an :class:`~bigdl_tpu.obs.capture.CaptureController`;
+        ``on_step`` is driven once per dispatch (--traceSteps/SIGUSR2/
+        touch-file mid-run profile windows)."""
+        self._obs_capture = controller
+        return self
+
     # -------------------------------------------------------------- optimize
     def optimize(self) -> TrainedModel:
         # per-run conv-policy isolation (ADVICE r5 #1): _build_step
@@ -474,6 +509,16 @@ class Optimizer:
 
         step_fn, chunk_fn = self._build_step()
         eval_fn = self._build_eval() if self._val_methods else None
+
+        # --obs: per-step phase histograms flow into the shared registry
+        # (scraped live by the --metricsPort listener); the device-wait
+        # split needs a per-dispatch sync, so it only runs under obs —
+        # obs-off keeps the async dispatch pipeline untouched
+        obs_on = _obs_enabled()
+        if obs_on:
+            from bigdl_tpu.obs.metrics import get_registry, phase_histograms
+            self._obs_hists = phase_histograms(get_registry(), "train")
+        capture = self._obs_capture
 
         driver = {"epoch": 1, "iteration": 0, "prev_iteration": 0,
                   "epoch_finished": False, "loss": float("inf")}
@@ -559,6 +604,7 @@ class Optimizer:
         while not self.end_when(driver):
             driver["epoch_finished"] = False
             epoch_start = time.time()
+            ph_snap = dict(self._phase_totals)  # epoch-delta baseline
             records_this_epoch = 0
             driver["epoch_records"] = 0
             opt_state = self.optim_method.set_epoch(opt_state, driver["epoch"])
@@ -584,38 +630,59 @@ class Optimizer:
                 # K same-shape batches to scan inside one program
                 t_fetch = time.time()
                 buf = []
-                while len(buf) < K:
-                    if pending is not None:
-                        b, pending = pending, None
-                    else:
-                        b = next(data_iter, _end)
-                        if b is not _end:
-                            _fault_hook("data")  # one visit per fetch
-                    if b is _end:
-                        epoch_done = True
-                        break
-                    if buf and _shape_sig(b) != _shape_sig(buf[0]):
-                        pending = b  # ragged tail: flush, retry next group
-                        break
-                    buf.append(b)
-                fetch_accum += time.time() - t_fetch
+                with _span("data_wait"):
+                    while len(buf) < K:
+                        if pending is not None:
+                            b, pending = pending, None
+                        else:
+                            b = next(data_iter, _end)
+                            if b is not _end:
+                                _fault_hook("data")  # one visit per fetch
+                        if b is _end:
+                            epoch_done = True
+                            break
+                        if buf and _shape_sig(b) != _shape_sig(buf[0]):
+                            pending = b  # ragged tail: flush, retry next
+                            break
+                        buf.append(b)
+                dt_fetch = time.time() - t_fetch
+                fetch_accum += dt_fetch
+                self._obs_phase("data_wait", dt_fetch)
                 if not buf:
                     break
                 if chunk_fn is not None and len(buf) == K:
+                    if capture is not None:
+                        capture.on_step(driver["iteration"])
                     t0 = time.time()
-                    xs = jnp.stack([jnp.asarray(bx) for bx, _ in buf])
-                    ys = jax.tree_util.tree_map(
-                        lambda *ls: jnp.stack([jnp.asarray(l) for l in ls]),
-                        *[by for _, by in buf])
+                    t_h = time.perf_counter()
+                    with _span("h2d", batches=K):
+                        xs = jnp.stack([jnp.asarray(bx) for bx, _ in buf])
+                        ys = jax.tree_util.tree_map(
+                            lambda *ls: jnp.stack(
+                                [jnp.asarray(l) for l in ls]),
+                            *[by for _, by in buf])
+                    self._obs_phase("h2d", time.perf_counter() - t_h)
                     # fault site BEFORE the dispatch and BEFORE the rng
                     # splits: a preemption here loses the whole chunk,
                     # exactly like a kill between dispatches would
                     _fault_hook("step")
                     # same host key sequence as K=1 (counted for resume)
                     keys = [_next_key() for _ in range(K)]
-                    params, mod_state, opt_state, loss = chunk_fn(
-                        params, mod_state, opt_state, xs, ys,
-                        jnp.stack(keys))
+                    t_d = time.perf_counter()
+                    with _span("dispatch", steps=K):
+                        params, mod_state, opt_state, loss = chunk_fn(
+                            params, mod_state, opt_state, xs, ys,
+                            jnp.stack(keys))
+                    self._obs_phase("dispatch", time.perf_counter() - t_d)
+                    if obs_on:
+                        # true device wait: only metered under --obs (the
+                        # sync costs dispatch pipelining; that delta is
+                        # the obs overhead A/B in tpu_capture_r12.sh)
+                        t_w = time.perf_counter()
+                        with _span("device"):
+                            jax.block_until_ready(loss)
+                        self._obs_phase("device",
+                                        time.perf_counter() - t_w)
                     after_dispatch(sum(len(bx) for bx, _ in buf), K, t0,
                                    loss)
                     self._maybe_validate(eval_fn, params, mod_state, driver)
@@ -625,19 +692,34 @@ class Optimizer:
                         break
                     continue
                 for x, y in buf:  # K == 1, or a ragged/short group
+                    if capture is not None:
+                        capture.on_step(driver["iteration"])
                     t0 = time.time()
                     # fault site before the step's rng split + dispatch:
                     # a preemption loses this step, as a real kill would
                     _fault_hook("step")
-                    if self.strategy is not None:
-                        x, y = self.strategy.shard_batch(x, y)
-                    else:
-                        # target may be a pytree (Mixup's (y_a, y_b, lam))
-                        x = jnp.asarray(x)
-                        y = jax.tree_util.tree_map(jnp.asarray, y)
+                    t_h = time.perf_counter()
+                    with _span("h2d"):
+                        if self.strategy is not None:
+                            x, y = self.strategy.shard_batch(x, y)
+                        else:
+                            # target may be a pytree (Mixup's
+                            # (y_a, y_b, lam))
+                            x = jnp.asarray(x)
+                            y = jax.tree_util.tree_map(jnp.asarray, y)
+                    self._obs_phase("h2d", time.perf_counter() - t_h)
                     k_step = _next_key()
-                    params, mod_state, opt_state, loss = step_fn(
-                        params, mod_state, opt_state, x, y, k_step)
+                    t_d = time.perf_counter()
+                    with _span("dispatch"):
+                        params, mod_state, opt_state, loss = step_fn(
+                            params, mod_state, opt_state, x, y, k_step)
+                    self._obs_phase("dispatch", time.perf_counter() - t_d)
+                    if obs_on:
+                        t_w = time.perf_counter()
+                        with _span("device"):
+                            jax.block_until_ready(loss)
+                        self._obs_phase("device",
+                                        time.perf_counter() - t_w)
                     after_dispatch(len(x), 1, t0, loss)
                     self._maybe_validate(eval_fn, params, mod_state, driver)
                     self._maybe_checkpoint(params, mod_state, opt_state,
@@ -650,9 +732,32 @@ class Optimizer:
             driver["epoch_records"] = 0  # next epoch starts at cursor 0
             self.dataset.shuffle()
             dt_e = time.time() - epoch_start
-            logger.info("Epoch %d done: %d records in %.2fs (%.1f rec/s)",
-                        driver["epoch"] - 1, records_this_epoch, dt_e,
-                        records_this_epoch / max(dt_e, 1e-9))
+            # surface the phase split EVERY epoch (ISSUE 7 satellite: the
+            # old fetch_accum was measured then dropped — the feed-stall
+            # gap behind resnet50_pipe's 0.99% MFU, PERF.md §4, was
+            # invisible in normal runs). data_wait/dispatch meter in
+            # every run; h2d/device only split out under --obs.
+            d_wait = (self._phase_totals.get("data_wait", 0.0)
+                      - ph_snap.get("data_wait", 0.0))
+            d_disp = (self._phase_totals.get("dispatch", 0.0)
+                      - ph_snap.get("dispatch", 0.0))
+            logger.info(
+                "Epoch %d done: %d records in %.2fs (%.1f rec/s; "
+                "data_wait %.2fs, dispatch %.2fs, feed stall %.1f%%)",
+                driver["epoch"] - 1, records_this_epoch, dt_e,
+                records_this_epoch / max(dt_e, 1e-9), d_wait, d_disp,
+                100.0 * d_wait / max(dt_e, 1e-9))
+            # cumulative phase seconds into the shared registry — live
+            # on the --metricsPort listener, or read post-hoc by callers
+            from bigdl_tpu.obs.metrics import TRAIN_PHASES, get_registry
+            _reg = get_registry()
+            for _ph in TRAIN_PHASES:
+                d = (self._phase_totals.get(_ph, 0.0)
+                     - ph_snap.get(_ph, 0.0))
+                if d > 0.0:
+                    _reg.counter(
+                        f"train_phase_{_ph}_seconds_total",
+                        f"cumulative {_ph} phase seconds").inc(d)
             if jax.process_count() > 1:
                 # reference driver logs "computing time for each node"
                 # via Spark accumulators (Metrics.scala:25-117); the
@@ -717,6 +822,18 @@ class Optimizer:
                 or not self._ckpt_trigger(driver)
                 or driver["iteration"] == self._last_ckpt_iter):
             return
+        # ckpt phase: what the loop thread pays for this snapshot (the
+        # async path only pays the device->host copy here; the disk
+        # write runs on the worker and is not loop-thread stall)
+        t_ck = time.perf_counter()
+        try:
+            with _span("ckpt", iteration=driver["iteration"]):
+                self._write_checkpoint(params, mod_state, opt_state,
+                                       driver)
+        finally:
+            self._obs_phase("ckpt", time.perf_counter() - t_ck)
+
+    def _write_checkpoint(self, params, mod_state, opt_state, driver):
         self._last_ckpt_iter = driver["iteration"]
         n = driver["iteration"]
         target = os.path.join(self._ckpt_path, f"model.{n}")

@@ -258,6 +258,13 @@ class FaultInjector:
         if rule.arg is not None:
             ev["arg"] = rule.arg
         self.events.append(ev)
+        try:  # shared-registry fault counter (ISSUE 7): scrapable live
+            from bigdl_tpu.obs.metrics import get_registry
+            get_registry().counter(
+                "faults_injected_total",
+                "faults fired by the installed --faultPlan").inc()
+        except Exception:
+            pass  # observability must never change fault semantics
         if self.log_path:
             # append + close per event: survives os._exit on the next line
             with open(self.log_path, "a") as f:

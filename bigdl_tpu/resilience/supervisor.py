@@ -37,6 +37,7 @@ import subprocess
 import time
 from typing import Callable, List, Optional, Sequence, Tuple
 
+from bigdl_tpu.obs.spans import span as _obs_span
 from bigdl_tpu.resilience.faults import (ChecksumError, PREEMPT_RC,
                                          SimulatedPreemption,
                                          TransientFault, _u01,
@@ -119,7 +120,9 @@ class Supervisor:
         while True:
             self.attempts += 1
             try:
-                result = attempt_fn(self.attempts - 1)
+                with _obs_span("supervisor_attempt",
+                               attempt=self.attempts):
+                    result = attempt_fn(self.attempts - 1)
             except self.retryable as e:
                 retries += 1
                 self.events.append({
@@ -141,6 +144,14 @@ class Supervisor:
                                     "backoff_s": round(d, 3),
                                     "action": "resume from newest valid "
                                               "checkpoint"})
+                try:  # shared-registry retry counter (ISSUE 7)
+                    from bigdl_tpu.obs.metrics import get_registry
+                    get_registry().counter(
+                        "supervisor_retries_total",
+                        "supervised retries after retryable "
+                        "faults").inc()
+                except Exception:
+                    pass  # never let observability break recovery
                 logger.warning(
                     "supervisor[%s]: %s: %s — retry %d/%d in %.2fs",
                     self.name, type(e).__name__, e, retries,

@@ -18,7 +18,9 @@ Modules:
 * :mod:`decode`  — KV-cache prefill/decode split with
   continuous-batching slots for ``transformer_lm``;
 * :mod:`metrics` — lock-cheap counters + latency histograms with a
-  plaintext exposition format and config-provenance stamping;
+  plaintext exposition format and config-provenance stamping (now a
+  re-export of :mod:`bigdl_tpu.obs.metrics` — ISSUE 7 promoted the
+  registry process-global so training and resilience share it);
 * :mod:`watchdog` — dead/wedged-worker detection: pending futures fail
   fast, ``/readyz`` flips, ``/healthz`` stays live (ISSUE 6);
 * :mod:`server`  — stdlib ThreadingHTTPServer JSON endpoints

@@ -49,6 +49,9 @@ from typing import Callable, Optional
 
 import numpy as np
 
+from bigdl_tpu.obs.spans import (get_tracer as _get_tracer,
+                                 span as _obs_span)
+
 __all__ = ["AdmissionError", "DeadlineExceeded", "WorkerDied",
            "MicroBatcher"]
 
@@ -284,9 +287,23 @@ class MicroBatcher:
         if self._m_wait is not None:
             for p in batch:
                 self._m_wait.observe((now - p.t_enqueue) * 1000.0)
+        tr = _get_tracer()
+        if tr is not None:
+            # queue wait is retrospective (enqueue happened on another
+            # thread): back-date one span covering the oldest row's wait
+            # so the request-path timeline reads queue_wait ->
+            # batch_assembly -> compute
+            t1 = tr.clock()
+            wait = max(now - p.t_enqueue for p in batch)
+            tr.record("queue_wait", t1 - max(wait, 0.0), t1, depth=0,
+                      args={"rows": len(batch)})
         try:
-            scores = self.predict_fn(
-                np.stack([np.asarray(p.row) for p in batch]))
+            # queue_wait ended at drain; assembly (stack) and compute
+            # (engine forward) are the next spans on the request path
+            with _obs_span("batch_assembly", rows=len(batch)):
+                stacked = np.stack([np.asarray(p.row) for p in batch])
+            with _obs_span("compute", rows=len(batch)):
+                scores = self.predict_fn(stacked)
         except BaseException as e:  # resolve every waiter, never hang them
             for p in batch:
                 p.future.set_exception(e)

@@ -37,6 +37,7 @@ from typing import Optional, Sequence
 
 import numpy as np
 
+from bigdl_tpu.obs.spans import span as _obs_span
 from bigdl_tpu.serving.batcher import (AdmissionError, DeadlineExceeded,
                                        WorkerDied, _Future)
 
@@ -315,10 +316,11 @@ class DecodeEngine:
                 return 0
             self._key, sub = jax.random.split(self._key)
             keys = jax.random.split(sub, self.slots)
-            toks, self._logits, self._cache = self._step_jit(
-                self.params, self._logits, self._cache,
-                jnp.asarray(self._pos), jnp.asarray(self._temp), keys)
-            toks_host = np.asarray(toks)
+            with _obs_span("decode_step", active=len(active)):
+                toks, self._logits, self._cache = self._step_jit(
+                    self.params, self._logits, self._cache,
+                    jnp.asarray(self._pos), jnp.asarray(self._temp), keys)
+                toks_host = np.asarray(toks)
             if self._m_steps is not None:
                 self._m_steps.inc()
                 self._m_tokens.inc(len(active))

@@ -30,6 +30,7 @@ from typing import Optional, Sequence
 
 import numpy as np
 
+from bigdl_tpu.obs.spans import span as _obs_span
 from bigdl_tpu.resilience.faults import hook as _fault_hook
 
 logger = logging.getLogger(__name__)
@@ -224,9 +225,10 @@ class InferenceEngine:
                 chunk = np.concatenate(
                     [chunk, np.repeat(chunk[-1:], pad, axis=0)])
             fn = self._get_compiled(bucket, feat_shape, dtype)
-            y = fn(self.params, self.mod_state,
-                   self._jax.numpy.asarray(chunk))
-            outs.append(np.asarray(y)[:take])
+            with _obs_span("infer", bucket=bucket, rows=take):
+                y = fn(self.params, self.mod_state,
+                       self._jax.numpy.asarray(chunk))
+                outs.append(np.asarray(y)[:take])
             if self._m_rows is not None:
                 self._m_rows.inc(take)
                 self._m_pad.inc(pad)

@@ -1,269 +1,18 @@
-"""Serving observability: lock-cheap counters, gauges, and latency
-histograms with a plaintext exposition format.
+"""Back-compat shim (ISSUE 7 satellite): the serving metrics registry
+was promoted to :mod:`bigdl_tpu.obs.metrics` so training, resilience,
+and serving share one instrument set and one exposition format.
 
-The reference monitors training through Spark's accumulator/UI machinery
-(DistriOptimizer's recordsProcessedThisEpoch + driver logs); an online
-inference engine needs the serving-side equivalents — request counters,
-latency quantiles (p50/p95/p99), queue depth, padding-waste fraction,
-tokens/s — cheap enough to update on every request from many handler
-threads, and exposable over HTTP for scrape-based collection.
-
-Design: each instrument guards its few-word update with one short-held
-``threading.Lock`` (never held across an engine call or IO), histograms
-use fixed log-spaced buckets so ``observe`` is a bisect + two adds, and
-quantiles are estimated at render time by linear interpolation inside
-the covering bucket — the standard fixed-bucket estimator, exact at
-bucket edges and monotone in between. No dependencies.
+Everything that imported from here keeps working unchanged — same
+classes, same default ``bigdl_serving`` namespace, same bucket ladder,
+same ``# provenance`` stamping. New code should import from
+``bigdl_tpu.obs`` directly.
 """
 
 from __future__ import annotations
 
-import bisect
-import json
-import threading
-import time
-from typing import Callable, Dict, List, Optional, Sequence
+from bigdl_tpu.obs.metrics import (Counter, DEFAULT_LATENCY_BUCKETS_MS,
+                                   Gauge, Histogram, MetricsRegistry,
+                                   _fmt, _label_escape)
 
 __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
            "DEFAULT_LATENCY_BUCKETS_MS"]
-
-# log-spaced 100 us .. 60 s: covers a CPU smoke test and a loaded TPU
-# server with ~2x resolution per decade
-DEFAULT_LATENCY_BUCKETS_MS: tuple = (
-    0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0,
-    500.0, 1000.0, 2500.0, 5000.0, 10000.0, 30000.0, 60000.0)
-
-
-class Counter:
-    """Monotone counter; ``inc`` is one lock + one add."""
-
-    def __init__(self, name: str, help: str = ""):
-        self.name, self.help = name, help
-        self._lock = threading.Lock()
-        self._value = 0.0
-
-    def inc(self, n: float = 1.0) -> None:
-        with self._lock:
-            self._value += n
-
-    @property
-    def value(self) -> float:
-        with self._lock:
-            return self._value
-
-
-class Gauge:
-    """Point-in-time value: either ``set()`` from the owner or backed by
-    a ``fn`` sampled at render time (queue depth, occupancy)."""
-
-    def __init__(self, name: str, help: str = "",
-                 fn: Optional[Callable[[], float]] = None):
-        self.name, self.help = name, help
-        self._fn = fn
-        self._lock = threading.Lock()
-        self._value = 0.0
-
-    def set(self, v: float) -> None:
-        with self._lock:
-            self._value = float(v)
-
-    @property
-    def value(self) -> float:
-        if self._fn is not None:
-            try:
-                return float(self._fn())
-            except Exception:
-                return float("nan")
-        with self._lock:
-            return self._value
-
-
-class Histogram:
-    """Fixed-bucket histogram with quantile estimation.
-
-    ``bounds`` are bucket upper edges (ascending); one implicit +Inf
-    bucket catches overflow. ``quantile(q)`` interpolates linearly
-    inside the covering bucket (lower edge = previous bound, 0 for the
-    first; the +Inf bucket reports the max ever observed — a bounded
-    answer instead of infinity)."""
-
-    def __init__(self, name: str, help: str = "",
-                 bounds: Sequence[float] = DEFAULT_LATENCY_BUCKETS_MS):
-        self.name, self.help = name, help
-        self.bounds: List[float] = sorted(float(b) for b in bounds)
-        if not self.bounds:
-            raise ValueError("histogram needs at least one bucket bound")
-        self._lock = threading.Lock()
-        self._counts = [0] * (len(self.bounds) + 1)  # last = +Inf
-        self._sum = 0.0
-        self._count = 0
-        self._max = float("-inf")
-
-    def observe(self, v: float) -> None:
-        v = float(v)
-        i = bisect.bisect_left(self.bounds, v)
-        with self._lock:
-            self._counts[i] += 1
-            self._sum += v
-            self._count += 1
-            if v > self._max:
-                self._max = v
-
-    def snapshot(self) -> dict:
-        with self._lock:
-            return {"counts": list(self._counts), "sum": self._sum,
-                    "count": self._count, "max": self._max}
-
-    @property
-    def count(self) -> int:
-        with self._lock:
-            return self._count
-
-    @property
-    def sum(self) -> float:
-        with self._lock:
-            return self._sum
-
-    def quantile(self, q: float, snap: Optional[dict] = None) -> float:
-        """Estimated q-quantile (q in [0, 1]); NaN when empty."""
-        if not 0.0 <= q <= 1.0:
-            raise ValueError(f"quantile {q} outside [0, 1]")
-        s = snap or self.snapshot()
-        total = s["count"]
-        if total == 0:
-            return float("nan")
-        rank = q * total
-        cum = 0.0
-        for i, c in enumerate(s["counts"]):
-            if c == 0:
-                continue
-            if cum + c >= rank:
-                lo = 0.0 if i == 0 else self.bounds[i - 1]
-                hi = s["max"] if i == len(self.bounds) else self.bounds[i]
-                frac = (rank - cum) / c
-                return lo + (hi - lo) * min(max(frac, 0.0), 1.0)
-            cum += c
-        return s["max"]
-
-
-class MetricsRegistry:
-    """Instrument factory + plaintext exposition.
-
-    ``render()`` emits a Prometheus-compatible text page: HELP/TYPE
-    lines, counter/gauge samples, histogram ``_bucket``/``_sum``/
-    ``_count`` series plus estimated ``{quantile=...}`` samples. The
-    serving config provenance (``set_provenance``) is stamped into every
-    scrape twice: as an ``<ns>_info`` gauge with label pairs, and as a
-    one-line ``# provenance {json}`` comment so load generators can
-    embed the exact config into their bench JSON without a label parser
-    (the perf-JSON contract from PRs 2-4, extended to serving)."""
-
-    QUANTILES = (0.5, 0.95, 0.99)
-
-    def __init__(self, namespace: str = "bigdl_serving",
-                 clock: Callable[[], float] = time.time):
-        self.namespace = namespace
-        self._lock = threading.Lock()
-        self._metrics: Dict[str, object] = {}
-        self._provenance: dict = {}
-        self._clock = clock  # injectable: uptime-derived gauges (tokens/s)
-        self._t0 = clock()   # become deterministic under test
-
-    def _register(self, name, factory):
-        with self._lock:
-            m = self._metrics.get(name)
-            if m is None:
-                m = self._metrics[name] = factory()
-            return m
-
-    def counter(self, name: str, help: str = "") -> Counter:
-        m = self._register(name, lambda: Counter(name, help))
-        if not isinstance(m, Counter):
-            raise TypeError(f"{name} already registered as {type(m).__name__}")
-        return m
-
-    def gauge(self, name: str, help: str = "",
-              fn: Optional[Callable[[], float]] = None) -> Gauge:
-        m = self._register(name, lambda: Gauge(name, help, fn))
-        if not isinstance(m, Gauge):
-            raise TypeError(f"{name} already registered as {type(m).__name__}")
-        return m
-
-    def histogram(self, name: str, help: str = "",
-                  bounds: Sequence[float] = DEFAULT_LATENCY_BUCKETS_MS
-                  ) -> Histogram:
-        m = self._register(name, lambda: Histogram(name, help, bounds))
-        if not isinstance(m, Histogram):
-            raise TypeError(f"{name} already registered as {type(m).__name__}")
-        return m
-
-    def set_provenance(self, prov: dict) -> None:
-        with self._lock:
-            self._provenance = dict(prov)
-
-    @property
-    def provenance(self) -> dict:
-        with self._lock:
-            return dict(self._provenance)
-
-    def uptime_s(self) -> float:
-        return self._clock() - self._t0
-
-    # ------------------------------------------------------------ exposition
-    def render(self) -> str:
-        ns = self.namespace
-        with self._lock:
-            metrics = list(self._metrics.values())
-            prov = dict(self._provenance)
-        lines: List[str] = []
-        if prov:
-            # machine-scrapable config provenance, one JSON line
-            lines.append(f"# provenance {json.dumps(prov, sort_keys=True)}")
-            labels = ",".join(
-                f'{k}="{_label_escape(v)}"' for k, v in sorted(prov.items()))
-            lines.append(f"# HELP {ns}_info serving config provenance")
-            lines.append(f"# TYPE {ns}_info gauge")
-            lines.append(f"{ns}_info{{{labels}}} 1")
-        lines.append(f"# HELP {ns}_uptime_seconds process uptime")
-        lines.append(f"# TYPE {ns}_uptime_seconds gauge")
-        lines.append(f"{ns}_uptime_seconds {self.uptime_s():.3f}")
-        for m in metrics:
-            full = f"{ns}_{m.name}"
-            if m.help:
-                lines.append(f"# HELP {full} {m.help}")
-            if isinstance(m, Counter):
-                lines.append(f"# TYPE {full} counter")
-                lines.append(f"{full} {_fmt(m.value)}")
-            elif isinstance(m, Gauge):
-                lines.append(f"# TYPE {full} gauge")
-                lines.append(f"{full} {_fmt(m.value)}")
-            elif isinstance(m, Histogram):
-                snap = m.snapshot()
-                lines.append(f"# TYPE {full} histogram")
-                cum = 0
-                for b, c in zip(m.bounds, snap["counts"]):
-                    cum += c
-                    lines.append(f'{full}_bucket{{le="{_fmt(b)}"}} {cum}')
-                lines.append(
-                    f'{full}_bucket{{le="+Inf"}} {snap["count"]}')
-                lines.append(f"{full}_sum {_fmt(snap['sum'])}")
-                lines.append(f"{full}_count {snap['count']}")
-                for q in self.QUANTILES:
-                    lines.append(
-                        f'{full}{{quantile="{q}"}} '
-                        f"{_fmt(m.quantile(q, snap))}")
-        return "\n".join(lines) + "\n"
-
-
-def _fmt(v) -> str:
-    f = float(v)
-    if f != f:  # NaN (empty-histogram quantile, dead gauge fn)
-        return "NaN"
-    if f in (float("inf"), float("-inf")):
-        return "+Inf" if f > 0 else "-Inf"
-    return str(int(f)) if f == int(f) and abs(f) < 1e15 else repr(f)
-
-
-def _label_escape(v) -> str:
-    return str(v).replace("\\", "\\\\").replace('"', '\\"').replace(
-        "\n", "\\n")

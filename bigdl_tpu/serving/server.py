@@ -45,6 +45,7 @@ from typing import Optional
 
 import numpy as np
 
+from bigdl_tpu.obs.spans import span as _obs_span
 from bigdl_tpu.resilience.faults import TransientFault, hook as _fault_hook
 from bigdl_tpu.serving.batcher import (AdmissionError, DeadlineExceeded,
                                        WorkerDied)
@@ -239,7 +240,8 @@ class ServingApp:
         t0 = time.perf_counter()
         try:
             _fault_hook("request")  # no-op unless --faultPlan installed
-            status, body = handler(payload)
+            with _obs_span("request", endpoint=ep):
+                status, body = handler(payload)
         except AdmissionError as e:
             self._m_errors.inc()
             return 429, {"error": str(e)}

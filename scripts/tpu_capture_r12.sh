@@ -1,0 +1,84 @@
+#!/usr/bin/env bash
+# Round-12 capture: ISSUE 7 (observability) chip evidence. The obs layer
+# is CPU-verified end-to-end (tests/test_obs.py, the obs-smoke CI job);
+# what only a chip can tell us is (a) what --obs actually COSTS on the
+# real hot path — the per-step block_until_ready that makes device time
+# exact trades dispatch pipelining for truth, and the A/B below puts a
+# number on that trade (PERF.md §15 overhead slot), (b) what the phase
+# split says about the tuned configs (device_s should dominate; any
+# data_wait on synthetic data is dispatch-loop overhead), and (c) that a
+# mid-run --traceSteps window on hardware produces an xplane the PR 3
+# reader parses (the capture leg stamps ok:true into its JSON line).
+# Appends to $OUT, mirrored into the repo per step.
+
+set -uo pipefail
+cd "$(dirname "$0")/.."
+OUT="${OUT:-/tmp/tpu_capture_r12.log}"
+REPO_LOG="${REPO_LOG:-TPU_CAPTURE_r12.log}"
+TRACE_ROOT="${TRACE_ROOT:-/tmp/obs_r12}"
+trap 'cp -f "$OUT" "$REPO_LOG" 2>/dev/null || true' EXIT
+
+step() {
+  local name="$1" tmo="$2"; shift 2
+  echo "=== $name ($(date -u +%H:%M:%SZ))" | tee -a "$OUT"
+  timeout "$tmo" "$@" 2>&1 | tail -30 | tee -a "$OUT"
+  echo "=== end $name rc=$?" | tee -a "$OUT"
+  cp -f "$OUT" "$REPO_LOG" 2>/dev/null || true
+}
+
+# 0. compiled-path + obs tests first (a broken kernel path would poison
+#    every number below; the span/capture contracts must hold on-chip)
+step "pytest_tpu_marked" 1200 env BIGDL_TPU_TESTS=1 python -m pytest tests/ -m tpu -q
+step "pytest_obs" 600 python -m pytest tests/test_obs.py -q
+
+# 1. obs-on vs obs-off overhead A/B (the §15 overhead slot): identical
+#    tuned resnet50 config, 3 interleaved reps each. The obs leg stamps
+#    the phase columns + stall_frac into its JSON line; the img/s delta
+#    between legs IS the cost of exact per-step phase attribution
+#    (expected: the block_until_ready sync serializes dispatch — same
+#    class of cost as log_every=1).
+for REP in 1 2 3; do
+  step "perf_obsoff_rep${REP}" 1800 python -m bigdl_tpu.cli.main perf \
+    -m resnet50 -b 128 -i 40 --fusedBN apply --autotune cached
+  step "perf_obson_rep${REP}" 1800 python -m bigdl_tpu.cli.main perf \
+    -m resnet50 -b 128 -i 40 --fusedBN apply --autotune cached \
+    --obs --traceDir "$TRACE_ROOT/resnet50_rep${REP}"
+done
+
+# 2. same A/B at the transformer_lm flagship (different dispatch
+#    cadence; tokens/s + phase split land in §15)
+step "perf_lm_obsoff" 1800 python -m bigdl_tpu.cli.main perf \
+  -m transformer_lm_1k_hd128 -b 8 -i 40 --autotune cached
+step "perf_lm_obson" 1800 python -m bigdl_tpu.cli.main perf \
+  -m transformer_lm_1k_hd128 -b 8 -i 40 --autotune cached \
+  --obs --traceDir "$TRACE_ROOT/lm"
+
+# 3. mid-run capture window ON CHIP: --traceSteps 4@20 opens a bounded
+#    jax.profiler window at step 20 of a 60-step run and verifies the
+#    xplane parses (ok:true in the JSON obs.captures annotation); the
+#    resulting profile feeds scripts/backward_roofline.py exactly like
+#    a --profile run would, but without profiling the warmup.
+step "perf_tracesteps_window" 2400 python -m bigdl_tpu.cli.main perf \
+  -m resnet50 -b 128 -i 60 --fusedBN apply --autotune cached \
+  --obs --traceDir "$TRACE_ROOT/window" --traceSteps 4@20
+
+# 4. input-pipeline phase split: the record-fed bench is the config the
+#    feed-stall columns were built for (resnet50_pipe measured 0.99%
+#    MFU, PERF.md §4 — data_wait_s/stall_frac now say exactly how much
+#    of every wall-second the chip spent starved). Shards are built on
+#    the fly if the probe dir is absent.
+if [ -d "${SHARDS:-/tmp/r12_shards}" ]; then
+  step "perf_pipe_obs" 2400 python -m bigdl_tpu.cli.main perf \
+    -m resnet50 -b 128 -i 30 --data "record:${SHARDS:-/tmp/r12_shards}" \
+    --obs --traceDir "$TRACE_ROOT/pipe"
+else
+  echo "=== perf_pipe_obs skipped (no \$SHARDS dir)" | tee -a "$OUT"
+fi
+
+# 5. training-loop phase split + live scrape: a short supervised TTA
+#    run with the metrics listener up; the scrape is taken mid-run by
+#    the smoke harness (same assertions as CI, now against chip phase
+#    numbers), and the epoch log lines carry data_wait/dispatch/stall.
+step "obs_smoke_chip" 1800 python scripts/obs_smoke.py -b 64 -i 60
+
+echo "=== r12 capture complete ($(date -u +%H:%M:%SZ))" | tee -a "$OUT"

@@ -1,0 +1,374 @@
+"""Unified observability layer (ISSUE 7): span tracing, shared
+registry, capture windows, CLI wiring.
+
+Covers the satellite contract: span nesting + thread-safety under an
+injected clock, Chrome-trace JSON validity (loads, events properly
+nested, pid/tid/ts sane), registry exposition from the training path,
+a capture-window trigger producing a parseable xplane on CPU, and
+disabled-mode overhead (span() is a shared no-op singleton; obs-off
+perf output identical modulo the new null columns).
+"""
+
+import json
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+from bigdl_tpu import obs
+from bigdl_tpu.obs.spans import NOOP_SPAN, Tracer
+
+
+@pytest.fixture(autouse=True)
+def _clean_obs():
+    """Every test starts and ends with tracing off and a fresh global
+    registry (other test modules share the process)."""
+    obs.disable()
+    obs.reset_registry()
+    yield
+    obs.disable()
+    obs.reset_registry()
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def tick(self, dt):
+        self.t += dt
+        return self.t
+
+
+# ------------------------------------------------------------------ spans
+def test_span_nesting_under_injected_clock():
+    clk = FakeClock(10.0)
+    tr = Tracer(clock=clk)
+    obs.set_tracer(tr)
+    with obs.span("outer"):
+        clk.tick(1.0)
+        with obs.span("inner", step=3):
+            clk.tick(0.25)
+        clk.tick(0.5)
+    evs = tr.events()
+    # completed-on-exit ordering: inner closes first
+    assert [e["name"] for e in evs] == ["inner", "outer"]
+    inner, outer = evs
+    assert inner["ts"] == pytest.approx(11.0)
+    assert inner["dur"] == pytest.approx(0.25)
+    assert inner["depth"] == 1 and inner["args"] == {"step": 3}
+    assert outer["ts"] == pytest.approx(10.0)
+    assert outer["dur"] == pytest.approx(1.75)
+    assert outer["depth"] == 0
+    # nesting containment on the fake timeline
+    assert outer["ts"] <= inner["ts"]
+    assert inner["ts"] + inner["dur"] <= outer["ts"] + outer["dur"]
+
+
+def test_span_disabled_is_shared_noop_singleton():
+    assert not obs.enabled()
+    s1 = obs.span("a")
+    s2 = obs.span("b", x=1)
+    assert s1 is NOOP_SPAN and s2 is NOOP_SPAN  # no allocation, no clock
+    with s1:
+        pass  # and it is a working (do-nothing) context manager
+
+
+def test_span_thread_safety_and_tids():
+    tr = obs.enable(capacity=4096)
+    n_threads, n_spans = 4, 200
+    barrier = threading.Barrier(n_threads)
+
+    def work():
+        barrier.wait()
+        for i in range(n_spans):
+            with obs.span("w"):
+                pass
+
+    threads = [threading.Thread(target=work) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    evs = tr.events()
+    assert len(evs) == n_threads * n_spans  # nothing lost or corrupted
+    tids = {e["tid"] for e in evs}
+    assert len(tids) == n_threads  # stable small per-thread ids
+    per_tid = {tid: sorted(e["ts"] for e in evs if e["tid"] == tid)
+               for tid in tids}
+    for tid, n in ((t, len(v)) for t, v in per_tid.items()):
+        assert n == n_spans
+
+
+def test_ring_buffer_bounds_memory_and_counts_drops():
+    tr = obs.enable(capacity=8)
+    for i in range(20):
+        with obs.span(f"s{i}"):
+            pass
+    assert len(tr.events()) == 8
+    assert tr.dropped == 12
+    # oldest dropped, newest kept
+    assert tr.events()[-1]["name"] == "s19"
+
+
+def test_chrome_trace_export_valid_and_nested(tmp_path):
+    clk = FakeClock(5.0)
+    tr = Tracer(clock=clk)
+    obs.set_tracer(tr)
+    for step in range(3):
+        with obs.span("step", i=step):
+            clk.tick(0.001)
+            with obs.span("h2d"):
+                clk.tick(0.002)
+            with obs.span("device"):
+                clk.tick(0.004)
+            clk.tick(0.001)
+    path = str(tmp_path / "trace.json")
+    n = tr.export_chrome_trace(path)
+    with open(path) as f:
+        doc = json.load(f)  # must json-load
+    evs = doc["traceEvents"]
+    assert n == len(evs) == 9
+    assert all(e["ph"] == "X" for e in evs)
+    assert len({e["pid"] for e in evs}) == 1
+    # ts monotone non-decreasing per tid in export order
+    for tid in {e["tid"] for e in evs}:
+        ts = [e["ts"] for e in evs if e["tid"] == tid]
+        assert ts == sorted(ts)
+    # every h2d/device interval sits inside a step interval
+    steps = [(e["ts"], e["ts"] + e["dur"]) for e in evs
+             if e["name"] == "step"]
+    for e in evs:
+        if e["name"] in ("h2d", "device"):
+            lo, hi = e["ts"], e["ts"] + e["dur"]
+            assert any(s <= lo and hi <= t + 1e-6 for s, t in steps)
+
+
+# --------------------------------------------------------------- registry
+def test_global_registry_singleton_and_reset():
+    r1 = obs.get_registry()
+    assert obs.get_registry() is r1
+    assert r1.namespace == "bigdl"
+    obs.reset_registry()
+    assert obs.get_registry() is not r1
+
+
+def test_phase_histograms_idempotent():
+    reg = obs.get_registry()
+    h1 = obs.phase_histograms(reg, "train")
+    h2 = obs.phase_histograms(reg, "train")
+    assert set(h1) == set(obs.TRAIN_PHASES)
+    for ph in h1:
+        assert h1[ph] is h2[ph]  # registry dedups by name
+
+
+def _train_tiny(epochs=1):
+    import jax.numpy as jnp  # noqa: F401 (backend init)
+
+    from bigdl_tpu import nn
+    from bigdl_tpu.core import Sequential
+    from bigdl_tpu.dataset import BatchDataSet
+    from bigdl_tpu.optim import Optimizer, SGD, Trigger
+
+    rs = np.random.RandomState(0)
+    x = rs.randn(32, 8).astype(np.float32)
+    y = rs.randint(0, 3, 32)
+    ds = BatchDataSet(x, y, batch_size=8)
+    model = Sequential(nn.Linear(8, 3), nn.LogSoftMax())
+    opt = Optimizer(model, ds, nn.ClassNLLCriterion(),
+                    optim_method=SGD(learning_rate=0.1),
+                    end_when=Trigger.max_epoch(epochs))
+    opt.optimize()
+    return opt
+
+
+def test_training_publishes_phases_to_registry():
+    obs.enable()
+    opt = _train_tiny()
+    totals = opt.phase_totals()
+    # dispatch covers the jitted step calls; device wait was split out
+    # because obs is on
+    assert totals["dispatch"] > 0
+    assert "device" in totals and totals["device"] >= 0
+    page = obs.get_registry().render()
+    assert "bigdl_train_phase_dispatch_ms_count" in page
+    assert "bigdl_train_phase_dispatch_seconds_total" in page
+    assert "bigdl_train_phase_data_wait_seconds_total" in page
+    # histogram saw one observation per dispatch (4 batches x 1 epoch)
+    h = obs.get_registry().histogram("train_phase_dispatch_ms")
+    assert h.count == 4
+
+
+def test_training_obs_off_still_meters_feed_stall():
+    """Satellite #1: fetch/dispatch seconds surface in EVERY run — the
+    old fetch_accum was measured then dropped."""
+    assert not obs.enabled()
+    opt = _train_tiny()
+    totals = opt.phase_totals()
+    assert totals["dispatch"] > 0
+    assert totals["data_wait"] >= 0
+    assert "device" not in totals  # the sync split is obs-only
+    page = obs.get_registry().render()
+    assert "bigdl_train_phase_dispatch_seconds_total" in page
+    # but no per-step histograms were fed (no per-step locking obs-off)
+    assert "train_phase_dispatch_ms_count" not in page
+
+
+def test_metrics_http_listener_scrapes_registry():
+    reg = obs.get_registry()
+    reg.counter("smoke_total", "x").inc(3)
+    srv = obs.start_metrics_server(reg, port=0)
+    try:
+        with urllib.request.urlopen(srv.url, timeout=10) as r:
+            page = r.read().decode()
+        assert "bigdl_smoke_total 3" in page
+        health = srv.url.replace("/metrics", "/healthz")
+        with urllib.request.urlopen(health, timeout=10) as r:
+            assert json.loads(r.read())["status"] == "ok"
+    finally:
+        srv.close()
+
+
+def test_serving_shim_reexports():
+    """Satellite #2: serving/metrics.py keeps its surface (same classes,
+    same default namespace) while the implementation lives in obs."""
+    from bigdl_tpu.obs import metrics as obs_metrics
+    from bigdl_tpu.serving import metrics as serving_metrics
+
+    assert serving_metrics.MetricsRegistry is obs_metrics.MetricsRegistry
+    assert serving_metrics.Histogram is obs_metrics.Histogram
+    reg = serving_metrics.MetricsRegistry()
+    assert reg.namespace == "bigdl_serving"  # pinned default
+
+
+# ---------------------------------------------------------------- capture
+def test_parse_trace_steps():
+    from bigdl_tpu.obs.capture import parse_trace_steps
+    assert parse_trace_steps("5@20") == (5, 20)
+    assert parse_trace_steps("1@0") == (1, 0)
+    for bad in ("", "5", "@3", "0@2", "a@b", "3@"):
+        with pytest.raises(ValueError):
+            parse_trace_steps(bad)
+
+
+def test_capture_window_produces_parseable_xplane(tmp_path):
+    """--traceSteps N@M on CPU: the window opens at M, closes at M+N,
+    and the resulting xplane parses with utils/xplane (the PR 3
+    reader)."""
+    import jax
+    import jax.numpy as jnp
+
+    ctl = obs.CaptureController(str(tmp_path / "tr"), trace_steps="2@1",
+                                install_signal=False)
+    f = jax.jit(lambda a: a * 2 + 1)
+    for step in range(5):
+        ctl.on_step(step)
+        f(jnp.arange(8.0)).block_until_ready()
+    ctl.finish()
+    assert len(ctl.captures) == 1
+    cap = ctl.captures[0]
+    assert cap["start_step"] == 1 and cap["stop_step"] == 3
+    assert cap["ok"], cap.get("error")
+    assert cap["planes"] >= 1
+    from bigdl_tpu.utils.xplane import parse_xspace
+    assert len(parse_xspace(cap["xplane"])) == cap["planes"]
+
+
+def test_capture_touch_file_trigger(tmp_path):
+    import jax
+    import jax.numpy as jnp
+
+    d = str(tmp_path / "tr")
+    ctl = obs.CaptureController(d, window_steps=2, install_signal=False)
+    f = jax.jit(lambda a: a + 1)
+    f(jnp.arange(4.0)).block_until_ready()  # compile outside windows
+    for step in range(8):
+        if step == 3:
+            open(ctl.touch_file, "w").close()
+        ctl.on_step(step)
+        f(jnp.arange(4.0)).block_until_ready()
+    ctl.finish()
+    assert len(ctl.captures) == 1
+    cap = ctl.captures[0]
+    assert cap["trigger"] == "touch"
+    assert cap["start_step"] == 3 and cap["stop_step"] == 5
+    assert cap["ok"], cap.get("error")
+    # the touch file was consumed: one touch = one capture
+    import os
+    assert not os.path.exists(ctl.touch_file)
+
+
+# ------------------------------------------------------------- CLI wiring
+def _perf_run(tmp_path, obs_on):
+    from bigdl_tpu.cli import common
+    from bigdl_tpu.cli.perf import run
+
+    obs_state = None
+    if obs_on:
+        obs.enable()
+        obs_state = common.ObsState(True, str(tmp_path / "tr"), None,
+                                    None)
+    return run("lenet5", 16, 6, "constant", use_bf16=False,
+               obs_state=obs_state)
+
+
+def test_perf_phase_columns_sum_to_wall_time(tmp_path):
+    """Acceptance (a): under --obs the phase columns sum to within 10%
+    of the measured wall time, and the span timeline lands in
+    --traceDir."""
+    out = _perf_run(tmp_path, obs_on=True)
+    s = (out["data_wait_s"] + out["h2d_s"] + out["dispatch_s"]
+         + out["device_s"] + out["ckpt_s"])
+    assert s == pytest.approx(out["seconds"], rel=0.10)
+    assert out["stall_frac"] is not None
+    assert out["obs"]["span_events"] > 0
+    with open(out["obs"]["trace_json"]) as f:
+        doc = json.load(f)
+    names = {e["name"] for e in doc["traceEvents"]}
+    assert {"dispatch", "device"} <= names
+    # and the scrape surface carries the step-phase histograms
+    page = obs.get_registry().render()
+    assert "train_phase_dispatch_ms_bucket" in page
+
+
+def test_perf_obs_off_identical_modulo_null_columns(tmp_path):
+    """Acceptance: an obs-off run's JSON is the pre-PR schema plus
+    exactly the null phase columns."""
+    out = _perf_run(tmp_path, obs_on=False)
+    cols = ("data_wait_s", "h2d_s", "dispatch_s", "device_s", "ckpt_s",
+            "stall_frac")
+    for c in cols:
+        assert c in out and out[c] is None
+    assert "obs" not in out
+    # spans stayed compiled-to-noops through the whole run
+    assert obs.span("check") is NOOP_SPAN
+
+
+def test_install_observability_wiring(tmp_path):
+    import argparse
+
+    from bigdl_tpu.cli import common
+
+    p = argparse.ArgumentParser()
+    common.add_obs_args(p)
+    # nothing set -> no-op
+    args = p.parse_args([])
+    assert common.install_observability(args) is None
+    assert not obs.enabled()
+    # --traceSteps without --traceDir is a clean CLI error
+    args = p.parse_args(["--traceSteps", "2@1"])
+    with pytest.raises(SystemExit, match="traceDir"):
+        common.install_observability(args)
+    assert not obs.enabled()
+    # --traceDir implies spans + capture controller
+    args = p.parse_args(["--traceDir", str(tmp_path / "t")])
+    st = common.install_observability(args)
+    assert st is not None and st.enabled and obs.enabled()
+    assert st.capture is not None and st.capture.trace_dir == str(
+        tmp_path / "t")
+    st.capture.finish()
+    info = st.finalize()
+    assert info is st.finalize()  # idempotent
