@@ -50,8 +50,17 @@ PARTIAL_LOG = os.environ.get(
 
 
 def child(backend: str, model: str, batch: int, iters: int,
-          inner: int = 1, autotune: str = "off") -> None:
+          inner: int = 1, autotune: str = "off",
+          strategy: str = "") -> None:
     """Run one benchmark and print the perf dict as a JSON line."""
+    if strategy and backend == "cpu":
+        # a multi-device strategy on the CPU fallback needs the virtual
+        # 8-device platform; must land in the env BEFORE jax imports
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
     import jax
 
     if backend == "cpu":
@@ -109,7 +118,7 @@ def child(backend: str, model: str, batch: int, iters: int,
 
     out = perf.run(model, batch, iters, "random", use_bf16=True,
                    data_source=data_source, inner_steps=inner,
-                   autotune=autotune)
+                   autotune=autotune, strategy=strategy or None)
     if data_source is not None:
         out["model"] += "_pipe"
         out["data_source"] = "record-shards (generated, ~120KB JPEGs)"
@@ -118,10 +127,11 @@ def child(backend: str, model: str, batch: int, iters: int,
 
 
 def _attempt(backend: str, model: str, batch: int, iters: int,
-             timeout: int, inner: int = 1, autotune: str = "off"):
+             timeout: int, inner: int = 1, autotune: str = "off",
+             strategy: str = ""):
     """Spawn the child benchmark; return (result_dict | None, error | None)."""
     cmd = [sys.executable, os.path.abspath(__file__), "--child", backend,
-           model, str(batch), str(iters), str(inner), autotune]
+           model, str(batch), str(iters), str(inner), autotune, strategy]
     try:
         proc = subprocess.run(
             cmd, capture_output=True, text=True, timeout=timeout,
@@ -222,6 +232,12 @@ def _build_line(model, result, companions, errors):
             line["tokens_per_second"] = result["tokens_per_second"]
         if "flops_disagreement" in result:
             line["flops_disagreement"] = result["flops_disagreement"]
+        # ISSUE 8: a multichip row says which mesh its collectives rode,
+        # and carries the per-step collective time when a capture fired
+        if result.get("strategy") is not None:
+            for k in ("strategy", "n_devices", "mesh", "collective_s",
+                      "collective_frac"):
+                line[k] = result.get(k)
     if companions:
         line["companions"] = companions
     if errors:
@@ -231,9 +247,22 @@ def _build_line(model, result, companions, errors):
 
 def main() -> None:
     global _line
-    model = sys.argv[1] if len(sys.argv) > 1 else "resnet50"
-    batch = int(sys.argv[2]) if len(sys.argv) > 2 else 128
-    iters = int(sys.argv[3]) if len(sys.argv) > 3 else 20
+    argv = list(sys.argv[1:])
+    # --strategy NAME[:K] (or BENCH_STRATEGY): run the headline config
+    # over every visible device via bigdl_tpu.parallel (ISSUE 8) — the
+    # CPU fallback child forces the 8-device virtual platform so the
+    # sweep stays runnable off-chip
+    strategy = os.environ.get("BENCH_STRATEGY", "")
+    if "--strategy" in argv:
+        i = argv.index("--strategy")
+        if i + 1 >= len(argv):
+            print(json.dumps({"error": "--strategy needs a value"}))
+            return
+        strategy = argv[i + 1]
+        del argv[i:i + 2]
+    model = argv[0] if len(argv) > 0 else "resnet50"
+    batch = int(argv[1]) if len(argv) > 1 else 128
+    iters = int(argv[2]) if len(argv) > 2 else 20
 
     # if the driver kills us mid-companion-run, the headline result must
     # not be lost: emit the best line built so far on SIGTERM/SIGINT
@@ -283,7 +312,8 @@ def main() -> None:
     except OSError:
         pass
     if tpu_up:
-        result, err = _attempt("default", model, batch, iters, TPU_TIMEOUT)
+        result, err = _attempt("default", model, batch, iters, TPU_TIMEOUT,
+                               strategy=strategy)
         if err:
             errors.append(err)
         if result is not None and result.get("backend") == "tpu":
@@ -383,8 +413,12 @@ def main() -> None:
                     companions[cname] = {"error": cerr}
                 _line = _build_line(model, result, companions, errors)
     if result is None:
-        # CPU fallback: tiny shapes so the line lands fast; marked as cpu
-        result, err = _attempt("cpu", model, min(batch, 4), 2, CPU_TIMEOUT)
+        # CPU fallback: tiny shapes so the line lands fast; marked as
+        # cpu (a strategy run keeps batch 16 so the 8-way data axis
+        # still divides it)
+        result, err = _attempt("cpu", model,
+                               min(batch, 16 if strategy else 4), 2,
+                               CPU_TIMEOUT, strategy=strategy)
         if err:
             errors.append(err)
 
@@ -396,6 +430,7 @@ if __name__ == "__main__":
     if len(sys.argv) > 1 and sys.argv[1] == "--child":
         child(sys.argv[2], sys.argv[3], int(sys.argv[4]), int(sys.argv[5]),
               int(sys.argv[6]) if len(sys.argv) > 6 else 1,
-              sys.argv[7] if len(sys.argv) > 7 else "off")
+              sys.argv[7] if len(sys.argv) > 7 else "off",
+              sys.argv[8] if len(sys.argv) > 8 else "")
     else:
         main()

@@ -444,19 +444,147 @@ def setup_logging() -> None:
         format="%(asctime)s %(levelname)s %(name)s - %(message)s")
 
 
-def build_strategy(args):
-    """DataParallel over every visible device when requested (the
-    reference's Engine.init(node, cores) + DistriOptimizer path)."""
-    if not getattr(args, "dataParallel", False):
+# the --strategy surface (ISSUE 8): the five parallelism families the
+# MULTICHIP_r05 dryruns validate, now reachable from perf/bench/training
+# instead of living only in __graft_entry__.py
+STRATEGY_CHOICES = ("dp", "tp", "sp", "pp", "ep")
+
+
+def add_strategy_arg(p: argparse.ArgumentParser) -> None:
+    p.add_argument("--strategy", default=None, metavar="NAME[:K]",
+                   help="multi-device training strategy over every "
+                        "visible device (bigdl_tpu.parallel): dp = data "
+                        "parallel (ZeRO-1 sharded optimizer state), tp = "
+                        "dp x Megatron tensor parallel, sp = dp x ring-"
+                        "attention sequence parallel (transformer_lm* "
+                        "models), pp = GPipe pipeline x dp "
+                        "(transformer_lm* models), ep = expert-parallel "
+                        "MoE. Optional :K sizes the non-data axis (e.g. "
+                        "tp:4 = 4-way model parallel, pp:2 = 2 stages); "
+                        "defaults mirror the MULTICHIP_r05 dryrun "
+                        "shapes. CPU-testable end to end with XLA_FLAGS="
+                        "--xla_force_host_platform_device_count=8. "
+                        "Replaces the deprecated --dataParallel "
+                        "(still accepted as an alias for 'dp'). Mesh "
+                        "topology and device count are stamped into "
+                        "every result JSON line")
+
+
+def parse_strategy_spec(spec: Optional[str]):
+    """``"name[:K]"`` -> ``(name, k|None)``; SystemExit on junk (the
+    clean-CLI-validation contract, ADVICE r5 #5)."""
+    if not spec:
+        return None, None
+    name, _, k = str(spec).partition(":")
+    if name not in STRATEGY_CHOICES:
+        raise SystemExit(f"--strategy {spec!r}: unknown strategy "
+                         f"{name!r}; choose from {list(STRATEGY_CHOICES)}"
+                         " (optionally NAME:K to size the non-data axis)")
+    if not k:
+        return name, None
+    try:
+        kk = int(k)
+    except ValueError:
+        raise SystemExit(f"--strategy {spec!r}: K must be an integer")
+    if kk < 1:
+        raise SystemExit(f"--strategy {spec!r}: K must be >= 1")
+    return name, kk
+
+
+def resolve_strategy(args):
+    """The run's effective ``(strategy_name, k|None)`` — ``--strategy``
+    wins; the historical ``--dataParallel`` flag is kept as a deprecated
+    alias for ``dp``."""
+    name, k = parse_strategy_spec(getattr(args, "strategy", None))
+    if name is not None:
+        return name, k
+    if getattr(args, "dataParallel", False):
+        logging.getLogger(__name__).warning(
+            "--dataParallel is deprecated; use --strategy dp")
+        return "dp", None
+    return None, None
+
+
+def check_strategy_dispatch(steps: int, flag: str = "--stepsPerDispatch"):
+    """The PR 1 validation contract: multi-step dispatch amortization is
+    single-device by construction and refuses (clean SystemExit) to
+    combine with a multi-device strategy — perf's old hidden
+    data_parallel branch silently ignored this."""
+    if steps and int(steps) > 1:
+        raise SystemExit(
+            f"{flag} > 1 is a single-device dispatch amortization (the "
+            "stepsPerDispatch contract); it cannot be combined with a "
+            "multi-device --strategy/--dataParallel (whose runtime "
+            "pipelines dispatch already)")
+
+
+def strategy_mesh_axes(name: str, n_devices: int, k: Optional[int] = None
+                       ) -> dict:
+    """Axis layout of one strategy over ``n_devices`` (the MULTICHIP_r05
+    dryrun shapes). ``k`` sizes the non-data axis; defaults: tp/sp split
+    devices 2-way on data (n>=4), pp uses 4 stages (n%4==0) else 2, ep
+    puts every device on the expert axis."""
+    n = int(n_devices)
+    if name == "dp":
+        return {"data": n}
+    if name in ("tp", "sp"):
+        axis = "model" if name == "tp" else "seq"
+        kk = k or (n // 2 if n >= 4 else n)
+        if n % kk:
+            raise SystemExit(f"--strategy {name}:{kk} needs the {axis} "
+                             f"axis to divide {n} devices")
+        return {"data": n // kk, axis: kk}
+    if name == "pp":
+        kk = k or (4 if n % 4 == 0 and n >= 4 else 2)
+        if n % kk:
+            raise SystemExit(f"--strategy pp:{kk} needs the stage count "
+                             f"to divide {n} devices")
+        return {"pipe": kk, "data": n // kk}
+    if name == "ep":
+        return {"expert": k or n}
+    raise SystemExit(f"unknown strategy {name!r}")
+
+
+def build_strategy(args, model=None):
+    """Resolve ``--strategy``/``--dataParallel`` into a strategy object
+    consumed by the Optimizer (the reference's Engine.init(node, cores)
+    + DistriOptimizer path). Owns the validation the old perf branch
+    skipped: the stepsPerDispatch/innerSteps x strategy SystemExit
+    contract fires here, BEFORE any mesh is built. Returns None
+    single-device (the deprecated alias degrades silently, an explicit
+    --strategy exits with the XLA_FLAGS recipe). dp/tp build here;
+    sp/pp/ep need harness-side model composition (ring attention /
+    pipeline stack / MoE) and are wired in ``cli/perf.py``."""
+    name, k = resolve_strategy(args)
+    if name is None:
         return None
     import jax
 
-    from bigdl_tpu.parallel import DataParallel, make_mesh
-
     n = len(jax.devices())
     if n <= 1:
-        return None
-    return DataParallel(make_mesh({"data": n}))
+        if getattr(args, "strategy", None):
+            raise SystemExit(
+                f"--strategy {name} needs more than one device; off-chip "
+                "set XLA_FLAGS=--xla_force_host_platform_device_count=8 "
+                "(the MULTICHIP dryrun recipe)")
+        return None  # deprecated --dataParallel alias: historical no-op
+    check_strategy_dispatch(getattr(args, "stepsPerDispatch", 1) or 1)
+    check_strategy_dispatch(getattr(args, "innerSteps", 1) or 1,
+                            "--innerSteps")
+    from bigdl_tpu.parallel import DataParallel, TensorParallel, make_mesh
+
+    axes = strategy_mesh_axes(name, n, k)
+    if name == "dp":
+        return DataParallel(make_mesh(axes))
+    if name == "tp":
+        if model is None:
+            raise SystemExit("--strategy tp needs the model to derive "
+                             "its Megatron sharding rules")
+        return TensorParallel(make_mesh(axes), model)
+    raise SystemExit(f"--strategy {name} composes with the model/step "
+                     "structure and is wired through the perf harness "
+                     "(bigdl-tpu perf --strategy {sp,pp,ep}); the "
+                     "training CLIs support dp/tp")
 
 
 def build_optimizer(model, dataset, criterion, args, schedule=None,
@@ -498,16 +626,10 @@ def build_optimizer(model, dataset, criterion, args, schedule=None,
                 "lamb": lambda: LAMB(learning_rate=lr, weight_decay=wd,
                                      schedule=sched),
             }[name]()
-    strategy = build_strategy(args)
+    # build_strategy owns the stepsPerDispatch x strategy SystemExit
+    # contract (ADVICE r5 #5) — one validator shared with perf (ISSUE 8)
+    strategy = build_strategy(args, model=model)
     k = int(getattr(args, "stepsPerDispatch", 1) or 1)
-    if k > 1 and strategy is not None:
-        # same clean exit as the other CLI validation errors (ADVICE r5
-        # #5) instead of the Optimizer constructor's raw ValueError
-        raise SystemExit(
-            "--stepsPerDispatch > 1 is a single-device dispatch "
-            "amortization; it cannot be combined with --dataParallel "
-            "over multiple devices (whose runtime pipelines dispatch "
-            "already)")
     opt = Optimizer(model, dataset, criterion,
                     optim_method=optim_method,
                     end_when=Trigger.max_epoch(args.maxEpoch),

@@ -1,0 +1,118 @@
+"""`bigdl-tpu explain` — turn a profile into an explanation (ISSUE 8).
+
+    bigdl-tpu explain /tmp/obs/capture_4            # a capture window
+    bigdl-tpu explain /tmp/xp --steps 5 --gflops 94 # any profiler dir
+    bigdl-tpu explain resnet50 -b 32 -i 5           # run + explain
+
+The target is either a ``jax.profiler`` output directory (a perf
+``--profile`` dir or an obs ``capture_<step>`` window) or a perf-zoo
+model name — the latter runs a short profiled throughput loop first
+(``cli/perf.py``), then attributes its own trace with the run's analytic
+FLOPs numerator and mesh peak, so the table carries FLOP share and
+roofline utilization, not just times. Output: the per-category table
+(``utils/table``) with the collective breakout and MFU decomposition,
+or ``--json`` (one line, printed last — ``tail -1`` safe).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        "bigdl-tpu explain",
+        description="classify every device op of a profile into the "
+                    "PERF.md §16 taxonomy (matmul/conv/bn_norm/"
+                    "attention/elementwise/collective/infeed/host_other)"
+                    " with per-collective subtotals and an MFU "
+                    "decomposition")
+    p.add_argument("target",
+                   help="jax.profiler trace dir (e.g. an obs "
+                        "capture_<step> window) OR a perf model name "
+                        "(runs a short profiled loop first)")
+    p.add_argument("--json", action="store_true",
+                   help="machine output (one JSON line, printed last)")
+    p.add_argument("-b", "--batchSize", type=int, default=16,
+                   help="batch for model-mode runs")
+    p.add_argument("-i", "--iteration", type=int, default=5,
+                   help="timed steps for model-mode runs (= the step "
+                        "count the attribution divides by)")
+    p.add_argument("--steps", type=int, default=None,
+                   help="step count of a profile-dir target (enables "
+                        "ms/step and the per-step collective column)")
+    p.add_argument("--gflops", type=float, default=None,
+                   help="analytic step GFLOPs of the profiled run "
+                        "(perf JSON's step_gflops_analytic) — enables "
+                        "FLOP share / utilization for a profile-dir "
+                        "target")
+    p.add_argument("--gflopsConv", type=float, default=None,
+                   help="conv share of --gflops (perf JSON's "
+                        "step_gflops_by_kind.conv); rest is matmul")
+    p.add_argument("--peak", type=float, default=None,
+                   help="whole-mesh peak FLOP/s for the roofline join "
+                        "(perf JSON's peak_flops_assumed x n_devices)")
+    p.add_argument("--top", type=int, default=3,
+                   help="top ops listed per category")
+    p.add_argument("--seq", type=int, default=None,
+                   help="transformer_lm* sequence override (model mode)")
+    from bigdl_tpu.cli.common import (_add_platform_arg, add_strategy_arg,
+                                      apply_platform)
+    _add_platform_arg(p)
+    add_strategy_arg(p)
+    args = p.parse_args(argv)
+    apply_platform(args)
+
+    from bigdl_tpu.obs import attrib
+
+    if os.path.isdir(args.target):
+        step_flops = args.gflops * 1e9 if args.gflops else None
+        by_kind = None
+        if step_flops and args.gflopsConv is not None:
+            conv = args.gflopsConv * 1e9
+            by_kind = {"matmul": max(0.0, step_flops - conv),
+                       "conv": conv}
+        summary = attrib.attribute_profile(
+            args.target, steps=args.steps, step_flops=step_flops,
+            flops_by_kind=by_kind, peak_flops=args.peak,
+            top_ops=args.top)
+    else:
+        # model mode: short profiled perf run, then attribute its trace
+        # with the run's own numerators (perf prints its JSON line
+        # first; ours is last)
+        import tempfile
+
+        from bigdl_tpu.cli import perf
+
+        tmp = tempfile.mkdtemp(prefix="bigdl_explain_")
+        out = perf.run(args.target, args.batchSize, args.iteration,
+                       "random", profile_dir=tmp,
+                       strategy=args.strategy, seq_len=args.seq)
+        gf = out.get("step_gflops_analytic") or 0.0
+        kinds = out.get("step_gflops_by_kind") or {}
+        summary = attrib.attribute_profile(
+            tmp, steps=args.iteration * out.get("inner_steps", 1),
+            step_flops=gf * 1e9 or None,
+            flops_by_kind={k: v * 1e9 for k, v in kinds.items()} or None,
+            peak_flops=(out.get("peak_flops_assumed") or 0)
+            * out.get("n_devices", 1) or None,
+            top_ops=args.top)
+        summary["perf"] = {k: out.get(k) for k in (
+            "model", "batch", "strategy", "n_devices", "mesh",
+            "records_per_second", "mfu_pct", "device")}
+
+    if args.json:
+        c = attrib.compact(summary)
+        c["xplane"] = summary.get("xplane")
+        if "perf" in summary:
+            c["perf"] = summary["perf"]
+        print(json.dumps(c))
+    else:
+        print(attrib.render(summary))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

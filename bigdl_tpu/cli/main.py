@@ -29,6 +29,7 @@ _COMMANDS = {
     "transformerlm": "transformerlm",
     "textclassification": "textclassification",
     "perf": "perf",
+    "explain": "explain",
     "lint": "lint",
     "serve": "serve",
     "predict": "predict",

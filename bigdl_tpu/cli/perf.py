@@ -240,6 +240,12 @@ def _annotate_bn_fused(out: dict, model) -> None:
 
 _PHASE_COLUMNS = ("data_wait_s", "h2d_s", "dispatch_s", "device_s",
                   "ckpt_s", "stall_frac")
+# ISSUE 8: attribution columns, schema-stable like the phase columns —
+# null until a capture window closed (no capture = no device timeline to
+# attribute), then the per-step collective seconds, the collective share
+# of device time, and the compact per-category attribution of the run's
+# LAST verified window.
+_ATTRIB_COLUMNS = ("collective_s", "collective_frac", "attrib")
 
 
 def _annotate_obs_phases(out: dict, obs_state, phase: dict | None = None,
@@ -250,7 +256,10 @@ def _annotate_obs_phases(out: dict, obs_state, phase: dict | None = None,
     modulo exactly these nulls), measured cumulative seconds under
     --obs. ``stall_frac`` is the feed-stall fraction of wall time — the
     number PERF.md §4 could previously only infer. Under --obs the
-    trace/capture artifacts ride along as ``obs``."""
+    trace/capture artifacts ride along as ``obs``, and a closed capture
+    window additionally fills the attribution columns (ISSUE 8)."""
+    for c in _ATTRIB_COLUMNS:
+        out[c] = None
     on = (obs_state is not None and obs_state.enabled
           and phase is not None)
     if not on:
@@ -272,8 +281,18 @@ def _annotate_obs_phases(out: dict, obs_state, phase: dict | None = None,
     if "captures" in info:
         o["captures"] = [
             {k: c[k] for k in ("start_step", "stop_step", "trigger",
-                               "ok", "dir", "error") if k in c}
+                               "ok", "dir", "error", "attrib",
+                               "attrib_error") if k in c}
             for c in info["captures"]]
+        for c in reversed(info["captures"]):
+            a = c.get("attrib")
+            if a:  # newest attributed window wins
+                steps = max(1, int(a.get("steps") or 1))
+                out["attrib"] = a
+                out["collective_s"] = round(
+                    a["collective_s"] / steps, 6)
+                out["collective_frac"] = a["collective_frac"]
+                break
     if o:
         out["obs"] = o
 
@@ -293,19 +312,140 @@ def _annotate_supervisor(out: dict, supervisor) -> None:
         out["faults"] = ev
 
 
+# (d_model, layers, heads, seq) of the LM zoo configs — the pp/ep
+# harness builders below size their pipeline stack / MoE block from the
+# requested model name so an A/B against the dp/tp/sp legs compares the
+# same transformer geometry
+_LM_GEOM = {
+    "transformer_lm": (512, 8, 8, 512),
+    "transformer_lm_rope": (512, 8, 8, 512),
+    "transformer_lm_1k": (1024, 12, 16, 1024),
+    "transformer_lm_1k_hd128": (1024, 12, 8, 1024),
+    "transformer_lm_16k": (1024, 12, 8, 16384),
+    "transformer_lm_32k": (1024, 12, 8, 32768),
+}
+
+
+def _setup_strategy_harness(strat_name: str, model_name: str, batch: int,
+                            mesh, mesh_axes: dict, dtype,
+                            seq_len: int | None):
+    """Build the pp/ep timed-loop pieces (ISSUE 8). These strategies
+    compose with the STEP structure, not just parameter placement — a
+    GPipe pipeline schedules microbatches through ppermute hops, an
+    expert-parallel MoE routes tokens — so they get dedicated builders
+    that return a step with the harness's uniform
+    ``(params, mod_state, opt_state, x, y, rng) -> 4-tuple`` signature.
+    Geometry comes from the requested transformer_lm* config
+    (:data:`_LM_GEOM`, seq overridable via --seq); the criterion is MSE
+    over the block stack (embedding/head run replicated outside a real
+    pipeline and are excluded, exactly like the MULTICHIP_r05 dryrun)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from bigdl_tpu import nn
+    from bigdl_tpu.optim import SGD
+
+    geom = _LM_GEOM.get(model_name)
+    if geom is None:
+        raise SystemExit(
+            f"--strategy {strat_name} sizes its transformer stack from "
+            f"the model name; choose one of {sorted(_LM_GEOM)}")
+    d_model, layers, heads, seq = geom
+    if seq_len is not None:
+        seq = int(seq_len)
+    crit = nn.MSECriterion()
+    opt = SGD(learning_rate=0.01, momentum=0.9)
+    rs = np.random.RandomState(0)
+    x = jnp.asarray(rs.randn(batch, seq, d_model), dtype)
+    y = jnp.asarray(rs.randn(batch, seq, d_model), dtype)
+
+    if strat_name == "pp":
+        from bigdl_tpu.parallel import (PipelineStack,
+                                        make_pipeline_train_step,
+                                        place_pipeline_params)
+
+        stages = mesh_axes["pipe"]
+        if layers % stages:
+            raise SystemExit(
+                f"--strategy pp: {layers} layers must divide over "
+                f"{stages} pipeline stages (try pp:{layers // 2} or a "
+                "deeper model)")
+        micro = stages  # GPipe bubble (P-1)/(M+P-1); M=P keeps it <50%
+        data_ax = mesh_axes.get("data", 1)
+        if batch % micro or (batch // micro) % data_ax:
+            raise SystemExit(
+                f"--strategy pp: batch {batch} must split into {micro} "
+                f"microbatches of a multiple of the data axis "
+                f"({data_ax})")
+        stack = PipelineStack(
+            nn.TransformerEncoderLayer(d_model=d_model, num_heads=heads,
+                                       d_ff=4 * d_model), layers)
+        params = place_pipeline_params(mesh,
+                                       stack.init(jax.random.PRNGKey(0)),
+                                       "pipe")
+        opt_state = opt.init(jax.device_get(params))
+        compile_for = make_pipeline_train_step(
+            stack, mesh, crit, opt, microbatches=micro, axis="pipe",
+            data_axis="data")
+        raw = compile_for(opt_state, params)
+
+        def step(params, mod_state, opt_state, x, y, rng):
+            p, o, loss = raw(params, opt_state, x, y, rng)
+            return p, mod_state, o, loss
+
+        return {"step": step, "single_step": step, "params": params,
+                "opt_state": opt_state, "x": x, "y": y,
+                "in_shape": (seq, d_model)}
+
+    # ep: expert-parallel MoE — experts sharded over the expert axis,
+    # the top-2 router's dispatch/combine einsums become the measured
+    # all-to-all-shaped traffic
+    from bigdl_tpu.core import Sequential
+
+    n_exp = mesh_axes["expert"]
+    moe = nn.MoE(Sequential(nn.Linear(d_model, 2 * d_model), nn.ReLU(),
+                            nn.Linear(2 * d_model, d_model)),
+                 num_experts=n_exp, d_model=d_model, top_k=2,
+                 capacity_factor=2.0)
+    params = moe.place_expert_parallel(mesh,
+                                       moe.init(jax.random.PRNGKey(0)))
+    opt_state = opt.init(params)
+
+    def train_step(params, mod_state, opt_state, x, y, rng):
+        def loss_fn(p):
+            out, st = moe.apply(p, moe.init_state(), x, training=True)
+            return (crit(out.astype(jnp.float32),
+                         y.astype(jnp.float32))
+                    + 0.01 * st["aux_loss"])
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        new_p, new_o = opt.update(grads, opt_state, params)
+        return new_p, mod_state, new_o, loss
+
+    step = jax.jit(train_step, donate_argnums=(0, 2))
+    return {"step": step, "single_step": train_step, "params": params,
+            "opt_state": opt_state, "x": x, "y": y,
+            "in_shape": (seq, d_model)}
+
+
 def run(model_name: str, batch: int, iterations: int, data_type: str,
         use_bf16: bool = True, data_parallel: bool = False,
         data_source: str | None = None, inner_steps: int = 1,
         profile_dir: str | None = None, autotune: str | None = None,
         fused_bn: str | None = None, lint: dict | None = None,
-        supervisor=None, obs_state=None):
+        supervisor=None, obs_state=None, strategy: str | None = None,
+        seq_len: int | None = None):
     """Throughput harness entry. ``autotune`` optionally installs the
     tuning mode (the CLI does it via --autotune/apply_platform; bench.py
     children pass it directly). ``fused_bn`` ('off'/'stats'/'apply')
     installs the Pallas BN path on the built model — the flag spelling of
-    the resnet50_fbn/_fba model names. The conv layout policy is
-    snapshotted and restored so back-to-back runs in one process stay
-    independent (ADVICE r5 #1)."""
+    the resnet50_fbn/_fba model names. ``strategy`` ('dp'/'tp'/'sp'/
+    'pp'/'ep', optionally NAME:K) runs the timed loop over every visible
+    device via the ``parallel/`` API (ISSUE 8); ``data_parallel`` is the
+    deprecated alias for 'dp'. The conv layout policy is snapshotted and
+    restored so back-to-back runs in one process stay independent
+    (ADVICE r5 #1)."""
     from bigdl_tpu import tuning
     from bigdl_tpu.ops import conv2d
 
@@ -319,7 +459,8 @@ def run(model_name: str, batch: int, iterations: int, data_type: str,
                           data_source=data_source, inner_steps=inner_steps,
                           profile_dir=profile_dir, fused_bn=fused_bn,
                           lint=lint, supervisor=supervisor,
-                          obs_state=obs_state)
+                          obs_state=obs_state, strategy=strategy,
+                          seq_len=seq_len)
     finally:
         conv2d.restore_policy(snap)
 
@@ -329,7 +470,8 @@ def _run_timed(model_name: str, batch: int, iterations: int, data_type: str,
                data_source: str | None = None, inner_steps: int = 1,
                profile_dir: str | None = None,
                fused_bn: str | None = None, lint: dict | None = None,
-               supervisor=None, obs_state=None):
+               supervisor=None, obs_state=None,
+               strategy: str | None = None, seq_len: int | None = None):
     import os
 
     import jax
@@ -338,8 +480,60 @@ def _run_timed(model_name: str, batch: int, iterations: int, data_type: str,
 
     # persistent compile cache: repeat benchmark runs (the capture
     # sweeps re-measure the same configs) skip the 20-40s TPU compile
-    from bigdl_tpu.cli.common import enable_compile_cache
-    enable_compile_cache()
+    from bigdl_tpu.cli import common as _common
+    _common.enable_compile_cache()
+
+    # ----- strategy resolution (ISSUE 8): the hidden data_parallel-only
+    # branch is gone — all five MULTICHIP-validated families resolve
+    # through the shared cli/common machinery (mesh shapes, the
+    # innerSteps x strategy SystemExit contract), with --dataParallel
+    # kept as a deprecated alias for dp that still degrades silently on
+    # one device (its historical behavior)
+    strat_spec = strategy if strategy is not None else (
+        "dp" if data_parallel else None)
+    strat_name, strat_k = _common.parse_strategy_spec(strat_spec)
+    mesh = None
+    mesh_axes = None
+    if strat_name is not None:
+        n_all = len(jax.devices())
+        if n_all <= 1:
+            if strategy is not None:
+                raise SystemExit(
+                    f"--strategy {strat_name} needs more than one "
+                    "device; off-chip set XLA_FLAGS="
+                    "--xla_force_host_platform_device_count=8 (the "
+                    "MULTICHIP dryrun recipe)")
+            strat_name = None  # deprecated alias: historical no-op
+        else:
+            _common.check_strategy_dispatch(inner_steps, "--innerSteps")
+            if (strat_name == "sp"
+                    and not model_name.startswith("transformer_lm")):
+                # usage error regardless of the jax build — report it
+                # before the capability guard below
+                raise SystemExit(
+                    "--strategy sp shards the sequence axis via ring "
+                    "attention; it needs a transformer_lm* model")
+            if strat_name in ("sp", "pp") and not hasattr(jax,
+                                                          "shard_map"):
+                # ring attention / the pipeline schedule run inside
+                # jax.shard_map (the API the MULTICHIP dryruns
+                # validate); older jax only ships the experimental
+                # spelling with different kwargs — refuse cleanly
+                # instead of crashing mid-build
+                raise SystemExit(
+                    f"--strategy {strat_name} needs jax.shard_map; "
+                    f"this jax ({jax.__version__}) predates it — "
+                    "dp/tp/ep still run")
+            mesh_axes = _common.strategy_mesh_axes(strat_name, n_all,
+                                                   strat_k)
+            from bigdl_tpu.parallel import make_mesh
+            mesh = make_mesh(mesh_axes)
+            data_ax = mesh_axes.get("data", 1)
+            if batch % data_ax:
+                raise SystemExit(
+                    f"batch {batch} must be divisible by the data axis "
+                    f"({data_ax}) of --strategy {strat_name} "
+                    f"(mesh {mesh_axes})")
 
     # conv-layout decision for this device AND run configuration. The
     # window-2 combination matrix (PERF.md §8.2) measured the shipped
@@ -348,10 +542,9 @@ def _run_timed(model_name: str, batch: int, iterations: int, data_type: str,
     # 2,674) — so those configurations resolve their own autotune keys
     # (default all-NHWC until measured) instead of skipping installation
     # and inheriting whatever an earlier run left behind. inner_steps is
-    # normalized to 1 further down for data_source/strategy runs —
-    # mirror that here so those (plain-dispatch) runs still get the
-    # decision
-    _eff_inner = (1 if (data_source is not None or data_parallel)
+    # normalized to 1 further down for data_source runs — mirror that
+    # here so those (plain-dispatch) runs still get the decision
+    _eff_inner = (1 if (data_source is not None or strat_name is not None)
                   else inner_steps)
     from bigdl_tpu import tuning
     tuning.install_conv_layouts(
@@ -361,84 +554,130 @@ def _run_timed(model_name: str, batch: int, iterations: int, data_type: str,
     from bigdl_tpu import nn
     from bigdl_tpu.optim import SGD
 
-    model, in_shape = build_model(model_name)
-    from bigdl_tpu.cli.common import apply_fused_bn
-    apply_fused_bn(model, fused_bn)
-    is_lm = model_name.startswith("transformer_lm")
-    crit = (nn.TimeDistributedCriterion(nn.ClassNLLCriterion()) if is_lm
-            else nn.ClassNLLCriterion())
-    opt = SGD(learning_rate=0.01, momentum=0.9)
-
     on_tpu = jax.default_backend() == "tpu"
     dtype = jnp.bfloat16 if (use_bf16 and on_tpu) else jnp.float32
 
-    rng = np.random.RandomState(0)
-    if is_lm:  # token ids in, per-token targets
-        if dtype == jnp.bfloat16:
-            model.compute_dtype = dtype  # cast lives after the embedding
-        x_host = rng.randint(0, _LM_VOCAB,
-                             (batch, *in_shape)).astype(np.int32)
-        y_host = rng.randint(0, _LM_VOCAB,
-                             (batch, *in_shape)).astype(np.int32)
-    elif data_type == "constant":
-        x_host = np.ones((batch, *in_shape), np.float32)
-        y_host = rng.randint(0, 1000 if in_shape[0] > 30 else 10,
-                             batch).astype(np.int32)
+    if strat_name in ("pp", "ep"):
+        # pipeline/expert parallelism compose with the STEP structure,
+        # not just the placement — dedicated harness builders below
+        setup = _setup_strategy_harness(strat_name, model_name, batch,
+                                        mesh, mesh_axes, dtype, seq_len)
+        model, in_shape, is_lm = None, setup["in_shape"], False
+        params, mod_state, opt_state = (setup["params"], {},
+                                        setup["opt_state"])
+        x, y = setup["x"], setup["y"]
+        step, single_step = setup["step"], setup["single_step"]
+        strat = None
     else:
-        x_host = rng.randn(batch, *in_shape).astype(np.float32)
-        y_host = rng.randint(0, 1000 if in_shape[0] > 30 else 10,
-                             batch).astype(np.int32)
+        lm_attn = None
+        if strat_name == "sp":
+            if not model_name.startswith("transformer_lm"):
+                raise SystemExit(
+                    "--strategy sp shards the sequence axis via ring "
+                    "attention; it needs a transformer_lm* model")
+            from bigdl_tpu.parallel import make_ring_attention
+            lm_attn = make_ring_attention(mesh, "seq", batch_axis="data")
 
-    params = model.init(jax.random.PRNGKey(0))
-    mod_state = model.init_state()
-    opt_state = opt.init(params)
+        model, in_shape = build_model(model_name, seq_len=seq_len,
+                                      lm_attn_impl=lm_attn)
+        _common.apply_fused_bn(model, fused_bn)
+        is_lm = model_name.startswith("transformer_lm")
+        if strat_name == "sp" and in_shape[0] % mesh_axes["seq"]:
+            raise SystemExit(
+                f"--strategy sp: sequence length {in_shape[0]} must be "
+                f"divisible by the seq axis ({mesh_axes['seq']}); "
+                "shrink/resize with --seq")
+        crit = (nn.TimeDistributedCriterion(nn.ClassNLLCriterion())
+                if is_lm else nn.ClassNLLCriterion())
+        opt = SGD(learning_rate=0.01, momentum=0.9)
 
-    strategy = None
-    if data_parallel and len(jax.devices()) > 1:
-        from bigdl_tpu.parallel import DataParallel, make_mesh
+        rng = np.random.RandomState(0)
+        if is_lm:  # token ids in, per-token targets
+            if dtype == jnp.bfloat16:
+                model.compute_dtype = dtype  # cast lives after the
+                # embedding
+            x_host = rng.randint(0, _LM_VOCAB,
+                                 (batch, *in_shape)).astype(np.int32)
+            y_host = rng.randint(0, _LM_VOCAB,
+                                 (batch, *in_shape)).astype(np.int32)
+        elif data_type == "constant":
+            x_host = np.ones((batch, *in_shape), np.float32)
+            y_host = rng.randint(0, 1000 if in_shape[0] > 30 else 10,
+                                 batch).astype(np.int32)
+        else:
+            x_host = rng.randn(batch, *in_shape).astype(np.float32)
+            y_host = rng.randint(0, 1000 if in_shape[0] > 30 else 10,
+                                 batch).astype(np.int32)
 
-        strategy = DataParallel(make_mesh({"data": len(jax.devices())}))
-        params, mod_state, opt_state = strategy.place(
-            params, mod_state, opt_state)
+        params = model.init(jax.random.PRNGKey(0))
+        mod_state = model.init_state()
+        opt_state = opt.init(params)
 
-    def train_step(params, mod_state, opt_state, x, y, rng):
-        def loss_fn(p):
-            xc = x.astype(dtype) if jnp.issubdtype(x.dtype,
-                                                   jnp.floating) else x
-            out, ms = model.apply(p, mod_state, xc, training=True, rng=rng)
-            return crit(out.astype(jnp.float32), y), ms
+        strat = None
+        if strat_name == "dp" or strat_name == "sp":
+            from bigdl_tpu.parallel import DataParallel
 
-        (loss, ms), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
-        if strategy is not None:
-            grads, loss = strategy.reduce_grads(grads, loss)
-        new_p, new_o = opt.update(grads, opt_state, params)
-        return new_p, ms, new_o, loss
+            strat = DataParallel(mesh)
+        elif strat_name == "tp":
+            from bigdl_tpu.parallel import TensorParallel
 
-    single_step = train_step  # FLOPs are counted per single step below
+            strat = TensorParallel(mesh, model)
+        if strat is not None:
+            params, mod_state, opt_state = strat.place(
+                params, mod_state, opt_state)
 
-    if strategy is not None:
-        step = strategy.compile_step(train_step)
-        x, y = strategy.shard_batch(x_host, y_host)
-        inner_steps = 1
-    else:
-        if data_source is not None:
-            inner_steps = 1  # fresh host batch every step by definition
-        if inner_steps > 1:
-            # amortize per-dispatch overhead (measured ~2.5-3.5ms through
-            # the tunneled runtime) by chaining steps inside one program;
-            # same resident batch, per-step folded rng — the pure-compute
-            # meter the reference's LocalOptimizerPerf is
-            def train_step(params, mod_state, opt_state, x, y, rng):  # noqa: F811
-                def body(i, c):
-                    p, ms, o, _ = c
-                    return single_step(p, ms, o, x, y,
-                                       jax.random.fold_in(rng, i))
-                init = (params, mod_state, opt_state,
-                        jnp.zeros((), jnp.float32))
-                return jax.lax.fori_loop(0, inner_steps, body, init)
+        def train_step(params, mod_state, opt_state, x, y, rng):
+            def loss_fn(p):
+                xc = x.astype(dtype) if jnp.issubdtype(x.dtype,
+                                                       jnp.floating) else x
+                out, ms = model.apply(p, mod_state, xc, training=True,
+                                      rng=rng)
+                return crit(out.astype(jnp.float32), y), ms
 
-        step = jax.jit(train_step, donate_argnums=(0, 1, 2))
-        x, y = jnp.asarray(x_host), jnp.asarray(y_host)
+            (loss, ms), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            if strat is not None:
+                grads, loss = strat.reduce_grads(grads, loss)
+            new_p, new_o = opt.update(grads, opt_state, params)
+            return new_p, ms, new_o, loss
+
+        single_step = train_step  # FLOPs are counted per single step
+
+        if strat is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            if strat_name == "sp":
+                # token ids sharded batch x seq so ring attention's
+                # shard_map sees its home layout without a reshard
+                spec = P("data", "seq")
+                step = strat.compile_step(train_step, batch_spec=spec)
+                sh = NamedSharding(mesh, spec)
+                x = jax.device_put(jnp.asarray(x_host), sh)
+                y = jax.device_put(jnp.asarray(y_host), sh)
+            else:
+                step = strat.compile_step(train_step)
+                x, y = strat.shard_batch(x_host, y_host)
+            inner_steps = 1
+        else:
+            if data_source is not None:
+                inner_steps = 1  # fresh host batch every step by
+                # definition
+            if inner_steps > 1:
+                # amortize per-dispatch overhead (measured ~2.5-3.5ms
+                # through the tunneled runtime) by chaining steps inside
+                # one program; same resident batch, per-step folded rng
+                # — the pure-compute meter the reference's
+                # LocalOptimizerPerf is
+                def train_step(params, mod_state, opt_state, x, y, rng):  # noqa: F811
+                    def body(i, c):
+                        p, ms, o, _ = c
+                        return single_step(p, ms, o, x, y,
+                                           jax.random.fold_in(rng, i))
+                    init = (params, mod_state, opt_state,
+                            jnp.zeros((), jnp.float32))
+                    return jax.lax.fori_loop(0, inner_steps, body, init)
+
+            step = jax.jit(train_step, donate_argnums=(0, 1, 2))
+            x, y = jnp.asarray(x_host), jnp.asarray(y_host)
 
     k = jax.random.PRNGKey(1)
     # Two independent FLOPs estimates for the MFU numerator:
@@ -448,14 +687,18 @@ def _run_timed(model_name: str, batch: int, iterations: int, data_type: str,
     # MFU is reported from the analytic number; both appear in the JSON
     # and a >2x disagreement is flagged rather than silently trusted.
     flops_analytic, flops_error = 0.0, None
+    flops_kinds = {"matmul": 0.0, "conv": 0.0}
     try:
-        from bigdl_tpu.utils.flops import fn_flops
+        from bigdl_tpu.utils.flops import fn_flops_by_kind
 
-        flops_analytic = fn_flops(single_step, params, mod_state,
-                                  opt_state, x, y, k)
+        flops_kinds = fn_flops_by_kind(single_step, params, mod_state,
+                                       opt_state, x, y, k)
+        flops_analytic = flops_kinds["matmul"] + flops_kinds["conv"]
     except Exception as e:  # record, never hide — the basis field (below)
         flops_error = f"{type(e).__name__}: {e}"[:200]
     flops_hlo = 0.0
+    n_dev = (int(np.prod(list(mesh_axes.values())))
+             if mesh_axes is not None else 1)
     try:
         compiled = step.lower(params, mod_state, opt_state, x, y, k).compile()
         if inner_steps == 1:  # multi-step: while-body cost attribution is
@@ -466,14 +709,28 @@ def _run_timed(model_name: str, batch: int, iterations: int, data_type: str,
             flops_hlo = float(cost.get("flops", 0.0) or 0.0)
             # under SPMD cost_analysis reports the per-device partitioned
             # module; scale to global so both numerators share a basis
-            if strategy is not None:
-                flops_hlo *= len(jax.devices())
+            if strat_name is not None:
+                flops_hlo *= n_dev
         step = compiled
     except Exception:
         pass
     step_flops = flops_analytic or flops_hlo
     mfu_basis = ("analytic" if flops_analytic
                  else ("hlo" if flops_hlo else None))
+
+    peak_per_chip, peak_label = _peak_flops(jax.devices()[0])
+    peak = peak_per_chip * n_dev
+    if obs_state is not None and obs_state.capture is not None:
+        # attribution context (ISSUE 8): every capture window this run
+        # closes gets the run's own FLOPs numerator and mesh peak, so
+        # the post-capture attribution can decompose MFU instead of
+        # reporting bare times
+        cap = obs_state.capture
+        if step_flops:
+            cap.step_flops = step_flops * inner_steps
+            cap.flops_by_kind = {kk: v * inner_steps
+                                 for kk, v in flops_kinds.items()}
+        cap.peak_flops = peak
 
     params, mod_state, opt_state, loss = step(params, mod_state, opt_state,
                                               x, y, k)
@@ -569,15 +826,18 @@ def _run_timed(model_name: str, batch: int, iterations: int, data_type: str,
 
     total_steps = iterations * inner_steps
     ips = batch * total_steps / dt
-    n_dev = len(jax.devices()) if strategy is not None else 1
-    peak_per_chip, peak_label = _peak_flops(jax.devices()[0])
-    peak = peak_per_chip * n_dev
     mfu = (step_flops * total_steps / dt) / peak if step_flops else None
     out = {
         "model": model_name,
         "batch": batch,
         "iterations": iterations,
         "inner_steps": inner_steps,
+        # ISSUE 8: strategy + mesh topology in EVERY line — a multichip
+        # row must say which axes its collectives rode (null/1/null on
+        # a single-device run, schema stable)
+        "strategy": strat_name,
+        "n_devices": n_dev,
+        "mesh": mesh_axes,
         "seconds": round(dt, 4),
         "records_per_second": round(ips, 2),
         "images_per_second_per_chip": round(ips / n_dev, 2),
@@ -590,13 +850,24 @@ def _run_timed(model_name: str, batch: int, iterations: int, data_type: str,
         "peak_flops_assumed": peak_per_chip,
         "peak_flops_device_match": peak_label,
         "step_gflops_analytic": round(flops_analytic / 1e9, 3),
+        # the matmul/conv split of the analytic numerator — what the
+        # attribution engine joins category times against (ISSUE 8)
+        "step_gflops_by_kind": {
+            "matmul": round(flops_kinds["matmul"] / 1e9, 3),
+            "conv": round(flops_kinds["conv"] / 1e9, 3)},
         "step_gflops_hlo": round(flops_hlo / 1e9, 3),
+        # loss parity anchor: a --strategy run must land where the
+        # single-device run lands (the DistriOptimizerSpec bar)
+        "final_loss": round(float(loss), 6),
         "device": getattr(jax.devices()[0], "device_kind", "unknown"),
     }
     _annotate_obs_phases(out, obs_state, phase, dt)
     _annotate_conv_layouts(out)
     _annotate_autotune(out)
-    _annotate_bn_fused(out, model)
+    if model is not None:
+        _annotate_bn_fused(out, model)
+    else:
+        out["bn_fused"] = "off"  # pp/ep harnesses carry no BN
     if lint is not None:  # --lint pre-flight summary rides in the JSON
         out["lint"] = lint  # line like bn_fused/autotune decisions do
     _annotate_supervisor(out, supervisor)
@@ -841,7 +1112,12 @@ def main(argv=None):
                    default="constant")
     p.add_argument("--f32", action="store_true",
                    help="disable bf16 compute")
-    p.add_argument("--dataParallel", action="store_true")
+    p.add_argument("--dataParallel", action="store_true",
+                   help="deprecated alias for --strategy dp")
+    p.add_argument("--seq", type=int, default=None,
+                   help="override the transformer_lm* sequence length "
+                        "(mirrors lint's --seq; shrinks CPU --strategy "
+                        "smokes to seconds)")
     p.add_argument("--data", default=None,
                    help="feed from storage instead of a resident batch, "
                         "e.g. record:/path/to/shards (timed loop then "
@@ -912,8 +1188,10 @@ def main(argv=None):
     from bigdl_tpu.cli.common import (_add_platform_arg, add_autotune_arg,
                                       add_fused_bn_arg, add_lint_arg,
                                       add_obs_args, add_resilience_args,
-                                      apply_platform, run_preflight_lint)
+                                      add_strategy_arg, apply_platform,
+                                      run_preflight_lint)
     _add_platform_arg(p)
+    add_strategy_arg(p)
     add_autotune_arg(p)
     add_fused_bn_arg(p)
     add_lint_arg(p)
@@ -946,6 +1224,12 @@ def main(argv=None):
 
     def _go(supervisor=None):
         if args.timeToAcc is not None:
+            if args.strategy and args.strategy != "dp":
+                raise SystemExit(
+                    "--timeToAcc trains through the Optimizer, which is "
+                    "data-parallel by construction — --strategy only "
+                    "composes with the throughput loop (dp is implied "
+                    "here)")
             data_dir = None
             if args.data and args.data.startswith("record:"):
                 data_dir = args.data[len("record:"):]
@@ -967,7 +1251,8 @@ def main(argv=None):
             use_bf16=not args.f32, data_parallel=args.dataParallel,
             data_source=args.data, inner_steps=args.innerSteps,
             profile_dir=args.profile, fused_bn=args.fusedBN,
-            lint=lint_ann, supervisor=supervisor, obs_state=obs_state)
+            lint=lint_ann, supervisor=supervisor, obs_state=obs_state,
+            strategy=args.strategy, seq_len=args.seq)
 
     if args.supervise is not None:
         # supervised perf: transient injected faults retry with backoff

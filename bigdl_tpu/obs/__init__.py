@@ -29,6 +29,9 @@ columns (``data_wait_s``, ``h2d_s``, ``dispatch_s``, ``device_s``,
 broken out) and 4 (feed-stall metering) read from this layer.
 """
 
+from bigdl_tpu.obs import attrib
+from bigdl_tpu.obs.attrib import (ATTRIB_CATEGORIES, attribute,
+                                  attribute_profile, classify_op)
 from bigdl_tpu.obs.capture import (CaptureController, parse_trace_steps,
                                    TOUCH_FILE_NAME)
 from bigdl_tpu.obs.http import MetricsServer, start_metrics_server
@@ -41,6 +44,8 @@ from bigdl_tpu.obs.spans import (NOOP_SPAN, Tracer, disable, enable,
                                  enabled, get_tracer, set_tracer, span)
 
 __all__ = [
+    "attrib", "ATTRIB_CATEGORIES", "attribute", "attribute_profile",
+    "classify_op",
     "CaptureController", "parse_trace_steps", "TOUCH_FILE_NAME",
     "MetricsServer", "start_metrics_server",
     "Counter", "Gauge", "Histogram", "MetricsRegistry",

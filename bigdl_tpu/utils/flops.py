@@ -93,6 +93,59 @@ def _sub_jaxprs(params):
                     yield w
 
 
+def jaxpr_flops_by_kind(jaxpr) -> dict:
+    """Like :func:`jaxpr_flops` but split by primitive family:
+    ``{"matmul": f, "conv": f}``. ``dot_general`` (and Pallas kernels
+    with an author-declared CostEstimate — their declared FLOPs are MXU
+    dot FLOPs by construction, PERF.md §5) count as matmul;
+    ``conv_general_dilated`` as conv. The attribution engine
+    (``obs/attrib.py``) joins these against the profiled matmul/conv
+    category times to get per-category achieved-vs-roofline utilization."""
+    if isinstance(jaxpr, jex_core.ClosedJaxpr):
+        jaxpr = jaxpr.jaxpr
+    total = {"matmul": 0.0, "conv": 0.0}
+
+    def add(dst, src, mult=1.0):
+        dst["matmul"] += mult * src["matmul"]
+        dst["conv"] += mult * src["conv"]
+
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+        f = _eqn_flops(eqn)
+        if f:
+            total["conv" if name == "conv_general_dilated"
+                  else "matmul"] += f
+        if name == "cond":
+            branches = [jaxpr_flops_by_kind(b)
+                        for b in eqn.params["branches"]]
+            if branches:
+                add(total, max(branches,
+                               key=lambda d: d["matmul"] + d["conv"]))
+            continue
+        mult = 1.0
+        if name == "scan":
+            mult = float(eqn.params.get("length", 1))
+        elif name == "pallas_call":
+            ce = eqn.params.get("cost_estimate")
+            if ce is not None and getattr(ce, "flops", 0):
+                total["matmul"] += float(ce.flops)
+                continue
+            gm = eqn.params.get("grid_mapping")
+            grid = getattr(gm, "grid", ()) or ()
+            if all(isinstance(g, int) for g in grid):
+                mult = _prod(grid) if grid else 1.0
+        for sub in _sub_jaxprs(eqn.params):
+            add(total, jaxpr_flops_by_kind(sub), mult)
+    return total
+
+
+def fn_flops_by_kind(fn, *args, **kwargs) -> dict:
+    """Matmul/conv FLOPs split of ``fn(*args, **kwargs)`` (abstract
+    trace); same recursion rules as :func:`fn_flops`."""
+    closed = jax.make_jaxpr(fn)(*args, **kwargs)
+    return jaxpr_flops_by_kind(closed)
+
+
 def jaxpr_flops(jaxpr) -> float:
     """Total matmul+conv FLOPs of one evaluation of ``jaxpr``."""
     if isinstance(jaxpr, jex_core.ClosedJaxpr):

@@ -1,0 +1,83 @@
+#!/usr/bin/env bash
+# Round-13 capture: ISSUE 8 (device-time attribution + --strategy) chip
+# evidence. The attribution loop is CPU-verified end to end
+# (tests/test_attrib.py, tests/test_strategy_perf.py, the attrib-smoke
+# CI job); what only hardware can tell us is (a) what the per-category
+# split of a REAL tuned step looks like — the §16 result slots: does
+# conv+matmul time match the §2 profile, how much rides in elementwise
+# fusions, (b) whether single-chip collective time is truly ~0 (the
+# baseline the multichip rows get compared against), and (c) the
+# per-strategy attribution A/Bs on any multi-chip slice this tunnel
+# exposes: dp vs tp vs ep with collective_s broken out per window —
+# ROADMAP item 2's "measure the all-reduce before shrinking it".
+# Appends to $OUT, mirrored into the repo per step.
+
+set -uo pipefail
+cd "$(dirname "$0")/.."
+OUT="${OUT:-/tmp/tpu_capture_r13.log}"
+REPO_LOG="${REPO_LOG:-TPU_CAPTURE_r13.log}"
+TRACE_ROOT="${TRACE_ROOT:-/tmp/attrib_r13}"
+trap 'cp -f "$OUT" "$REPO_LOG" 2>/dev/null || true' EXIT
+
+step() {
+  local name="$1" tmo="$2"; shift 2
+  echo "=== $name ($(date -u +%H:%M:%SZ))" | tee -a "$OUT"
+  timeout "$tmo" "$@" 2>&1 | tail -40 | tee -a "$OUT"
+  echo "=== end $name rc=$?" | tee -a "$OUT"
+  cp -f "$OUT" "$REPO_LOG" 2>/dev/null || true
+}
+
+# 0. the attribution/strategy tests on the bench env first
+step "pytest_attrib" 600 python -m pytest tests/test_attrib.py \
+  tests/test_strategy_perf.py tests/test_roofline.py -q
+
+# 1. single-chip attribution of the tuned flagships: capture a 4-step
+#    window mid-run, attribution lands in the JSON line (attrib +
+#    collective_s columns — expect collective_s ~0 on one chip; that
+#    number IS the baseline for the multichip A/Bs below)
+step "attrib_resnet50_fba" 1800 python -m bigdl_tpu.cli.main perf \
+  -m resnet50_fba -b 128 -i 40 --autotune cached \
+  --obs --traceDir "$TRACE_ROOT/resnet50_fba" --traceSteps 4@20
+step "attrib_lm_hd128" 1800 python -m bigdl_tpu.cli.main perf \
+  -m transformer_lm_1k_hd128 -b 8 -i 40 --autotune cached \
+  --obs --traceDir "$TRACE_ROOT/lm_hd128" --traceSteps 4@20
+
+# 2. the explain CLI over those windows (human table -> log, JSON ->
+#    artifacts) — the §16 "explain recipe" exercised on real profiles
+step "explain_resnet50" 600 python -m bigdl_tpu.cli.main explain \
+  "$TRACE_ROOT/resnet50_fba/capture_20" --steps 4
+step "explain_resnet50_json" 600 bash -c \
+  "python -m bigdl_tpu.cli.main explain \
+   '$TRACE_ROOT/resnet50_fba/capture_20' --steps 4 --json \
+   > '$TRACE_ROOT/resnet50_fba_attrib.json' && \
+   tail -c 400 '$TRACE_ROOT/resnet50_fba_attrib.json'"
+step "explain_lm" 600 python -m bigdl_tpu.cli.main explain \
+  "$TRACE_ROOT/lm_hd128/capture_20" --steps 4
+
+# 3. model-mode explain: one command from nothing to a table (runs a
+#    short profiled loop itself; numerators/peak wired automatically)
+step "explain_model_mode" 1800 python -m bigdl_tpu.cli.main explain \
+  resnet50 -b 128 -i 10
+
+# 4. per-strategy attribution A/Bs. On a single-chip tunnel these exit
+#    cleanly ("needs more than one device") and cost seconds; on a
+#    multi-chip slice each leg stamps mesh topology + per-window
+#    collective_s/collective_frac — dp's one grad all-reduce vs tp's
+#    per-layer collectives vs ep's routed dispatch is THE r13 table.
+for STRAT in dp tp ep; do
+  step "strategy_${STRAT}_resnet50" 1800 python -m bigdl_tpu.cli.main \
+    perf -m resnet50 -b 128 -i 30 --strategy "$STRAT" \
+    --obs --traceDir "$TRACE_ROOT/strat_${STRAT}" --traceSteps 4@15 \
+    || true
+done
+step "strategy_dp_lm" 1800 python -m bigdl_tpu.cli.main perf \
+  -m transformer_lm_1k_hd128 -b 8 -i 30 --strategy dp \
+  --obs --traceDir "$TRACE_ROOT/strat_dp_lm" --traceSteps 4@15 || true
+
+# 5. bench.py with the strategy plumbed through (the multichip bench
+#    row with collective_s in the line)
+step "bench_strategy_dp" 2400 python bench.py resnet50 128 20 \
+  --strategy dp
+
+# 6. summarize every JSON line in this log for PERF.md §16
+step "summarize" 300 python scripts/update_perf_from_capture.py "$OUT"

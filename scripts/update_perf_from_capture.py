@@ -42,16 +42,40 @@ def main(path: str) -> None:
     convp = [(s, r) for s, r in rows if "dgrad_tfs" in r]
 
     if perf:
+        # ISSUE 8 columns: strategy/mesh stamping + the per-step
+        # collective breakout (null until a capture window fired)
         print("### Training throughput / MFU\n")
-        print("| run | model | batch | img/s/chip | MFU % | basis | "
-              "device |")
-        print("|---|---|---|---|---|---|---|")
+        print("| run | model | strategy | devs | batch | img/s/chip "
+              "| MFU % | basis | coll ms/step | coll % | device |")
+        print("|---|---|---|---|---|---|---|---|---|---|---|")
         for s, r in perf:
-            print(f"| {s} | {r.get('model')} | {r.get('batch')} "
+            cs = r.get("collective_s")
+            cf = r.get("collective_frac")
+            print(f"| {s} | {r.get('model')} "
+                  f"| {r.get('strategy') or '-'} "
+                  f"| {r.get('n_devices', 1)} | {r.get('batch')} "
                   f"| {r.get('images_per_second_per_chip')} "
                   f"| {r.get('mfu_pct')} | {r.get('mfu_basis')} "
+                  f"| {round(cs * 1e3, 3) if cs is not None else '-'} "
+                  f"| {round(cf * 100, 2) if cf is not None else '-'} "
                   f"| {r.get('device')} |")
         print()
+        attribbed = [(s, r) for s, r in perf if r.get("attrib")]
+        if attribbed:
+            print("### Device-time attribution (per capture window)\n")
+            print("| run | model | category | time_s | frac % |")
+            print("|---|---|---|---|---|")
+            for s, r in attribbed:
+                a = r["attrib"]
+                for cat, d in a.get("categories", {}).items():
+                    print(f"| {s} | {r.get('model')} | {cat} "
+                          f"| {d.get('s')} "
+                          f"| {round(d.get('frac', 0) * 100, 2)} |")
+                for kind, d in a.get("collectives", {}).items():
+                    print(f"| {s} | {r.get('model')} | coll:{kind} "
+                          f"| {d.get('s')} "
+                          f"| {round(d.get('frac', 0) * 100, 2)} |")
+            print()
     if flash:
         print("### Flash vs dense attention (causal bf16)\n")
         print("| seq | impl | fwd ms | fwd+bwd ms | fwd TF/s | "

@@ -1,0 +1,209 @@
+"""ISSUE 8: device-time attribution — category regexes, the
+``collectives(planes)`` helper, the flops/roofline join, compact/publish
+surfaces, and the explain CLI, all against hand-built XSpace wire-format
+blobs (the same bytes ``jax.profiler.trace`` writes — no chip needed).
+Wire-format encoders are shared with tests/test_roofline.py."""
+
+import json
+
+import pytest
+
+from bigdl_tpu.obs import attrib
+from bigdl_tpu.utils import xplane
+from test_roofline import _ld, _vf, _xspace
+
+
+# ------------------------------------------------------------ fixtures
+def _write_profile(tmp_path, blobs, name="prof"):
+    d = tmp_path / name / "plugins" / "profile" / "run1"
+    d.mkdir(parents=True)
+    (d / "host.xplane.pb").write_bytes(b"".join(blobs))
+    return str(tmp_path / name)
+
+
+@pytest.fixture
+def mixed_profile(tmp_path):
+    """A device plane with one op per category family — all-reduce /
+    reduce-scatter collectives, a conv, a dot, fusions, an infeed — plus
+    a host plane that must be excluded (the satellite-#1 fixture)."""
+    dev = _xspace("/device:TPU:0 (xla)", [
+        (1, "fusion.12", 5_000_000_000, 1),           # elementwise
+        (2, "convolution.3", 2_000_000_000, 1),       # conv
+        (3, "all-reduce-start.1", 800_000_000, 1),    # collective
+        (4, "reduce-scatter.2", 200_000_000, 1),      # collective
+        (5, "infeed.2", 500_000_000, 1),              # infeed
+        (6, "dot.7", 250_000_000, 1),                 # matmul
+        (7, "jit_step/batch_norm_stats", 100_000_000, 1),  # bn_norm
+        (8, "mystery_op.1", 50_000_000, 1),           # host_other
+    ])
+    host = _xspace("/host:CPU", [(1, "python", 9_000_000_000, 1)])
+    return _write_profile(tmp_path, [dev, host])
+
+
+# ----------------------------------------------------------- classify
+def test_collective_kind_patterns():
+    assert xplane.collective_kind("all-reduce.3") == "all_reduce"
+    assert xplane.collective_kind("all-reduce-start.1") == "all_reduce"
+    assert xplane.collective_kind("psum") == "all_reduce"
+    assert xplane.collective_kind("all-gather.2") == "all_gather"
+    assert xplane.collective_kind("reduce-scatter.9") == "reduce_scatter"
+    assert xplane.collective_kind("all-to-all.1") == "all_to_all"
+    assert (xplane.collective_kind("collective-permute-start.4")
+            == "collective_permute")
+    # NOT collectives: plain reduce/gather/scatter data ops
+    assert xplane.collective_kind("reduce.5") is None
+    assert xplane.collective_kind("gather.3") is None
+    assert xplane.collective_kind("scatter.1") is None
+
+
+def test_classify_categories():
+    cases = {
+        "convolution.4": "conv",
+        "conv_general_dilated": "conv",
+        "convert_element_type.9": "elementwise",  # NOT conv
+        "dot.3": "matmul",
+        "dot_general": "matmul",
+        "fusion.128": "elementwise",
+        "loop_add_fusion.2": "elementwise",
+        "infeed.1": "infeed",
+        "outfeed.1": "infeed",
+        "jit_train_step/batch_norm_training": "bn_norm",
+        "layer_norm.2": "bn_norm",
+        "flash_fwd_kernel": "attention",
+        "softmax.1": "attention",
+        "all-gather.7": "collective",
+        "totally-unknown-op": "host_other",
+    }
+    for name, want in cases.items():
+        cat, _ = attrib.classify_op(name)
+        assert cat == want, (name, cat, want)
+    assert attrib.classify_op("reduce-scatter.1") == ("collective",
+                                                     "reduce_scatter")
+
+
+# -------------------------------------------------------- collectives()
+def test_collectives_helper(mixed_profile, tmp_path):
+    planes = xplane.parse_xspace(xplane.find_xplane_pb(mixed_profile))
+    colls = xplane.collectives(xplane.device_planes(planes))
+    assert set(colls) == {"all_reduce", "reduce_scatter"}
+    assert colls["all_reduce"]["total_ps"] == 800_000_000
+    assert colls["reduce_scatter"]["count"] == 1
+    # a collective-free profile reports an EMPTY dict, not zeros
+    dev_only = _xspace("/device:TPU:0", [(1, "fusion.1", 1000, 1)])
+    p2 = _write_profile(tmp_path, [dev_only], name="nocoll")
+    planes2 = xplane.parse_xspace(xplane.find_xplane_pb(p2))
+    assert xplane.collectives(planes2) == {}
+    assert xplane.collectives([]) == {}
+
+
+# ---------------------------------------------------------- attribute()
+def test_attribute_sums_and_collective_breakout(mixed_profile):
+    planes = xplane.parse_xspace(xplane.find_xplane_pb(mixed_profile))
+    out = attrib.attribute(planes, steps=2)
+    total = out["total_device_s"]
+    # acceptance: category times sum to (within fp) the total device time
+    s = sum(d["time_s"] for d in out["categories"].values())
+    assert s == pytest.approx(total, rel=1e-9)
+    assert total == pytest.approx(8.9e-3, rel=1e-6)  # 8.9e9 ps
+    # the host plane was excluded
+    assert out["device_planes"] == 1
+    # collective breakout
+    assert out["collective_s"] == pytest.approx(1.0e-3)
+    assert out["collective_frac"] == pytest.approx(1.0 / 8.9, rel=1e-3)
+    assert out["collectives"]["all_reduce"]["time_s"] == \
+        pytest.approx(0.8e-3)
+    assert out["per_step_ms"]["collective"] == pytest.approx(0.5)
+    # every taxonomy category is present (zeros included)
+    assert set(out["categories"]) == set(attrib.CATEGORIES)
+    assert out["categories"]["host_other"]["time_s"] == \
+        pytest.approx(5e-5)
+
+
+def test_attribute_flops_join(mixed_profile):
+    planes = xplane.parse_xspace(xplane.find_xplane_pb(mixed_profile))
+    out = attrib.attribute(planes, steps=2, step_flops=1e9,
+                           flops_by_kind={"matmul": 2.5e8, "conv": 7.5e8},
+                           peak_flops=1e12)
+    cats = out["categories"]
+    assert cats["matmul"]["flop_share"] == pytest.approx(0.25)
+    assert cats["conv"]["flop_share"] == pytest.approx(0.75)
+    # conv: 1.5e9 flops over 2e-3 s = 0.75 TF/s on a 1 TF/s peak
+    assert cats["conv"]["achieved_tflops"] == pytest.approx(0.75)
+    assert cats["conv"]["roofline_util"] == pytest.approx(0.75)
+    mfu = out["mfu"]
+    assert mfu["compute_s"] == pytest.approx(2.25e-3)
+    assert mfu["compute_frac"] == pytest.approx(2.25 / 8.9, rel=1e-3)
+    # mfu_device = compute_frac x compute_util (the decomposition)
+    assert mfu["mfu_device"] == pytest.approx(
+        mfu["compute_frac"] * mfu["compute_util"], rel=1e-6)
+
+
+def test_attribute_host_only_fallback(tmp_path):
+    """A CPU capture with no accelerator plane still attributes (the
+    'non-empty categories' CI contract) instead of reporting nothing."""
+    host = _xspace("/host:CPU", [(1, "python_call.1", 2_000_000, 1),
+                                 (2, "dot.1", 1_000_000, 1)])
+    planes = xplane.parse_xspace(
+        xplane.find_xplane_pb(_write_profile(tmp_path, [host])))
+    out = attrib.attribute(planes)
+    assert out["total_device_s"] > 0
+    assert out["categories"]["matmul"]["time_s"] > 0
+
+
+# ------------------------------------------------- compact / publish
+def test_compact_and_publish(mixed_profile):
+    from bigdl_tpu.obs.metrics import MetricsRegistry
+
+    planes = xplane.parse_xspace(xplane.find_xplane_pb(mixed_profile))
+    out = attrib.attribute(planes, steps=2, step_flops=1e9,
+                           peak_flops=1e12)
+    c = attrib.compact(out)
+    assert c["steps"] == 2
+    assert c["collective_s"] == pytest.approx(1.0e-3)
+    assert c["collective_frac"] == pytest.approx(0.1124, abs=1e-4)
+    assert "conv" in c["categories"] and "s" in c["categories"]["conv"]
+    json.dumps(c)  # must be JSON-ready as stamped into perf lines
+
+    reg = MetricsRegistry(namespace="t")
+    attrib.publish(out, reg)
+    page = reg.render()
+    assert "t_attrib_collective_all_reduce_seconds" in page
+    assert "t_attrib_conv_seconds" in page
+    assert "t_attrib_total_device_seconds" in page
+    assert "t_attrib_mfu_device" in page
+
+
+def test_render_table(mixed_profile):
+    planes = xplane.parse_xspace(xplane.find_xplane_pb(mixed_profile))
+    text = attrib.render(attrib.attribute(planes, steps=2))
+    assert "collective breakout:" in text
+    assert "all_reduce" in text and "reduce_scatter" in text
+    for cat in attrib.CATEGORIES:
+        assert cat in text  # zero rows stay visible
+
+
+# ------------------------------------------------------- explain CLI
+def test_explain_cli_json_and_table(mixed_profile, capsys):
+    from bigdl_tpu.cli import explain
+
+    rc = explain.main([mixed_profile, "--json", "--steps", "2",
+                       "--gflops", "1.0", "--peak", "1e12"])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["categories"] and out["collectives"]
+    assert out["collective_s"] == pytest.approx(1.0e-3)
+    assert out["xplane"].endswith(".xplane.pb")
+
+    rc = explain.main([mixed_profile, "--steps", "2"])
+    assert rc == 0
+    text = capsys.readouterr().out
+    assert "category" in text and "collective breakout:" in text
+
+
+def test_explain_cli_missing_profile(tmp_path):
+    from bigdl_tpu.cli import explain
+
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    with pytest.raises(SystemExit, match="xplane"):
+        explain.main([str(empty)])
