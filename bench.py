@@ -25,6 +25,10 @@ JSON line):
 
 Usage: python bench.py [model] [batch] [iters] — model per cli/perf.py
 (resnet50, transformer_lm, inception_v1/v2, vgg16/19, alexnet, lenet5).
+``--strategy NAME[:K]`` (or BENCH_STRATEGY) runs the headline config
+multi-device; ``--gradCompress MODE`` / ``--gradBuckets auto|N`` (or
+BENCH_GRADCOMPRESS / BENCH_GRADBUCKETS) compress+bucket its gradient
+all-reduce (ISSUE 10) and stamp the matching columns into the line.
 """
 
 import json
@@ -51,7 +55,8 @@ PARTIAL_LOG = os.environ.get(
 
 def child(backend: str, model: str, batch: int, iters: int,
           inner: int = 1, autotune: str = "off",
-          strategy: str = "") -> None:
+          strategy: str = "", grad_compress: str = "",
+          grad_buckets: str = "") -> None:
     """Run one benchmark and print the perf dict as a JSON line."""
     if strategy and backend == "cpu":
         # a multi-device strategy on the CPU fallback needs the virtual
@@ -118,7 +123,9 @@ def child(backend: str, model: str, batch: int, iters: int,
 
     out = perf.run(model, batch, iters, "random", use_bf16=True,
                    data_source=data_source, inner_steps=inner,
-                   autotune=autotune, strategy=strategy or None)
+                   autotune=autotune, strategy=strategy or None,
+                   grad_compress=grad_compress or None,
+                   grad_buckets=grad_buckets or None)
     if data_source is not None:
         out["model"] += "_pipe"
         out["data_source"] = "record-shards (generated, ~120KB JPEGs)"
@@ -128,10 +135,12 @@ def child(backend: str, model: str, batch: int, iters: int,
 
 def _attempt(backend: str, model: str, batch: int, iters: int,
              timeout: int, inner: int = 1, autotune: str = "off",
-             strategy: str = ""):
+             strategy: str = "", grad_compress: str = "",
+             grad_buckets: str = ""):
     """Spawn the child benchmark; return (result_dict | None, error | None)."""
     cmd = [sys.executable, os.path.abspath(__file__), "--child", backend,
-           model, str(batch), str(iters), str(inner), autotune, strategy]
+           model, str(batch), str(iters), str(inner), autotune, strategy,
+           grad_compress, grad_buckets]
     try:
         proc = subprocess.run(
             cmd, capture_output=True, text=True, timeout=timeout,
@@ -233,10 +242,12 @@ def _build_line(model, result, companions, errors):
         if "flops_disagreement" in result:
             line["flops_disagreement"] = result["flops_disagreement"]
         # ISSUE 8: a multichip row says which mesh its collectives rode,
-        # and carries the per-step collective time when a capture fired
+        # and carries the per-step collective time when a capture fired;
+        # ISSUE 10 adds what dtype the gradient all-reduce shipped and
+        # how many buckets carried it
         if result.get("strategy") is not None:
             for k in ("strategy", "n_devices", "mesh", "collective_s",
-                      "collective_frac"):
+                      "collective_frac", "grad_compress", "grad_buckets"):
                 line[k] = result.get(k)
     if companions:
         line["companions"] = companions
@@ -260,6 +271,24 @@ def main() -> None:
             return
         strategy = argv[i + 1]
         del argv[i:i + 2]
+    # --gradCompress MODE (or BENCH_GRADCOMPRESS) / --gradBuckets auto|N:
+    # compress the strategy run's gradient all-reduce (ISSUE 10) — rides
+    # the same child plumbing as --strategy and stamps grad_compress /
+    # grad_buckets columns into the line
+    grad_compress = os.environ.get("BENCH_GRADCOMPRESS", "")
+    grad_buckets = os.environ.get("BENCH_GRADBUCKETS", "")
+    for flag, var in (("--gradCompress", "grad_compress"),
+                      ("--gradBuckets", "grad_buckets")):
+        if flag in argv:
+            i = argv.index(flag)
+            if i + 1 >= len(argv):
+                print(json.dumps({"error": f"{flag} needs a value"}))
+                return
+            if var == "grad_compress":
+                grad_compress = argv[i + 1]
+            else:
+                grad_buckets = argv[i + 1]
+            del argv[i:i + 2]
     model = argv[0] if len(argv) > 0 else "resnet50"
     batch = int(argv[1]) if len(argv) > 1 else 128
     iters = int(argv[2]) if len(argv) > 2 else 20
@@ -313,7 +342,9 @@ def main() -> None:
         pass
     if tpu_up:
         result, err = _attempt("default", model, batch, iters, TPU_TIMEOUT,
-                               strategy=strategy)
+                               strategy=strategy,
+                               grad_compress=grad_compress,
+                               grad_buckets=grad_buckets)
         if err:
             errors.append(err)
         if result is not None and result.get("backend") == "tpu":
@@ -418,7 +449,9 @@ def main() -> None:
         # still divides it)
         result, err = _attempt("cpu", model,
                                min(batch, 16 if strategy else 4), 2,
-                               CPU_TIMEOUT, strategy=strategy)
+                               CPU_TIMEOUT, strategy=strategy,
+                               grad_compress=grad_compress,
+                               grad_buckets=grad_buckets)
         if err:
             errors.append(err)
 
@@ -431,6 +464,8 @@ if __name__ == "__main__":
         child(sys.argv[2], sys.argv[3], int(sys.argv[4]), int(sys.argv[5]),
               int(sys.argv[6]) if len(sys.argv) > 6 else 1,
               sys.argv[7] if len(sys.argv) > 7 else "off",
-              sys.argv[8] if len(sys.argv) > 8 else "")
+              sys.argv[8] if len(sys.argv) > 8 else "",
+              sys.argv[9] if len(sys.argv) > 9 else "",
+              sys.argv[10] if len(sys.argv) > 10 else "")
     else:
         main()

@@ -39,12 +39,13 @@ from bigdl_tpu.analysis.report import Finding, Report, SEVERITIES
 from bigdl_tpu.analysis.rules import (CATALOG, assert_blocks_tileable,
                                       check_block_padding,
                                       check_block_tiling, min_sublane,
-                                      run_jaxpr_rules, run_module_rules)
+                                      run_comm_rules, run_jaxpr_rules,
+                                      run_module_rules)
 
 __all__ = ["Finding", "Report", "SEVERITIES", "CATALOG",
            "check_block_tiling", "check_block_padding",
            "assert_blocks_tileable", "min_sublane",
-           "run_jaxpr_rules", "run_module_rules",
+           "run_jaxpr_rules", "run_module_rules", "run_comm_rules",
            "lint_fn", "trace_train_step", "lint_perf_model",
            "preflight_optimizer"]
 
@@ -147,11 +148,16 @@ def _bn_fallback_rule(model, closed, report: Report) -> None:
 
 def lint_perf_model(name: str, batch: int = 32, *, seq_len=None,
                     dtype=None, fused_bn=None, classes: int = 1000,
-                    trace: bool = True) -> Report:
+                    trace: bool = True, strategy=None,
+                    grad_compress=None) -> Report:
     """Full lint of one perf-zoo model (see module docstring). LMs are
     built with ``attn_impl='flash'`` forced so the TPU-projected kernels
     appear in the CPU trace; ``trace=False`` skips the jaxpr pass
-    (module rules only — used when only configuration is in question)."""
+    (module rules only — used when only configuration is in question).
+    ``strategy``/``grad_compress`` are the perf CLI's spec strings; when
+    a multi-device strategy is requested the gradient-communication
+    rules run over the abstract param tree (PERF.md §17)."""
+    import jax
     import jax.numpy as jnp
 
     from bigdl_tpu.cli.common import apply_fused_bn
@@ -167,6 +173,12 @@ def lint_perf_model(name: str, batch: int = 32, *, seq_len=None,
     report = Report()
     dtname = jnp.dtype(dtype).name
     run_module_rules(model, report, seq=seq, dtype=dtname)
+    if strategy is not None:
+        from bigdl_tpu.cli.common import parse_strategy_spec
+
+        strat_name, _ = parse_strategy_spec(strategy)
+        params = jax.eval_shape(model.init, jax.random.PRNGKey(0))
+        run_comm_rules(params, strat_name, grad_compress, report)
     if trace:
         closed = trace_train_step(model, in_shape, batch, dtype=dtype,
                                   is_lm=is_lm)
@@ -188,6 +200,28 @@ def preflight_optimizer(opt) -> Report:
     dtname = ("bfloat16" if getattr(opt, "compute_dtype", None) is not None
               else "float32")
     run_module_rules(opt.model, report, dtype=dtname)
+
+    if opt.strategy is not None:
+        try:
+            import jax
+
+            from bigdl_tpu.parallel import DataParallel, TensorParallel
+
+            if isinstance(opt.strategy, TensorParallel):
+                strat_name = "tp"
+            elif isinstance(opt.strategy, DataParallel):
+                strat_name = "dp"
+            else:
+                strat_name = None
+            cfg = getattr(opt.strategy, "grad_comm", None)
+            compress = cfg.compress if cfg is not None else None
+            params = jax.eval_shape(opt.model.init, jax.random.PRNGKey(0))
+            run_comm_rules(params, strat_name, compress, report)
+        except Exception as e:
+            report.add(Finding(
+                rule="lint-trace-error", family="meta", severity="info",
+                message=f"comm rules skipped ({type(e).__name__}: {e})",
+                hint="module-level rules still ran"))
 
     ds = opt.dataset
     feats = getattr(ds, "features", None)

@@ -46,9 +46,11 @@ from bigdl_tpu.analysis.jaxpr_walk import (aval_bytes, consumers_map,
 from bigdl_tpu.analysis.report import Finding, Report
 
 __all__ = ["CATALOG", "run_jaxpr_rules", "run_module_rules",
+           "run_comm_rules",
            "check_block_tiling", "check_block_padding",
            "assert_blocks_tileable", "min_sublane",
-           "UPCAST_MIN_BYTES", "DONATE_MIN_BYTES", "VMEM_BUDGET_BYTES"]
+           "UPCAST_MIN_BYTES", "DONATE_MIN_BYTES", "VMEM_BUDGET_BYTES",
+           "COMM_F32_MIN_BYTES", "COMM_MAX_COLLECTIVES"]
 
 # rule id -> (family, severity, one-line catalog description)
 CATALOG: Dict[str, Tuple[str, str, str]] = {
@@ -127,6 +129,16 @@ CATALOG: Dict[str, Tuple[str, str, str]] = {
         "host-sync", "error",
         "host callback inside the step — every dispatch round-trips "
         "through the host (tunneled-runtime cost: ~2.5-3.5 ms each)"),
+    "comm-f32-allreduce": (
+        "comm", "warning",
+        "multi-device strategy reduces >=1 MiB gradient buckets in f32 "
+        "with compression off — twice the wire bytes the 16-bit codec "
+        "path (--gradCompress bf16) would move"),
+    "comm-unbucketed": (
+        "comm", "warning",
+        "gradient reduction is per-leaf (>16 collectives in one step "
+        "graph / unbucketed grad tree) — per-collective launch latency "
+        "is paid per parameter instead of per dense bucket"),
     "lint-trace-error": (
         "meta", "info",
         "the step could not be traced; only module-level rules ran"),
@@ -136,6 +148,8 @@ UPCAST_MIN_BYTES = 2 * 1024 * 1024    # ignore small/scalar converts
 DONATE_MIN_BYTES = 1 * 1024 * 1024    # per-buffer floor for the HBM rule
 VMEM_BUDGET_BYTES = 16 * 1024 * 1024  # ~16 MB/core (pallas_guide.md)
 VMEM_WARN_FRAC = 0.8
+COMM_F32_MIN_BYTES = 1 * 1024 * 1024  # grad wire worth compressing
+COMM_MAX_COLLECTIVES = 16             # per-leaf-reduce smell threshold
 
 _SUBLANE = {4: 8, 2: 16, 1: 32}
 
@@ -420,6 +434,35 @@ def _rule_pallas(levels, report: Report) -> None:
                             "scratch_bytes": scratch}))
 
 
+# explicit cross-device reduction primitives (shard_map/pmap graphs —
+# jit-SPMD traces carry none; the partitioner inserts those later, which
+# is what run_comm_rules covers at the config level)
+_COLLECTIVE_PRIMS = ("psum", "ppermute", "all_gather", "all_to_all",
+                     "reduce_scatter", "psum_scatter", "pmax", "pmin")
+
+
+def _rule_collectives(levels, report: Report) -> None:
+    """Count explicit collective eqns in the step graph: more than
+    COMM_MAX_COLLECTIVES means the reduction is per-leaf — the dense-
+    bucket accumulation grad_comm does (and the reference's partitioned
+    all-reduce did) amortizes that launch latency away."""
+    hits = []
+    for lv in levels:
+        for i, eqn in enumerate(lv.jaxpr.eqns):
+            if eqn.primitive.name in _COLLECTIVE_PRIMS:
+                hits.append(lv.where(i, eqn))
+    if len(hits) > COMM_MAX_COLLECTIVES:
+        report.add(_finding(
+            "comm-unbucketed",
+            f"{len(hits)} collective op(s) in one step graph (threshold "
+            f"{COMM_MAX_COLLECTIVES}) — per-leaf reduction pays launch "
+            "latency per parameter",
+            where="; ".join(hits[:4]) + ("…" if len(hits) > 4 else ""),
+            hint="bucket the grads into dense size-bounded buffers "
+                 "(parallel/grad_comm; --gradCompress enables it)",
+            detail={"count": len(hits), "sites": hits[:16]}))
+
+
 def _rule_host_sync(levels, report: Report) -> None:
     for lv in levels:
         for i, eqn in enumerate(lv.jaxpr.eqns):
@@ -445,6 +488,58 @@ def run_jaxpr_rules(closed, report: Optional[Report] = None) -> Report:
     _rule_weak_scalar(levels, report)
     _rule_pallas(levels, report)
     _rule_host_sync(levels, report)
+    _rule_collectives(levels, report)
+    return report
+
+
+# ============================================================ comm rules
+def run_comm_rules(params, strategy: Optional[str],
+                   grad_compress: Optional[str] = None,
+                   report: Optional[Report] = None) -> Report:
+    """Gradient-communication rules over one run CONFIGURATION (ISSUE
+    10): jit-SPMD traces carry no collective eqns — the partitioner
+    inserts the grad all-reduce after lint runs — so what f32 bytes
+    would cross the wire is derived from the param tree + strategy +
+    --gradCompress instead of from the jaxpr. ``params`` may be real or
+    abstract (jax.eval_shape) leaves."""
+    report = report if report is not None else Report()
+    if strategy not in ("dp", "tp", "sp"):
+        return report  # pp/ep own their comm structure; single-device
+        # runs have no grad wire
+    compress = grad_compress or "off"
+    from bigdl_tpu.parallel.grad_comm import (DEFAULT_BUCKET_BYTES,
+                                              build_bucket_plan)
+    plan = build_bucket_plan(params, DEFAULT_BUCKET_BYTES)
+    if compress == "off":
+        big = [b for b in plan.buckets if b.nbytes >= COMM_F32_MIN_BYTES]
+        if big:
+            total = sum(b.nbytes for b in plan.buckets)
+            report.add(_finding(
+                "comm-f32-allreduce",
+                f"--strategy {strategy} all-reduces "
+                f"{total / 2**20:.1f} MiB of gradient in f32 "
+                f"({len(big)} bucket(s) >= "
+                f"{COMM_F32_MIN_BYTES / 2**20:.0f} MiB) with "
+                "compression off",
+                where=f"grad tree: {plan.n_leaves} leaves, "
+                      f"{len(plan.buckets)} bucket(s)",
+                hint="--gradCompress bf16 halves the wire bytes "
+                     "(bf16+ec keeps optimizer math exactly f32)",
+                detail={"bytes_f32": total,
+                        "big_buckets": len(big),
+                        "n_leaves": plan.n_leaves}))
+        n_inexact = plan.n_leaves - len(plan.passthrough)
+        if n_inexact > COMM_MAX_COLLECTIVES:
+            report.add(_finding(
+                "comm-unbucketed",
+                f"{n_inexact} gradient leaves reduce without bucketing "
+                f"(threshold {COMM_MAX_COLLECTIVES}) — per-leaf "
+                "collectives pay launch latency per parameter",
+                where=f"grad tree: {plan.n_leaves} leaves",
+                hint="--gradCompress bf16 packs them into "
+                     f"{len(plan.buckets)} dense bucket(s)",
+                detail={"n_leaves": n_inexact,
+                        "n_buckets": len(plan.buckets)}))
     return report
 
 

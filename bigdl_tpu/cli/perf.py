@@ -435,7 +435,8 @@ def run(model_name: str, batch: int, iterations: int, data_type: str,
         profile_dir: str | None = None, autotune: str | None = None,
         fused_bn: str | None = None, lint: dict | None = None,
         supervisor=None, obs_state=None, strategy: str | None = None,
-        seq_len: int | None = None):
+        seq_len: int | None = None, grad_compress: str | None = None,
+        grad_buckets: str | None = None):
     """Throughput harness entry. ``autotune`` optionally installs the
     tuning mode (the CLI does it via --autotune/apply_platform; bench.py
     children pass it directly). ``fused_bn`` ('off'/'stats'/'apply')
@@ -443,9 +444,11 @@ def run(model_name: str, batch: int, iterations: int, data_type: str,
     the resnet50_fbn/_fba model names. ``strategy`` ('dp'/'tp'/'sp'/
     'pp'/'ep', optionally NAME:K) runs the timed loop over every visible
     device via the ``parallel/`` API (ISSUE 8); ``data_parallel`` is the
-    deprecated alias for 'dp'. The conv layout policy is snapshotted and
-    restored so back-to-back runs in one process stay independent
-    (ADVICE r5 #1)."""
+    deprecated alias for 'dp'. ``grad_compress``/``grad_buckets`` are the
+    --gradCompress/--gradBuckets pair (ISSUE 10): bucketed 16-bit
+    gradient all-reduce under a multi-device dp/tp strategy. The conv
+    layout policy is snapshotted and restored so back-to-back runs in
+    one process stay independent (ADVICE r5 #1)."""
     from bigdl_tpu import tuning
     from bigdl_tpu.ops import conv2d
 
@@ -460,7 +463,8 @@ def run(model_name: str, batch: int, iterations: int, data_type: str,
                           profile_dir=profile_dir, fused_bn=fused_bn,
                           lint=lint, supervisor=supervisor,
                           obs_state=obs_state, strategy=strategy,
-                          seq_len=seq_len)
+                          seq_len=seq_len, grad_compress=grad_compress,
+                          grad_buckets=grad_buckets)
     finally:
         conv2d.restore_policy(snap)
 
@@ -471,7 +475,9 @@ def _run_timed(model_name: str, batch: int, iterations: int, data_type: str,
                profile_dir: str | None = None,
                fused_bn: str | None = None, lint: dict | None = None,
                supervisor=None, obs_state=None,
-               strategy: str | None = None, seq_len: int | None = None):
+               strategy: str | None = None, seq_len: int | None = None,
+               grad_compress: str | None = None,
+               grad_buckets: str | None = None):
     import os
 
     import jax
@@ -534,6 +540,27 @@ def _run_timed(model_name: str, batch: int, iterations: int, data_type: str,
                     f"batch {batch} must be divisible by the data axis "
                     f"({data_ax}) of --strategy {strat_name} "
                     f"(mesh {mesh_axes})")
+
+    # ----- gradient-communication config (ISSUE 10): bucketed 16-bit
+    # all-reduce through DataParallel.reduce_grads — so it composes with
+    # the strategies that route grads there (dp/tp/sp); pp/ep build
+    # their own step structure and refuse cleanly rather than silently
+    # running uncompressed
+    from bigdl_tpu.parallel.grad_comm import make_config as _mk_grad_comm
+    try:
+        grad_comm_cfg = _mk_grad_comm(grad_compress, grad_buckets)
+    except ValueError as e:
+        raise SystemExit(str(e))
+    if grad_comm_cfg is not None and grad_comm_cfg.active:
+        if strat_name is None:
+            raise SystemExit(
+                "--gradCompress compresses the cross-device gradient "
+                "all-reduce; it needs a multi-device --strategy (dp/tp)")
+        if strat_name in ("pp", "ep"):
+            raise SystemExit(
+                f"--gradCompress rides DataParallel.reduce_grads; "
+                f"--strategy {strat_name} builds its own step structure "
+                "and has no replicated-grad all-reduce to compress")
 
     # conv-layout decision for this device AND run configuration. The
     # window-2 combination matrix (PERF.md §8.2) measured the shipped
@@ -617,11 +644,12 @@ def _run_timed(model_name: str, batch: int, iterations: int, data_type: str,
         if strat_name == "dp" or strat_name == "sp":
             from bigdl_tpu.parallel import DataParallel
 
-            strat = DataParallel(mesh)
+            strat = DataParallel(mesh, grad_comm=grad_comm_cfg)
         elif strat_name == "tp":
             from bigdl_tpu.parallel import TensorParallel
 
             strat = TensorParallel(mesh, model)
+            strat.grad_comm = grad_comm_cfg
         if strat is not None:
             params, mod_state, opt_state = strat.place(
                 params, mod_state, opt_state)
@@ -731,6 +759,10 @@ def _run_timed(model_name: str, batch: int, iterations: int, data_type: str,
             cap.flops_by_kind = {kk: v * inner_steps
                                  for kk, v in flops_kinds.items()}
         cap.peak_flops = peak
+        if strat is not None and strat.grad_comm_info() is not None:
+            # the captured window's collective times belong to a
+            # compressed wire — attribution records say so
+            cap.grad_comm = strat.grad_comm_info()
 
     params, mod_state, opt_state, loss = step(params, mod_state, opt_state,
                                               x, y, k)
@@ -838,6 +870,17 @@ def _run_timed(model_name: str, batch: int, iterations: int, data_type: str,
         "strategy": strat_name,
         "n_devices": n_dev,
         "mesh": mesh_axes,
+        # ISSUE 10: what the gradient wire carried — every line says so
+        # ("off"/null single-device or uncompressed, so compressed-vs-
+        # plain A/Bs join on schema-stable columns next to collective_s)
+        "grad_compress": (grad_comm_cfg.compress
+                          if (grad_comm_cfg is not None
+                              and grad_comm_cfg.active
+                              and strat is not None) else "off"),
+        "grad_buckets": (strat.grad_comm_info()["n_buckets"]
+                         if (strat is not None
+                             and strat.grad_comm_info() is not None)
+                         else None),
         "seconds": round(dt, 4),
         "records_per_second": round(ips, 2),
         "images_per_second_per_chip": round(ips / n_dev, 2),
@@ -861,6 +904,10 @@ def _run_timed(model_name: str, batch: int, iterations: int, data_type: str,
         "final_loss": round(float(loss), 6),
         "device": getattr(jax.devices()[0], "device_kind", "unknown"),
     }
+    if strat is not None and strat.grad_comm_info() is not None:
+        # the full wire accounting (bucket bound + provenance, wire
+        # bytes vs f32 bytes, plan signature) for PERF.md §17 tables
+        out["grad_comm"] = dict(strat.grad_comm_info())
     _annotate_obs_phases(out, obs_state, phase, dt)
     _annotate_conv_layouts(out)
     _annotate_autotune(out)
@@ -981,7 +1028,9 @@ def run_time_to_acc(model_name: str, batch: int, target: float,
                     weight_decay: float = 1e-4,
                     fused_bn: str | None = None,
                     lint: dict | None = None,
-                    supervisor=None, obs_state=None):
+                    supervisor=None, obs_state=None,
+                    grad_compress: str | None = None,
+                    grad_buckets: str | None = None):
     """Time-to-accuracy harness (BASELINE.json metric: images/sec/chip
     **+ time-to-76%-top1**; reference recipe models/inception/Train.scala
     :77-83 + scripts/run.example.sh:54). Trains ``model_name`` from
@@ -1035,6 +1084,12 @@ def run_time_to_acc(model_name: str, batch: int, target: float,
         model, _ = build_model(model_name, class_num=classes)
         from bigdl_tpu.cli.common import apply_fused_bn
         apply_fused_bn(model, fused_bn)
+        from bigdl_tpu.parallel.grad_comm import make_config as _mk_gc
+        try:
+            gc_cfg = _mk_gc(grad_compress, grad_buckets)
+        except ValueError as e:
+            raise SystemExit(str(e))
+        strat = DataParallel(local_mesh(), grad_comm=gc_cfg)
         opt = Optimizer(
             model, train_ds, nn.ClassNLLCriterion(),
             # wd matches the reference CIFAR recipe (models/resnet/README.md
@@ -1044,7 +1099,7 @@ def run_time_to_acc(model_name: str, batch: int, target: float,
                              weight_decay=weight_decay),
             end_when=Trigger.or_(Trigger.max_epoch(max_epochs),
                                  Trigger.max_score(target)),
-            strategy=DataParallel(local_mesh()),
+            strategy=strat,
             compute_dtype=(jnp.bfloat16 if use_bf16 else None))
         val_trig = (Trigger.several_iteration(val_every_iters)
                     if val_every_iters else Trigger.every_epoch())
@@ -1081,6 +1136,14 @@ def run_time_to_acc(model_name: str, batch: int, target: float,
         # --valEvery (the val row's epoch field is post-rollover)
         "epochs_run": len({r.get("epoch") for r in curve}),
         "val_points": len(curve),
+        # schema-stable grad-comm columns (ISSUE 10) — the tta line
+        # carries them like every perf line does
+        "grad_compress": (gc_cfg.compress
+                          if (gc_cfg is not None and gc_cfg.active
+                              and strat.grad_comm_info() is not None)
+                          else "off"),
+        "grad_buckets": (strat.grad_comm_info()["n_buckets"]
+                         if strat.grad_comm_info() is not None else None),
         "hard_data": hard,
         "grade_lift": resolve_grade(hard, lift, noise)[0],
         "grade_noise": resolve_grade(hard, lift, noise)[1],
@@ -1186,12 +1249,14 @@ def main(argv=None):
                         "1x1/s1 convs may run as GEMM; stamped as "
                         "conv_geom in the result JSON")
     from bigdl_tpu.cli.common import (_add_platform_arg, add_autotune_arg,
-                                      add_fused_bn_arg, add_lint_arg,
-                                      add_obs_args, add_resilience_args,
+                                      add_fused_bn_arg, add_grad_comm_args,
+                                      add_lint_arg, add_obs_args,
+                                      add_resilience_args,
                                       add_strategy_arg, apply_platform,
                                       run_preflight_lint)
     _add_platform_arg(p)
     add_strategy_arg(p)
+    add_grad_comm_args(p)
     add_autotune_arg(p)
     add_fused_bn_arg(p)
     add_lint_arg(p)
@@ -1215,7 +1280,8 @@ def main(argv=None):
         from bigdl_tpu.analysis import lint_perf_model
         report = lint_perf_model(
             args.model, args.batchSize, fused_bn=args.fusedBN,
-            dtype=jnp.float32 if args.f32 else None)
+            dtype=jnp.float32 if args.f32 else None,
+            strategy=args.strategy, grad_compress=args.gradCompress)
         rc, lint_ann = run_preflight_lint(
             report, strict=(args.lint == "strict"))
         if rc:
@@ -1245,14 +1311,17 @@ def main(argv=None):
                             lift=args.ttaLift, noise=args.ttaNoise,
                             weight_decay=args.ttaWd, fused_bn=args.fusedBN,
                             lint=lint_ann, supervisor=supervisor,
-                            obs_state=obs_state)
+                            obs_state=obs_state,
+                            grad_compress=args.gradCompress,
+                            grad_buckets=args.gradBuckets)
             return
         run(args.model, args.batchSize, args.iteration, args.dataType,
             use_bf16=not args.f32, data_parallel=args.dataParallel,
             data_source=args.data, inner_steps=args.innerSteps,
             profile_dir=args.profile, fused_bn=args.fusedBN,
             lint=lint_ann, supervisor=supervisor, obs_state=obs_state,
-            strategy=args.strategy, seq_len=args.seq)
+            strategy=args.strategy, seq_len=args.seq,
+            grad_compress=args.gradCompress, grad_buckets=args.gradBuckets)
 
     if args.supervise is not None:
         # supervised perf: transient injected faults retry with backoff

@@ -81,6 +81,11 @@ class CaptureController:
         self.step_flops: Optional[float] = None
         self.flops_by_kind: Optional[dict] = None
         self.peak_flops: Optional[float] = None
+        # gradient-communication context (ISSUE 10): when the harness
+        # compressed/bucketed the grad all-reduce, the config rides into
+        # every attributed window so a captured collective_s can be read
+        # against the wire bytes that produced it
+        self.grad_comm: Optional[dict] = None
         os.makedirs(self.trace_dir, exist_ok=True)
         self._planned: Optional[Tuple[int, int]] = (
             parse_trace_steps(trace_steps) if trace_steps else None)
@@ -231,6 +236,8 @@ class CaptureController:
                 flops_by_kind=self.flops_by_kind,
                 peak_flops=self.peak_flops)
             rec["attrib"] = _attrib.compact(summary)
+            if self.grad_comm is not None:
+                rec["grad_comm"] = dict(self.grad_comm)
             _attrib.publish(summary, get_registry())
         except Exception as e:
             rec["attrib_error"] = (

@@ -15,9 +15,11 @@ How each reference mechanism maps:
   and optimizer state *sharded* (ZeRO-1 — exactly the reference's
   "optimizer runs on a 1/N weight shard" structure, DistriOptimizer.scala
   :225-236, but compiler-scheduled instead of blocking block exchange).
-* FP16 truncated compression -> native bf16: gradients can be computed and
-  reduced in bf16 by running the model in bf16 (compute dtype), which is
-  hardware-native rather than a byte-twiddling codec.
+* FP16 truncated compression -> :mod:`bigdl_tpu.parallel.grad_comm`:
+  gradients are bucketed into size-bounded dense buffers and cast to
+  bf16/fp16 for the cross-device reduce (``--gradCompress``), with an
+  error-compensation option keeping optimizer math exactly f32 — the
+  codec's hardware-native spelling, applied in ``reduce_grads`` below.
 * ZippedPartitionsWithLocalityRDD (host-locality of data)  ->
   per-host input pipelines + ``jax.make_array_from_process_local_data``.
 * straggler dropping -> intentionally absent: SPMD collectives are bulk
@@ -93,13 +95,15 @@ class DataParallel:
     """Strategy object consumed by :class:`bigdl_tpu.optim.Optimizer`.
 
     ``zero1=True`` shards optimizer state over the data axis (reference's
-    per-partition optimizer shards). For bf16 activations/grad math pass
-    ``compute_dtype=jnp.bfloat16`` to the Optimizer (native replacement
-    for the reference's fp16 codec).
+    per-partition optimizer shards). ``grad_comm`` takes a
+    :class:`bigdl_tpu.parallel.grad_comm.GradCommConfig` (the parsed
+    ``--gradCompress``/``--gradBuckets`` pair) to bucket + compress the
+    gradient all-reduce in :meth:`reduce_grads` — the reference's fp16
+    codec, trace-level.
     """
 
     def __init__(self, mesh: Optional[Mesh] = None, axis: str = "data",
-                 zero1: bool = True, donate: bool = True):
+                 zero1: bool = True, donate: bool = True, grad_comm=None):
         if mesh is None:
             from bigdl_tpu.parallel.mesh import local_mesh
             mesh = local_mesh(axis)
@@ -107,6 +111,8 @@ class DataParallel:
         self.axis = axis
         self.zero1 = zero1
         self.donate = donate
+        self.grad_comm = grad_comm
+        self._grad_comm_info = None
         self._repl = NamedSharding(mesh, P())
         self._batch = NamedSharding(mesh, P(axis))
         self._opt_shardings = None
@@ -143,10 +149,31 @@ class DataParallel:
 
     # ------------------------------------------------------------- compile
     def reduce_grads(self, grads, loss):
-        """Under jit-SPMD the cross-device grad psum is inserted by the
-        partitioner (params are replicated); nothing to do. Kept as a hook so
-        explicit shard_map strategies can psum here."""
+        """The single entry point for gradient reduction: every strategy
+        train step (Optimizer and perf harness) routes grads through here
+        before clip/update.
+
+        Without ``grad_comm`` the cross-device grad psum is inserted by
+        the partitioner on the raw f32 values (params replicated) and
+        this is the identity — the traced step is bit-identical to the
+        pre-grad-comm harness. With an active config the grads are
+        bucketed, compressed to the 16-bit wire dtype, annotated as the
+        replication point (so the partitioner's all-reduce rides the
+        compressed value), decompressed, and — under ``+ec`` — restored
+        to the exact f32 gradient via the local rounding residual. The
+        host-side bucket/wire accounting lands in
+        :meth:`grad_comm_info` for perf JSON stamping."""
+        from bigdl_tpu.parallel.grad_comm import apply_grad_comm
+
+        grads, info = apply_grad_comm(grads, self.grad_comm, self.mesh)
+        if info is not None:
+            self._grad_comm_info = info
         return grads, loss
+
+    def grad_comm_info(self):
+        """Bucket/wire accounting from the last traced ``reduce_grads``
+        (None when grad-comm never activated)."""
+        return self._grad_comm_info
 
     def _hinted(self, train_step, batch_spec: Optional[P]):
         """Trace ``train_step`` under the batch-sharding hint so modules at

@@ -36,6 +36,7 @@ from bigdl_tpu.tuning.cache import AutotuneCache
 
 __all__ = ["MODES", "set_mode", "get_mode", "dry_run", "make_key",
            "flash_blocks", "bn_row_block", "fba_row_block",
+           "grad_bucket_bytes",
            "install_conv_layouts", "conv_geom_layout", "conv_geom_key",
            "peek_geom_layout", "put_geom_decisions",
            "annotation", "reset", "reset_decisions", "get_cache"]
@@ -53,6 +54,11 @@ _CACHE: Optional[AutotuneCache] = None
 FLASH_TILINGS = (128, 256, 512, 1024)
 # BN row blocks: the (8, 128)-tile-legal heights around the shipped 512
 BN_ROW_BLOCKS = (128, 256, 512, 1024, 2048)
+
+# grad-comm dense-bucket byte bounds swept around the shipped 4 MiB
+# default: small enough to keep several reduces in flight behind the
+# backward, large enough to amortize per-collective launch latency
+GRAD_BUCKET_BYTES = tuple(m * 2 ** 20 for m in (1, 2, 4, 8, 16))
 
 CONV_VARIANTS = ("plain", "inner", "s2d")
 
@@ -262,6 +268,36 @@ def fba_row_block(rows: int, c: int, dtype,
 
     config, _ = _resolve(key, default, _measure)
     return int(config["row_block"])
+
+
+def grad_bucket_bytes(param_bytes: int, n_devices: int,
+                      dtype) -> Optional[int]:
+    """Tuned dense-bucket byte bound for the compressed gradient
+    all-reduce (``grad_comm`` namespace), or None when the mode is off —
+    the caller (parallel/grad_comm._resolve_bucket_bytes) then keeps its
+    shipped 4 MiB default. Keyed per (param MiB rounded up, device
+    count, wire dtype): bucket economics are a function of how much
+    gradient crosses the wire, over how many links, at what element
+    width — not of the model's name."""
+    if _MODE == "off":
+        return None
+    param_mib = max(1, -(-int(param_bytes) // 2 ** 20))
+    key = make_key("grad_comm", param_mib=param_mib, n_devices=n_devices,
+                   dtype=_dtype_name(dtype))
+    cands = [b for b in GRAD_BUCKET_BYTES if b <= param_bytes] or \
+        [GRAD_BUCKET_BYTES[0]]
+    from bigdl_tpu.parallel.grad_comm import DEFAULT_BUCKET_BYTES
+    default_b = DEFAULT_BUCKET_BYTES
+    if default_b not in cands:  # tiny trees: largest legal candidate
+        default_b = cands[-1]
+    default = {"bucket_bytes": default_b}
+
+    def _measure():
+        from bigdl_tpu.tuning.measure import measure_grad_buckets
+        return measure_grad_buckets(param_bytes, n_devices, dtype, cands)
+
+    config, _ = _resolve(key, default, _measure)
+    return int(config["bucket_bytes"])
 
 
 def conv_geom_key(pass_name: str, geom: tuple) -> str:

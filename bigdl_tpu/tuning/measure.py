@@ -20,7 +20,8 @@ from typing import Dict, List, Sequence, Tuple
 
 __all__ = ["time_fn", "measure_flash_blocks", "measure_bn_row_block",
            "measure_fba_row_block", "measure_conv_layouts",
-           "measure_conv_geom", "CONV_PROBE_SHAPES"]
+           "measure_conv_geom", "measure_grad_buckets",
+           "CONV_PROBE_SHAPES"]
 
 _WARMUP = 1
 _ITERS = 3
@@ -135,6 +136,52 @@ def measure_fba_row_block(rows: int, c: int, dtype, relu: bool,
         g = jax.jit(jax.grad(loss))
         ms = time_fn(g, x)  # grad is x-shaped: calls chain
         timed.append(({"row_block": rb}, ms))
+    return _pick(timed)
+
+
+def measure_grad_buckets(param_bytes: int, n_devices: int, dtype,
+                         candidates: Sequence[int]) -> Tuple[dict, float]:
+    """Time one full compressed all-reduce of ``param_bytes`` worth of
+    f32 gradient per bucket-bound candidate, over the ambient device
+    mesh's ``data`` axis via grad_comm's explicit shard_map psum path —
+    the wire cost a training step pays, minus the backward it would
+    overlap with (overlap headroom rises as buckets shrink; the measured
+    total captures the per-collective latency the bound amortizes).
+    Returns ({"bucket_bytes": best}, best_ms)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from bigdl_tpu.parallel.grad_comm import compressed_psum
+
+    mode = "fp16" if np.dtype(dtype).name == "float16" else "bf16"
+    devs = jax.devices()[:n_devices]
+    mesh = Mesh(np.array(devs), ("data",))
+    n_elems = max(1, int(param_bytes) // 4)
+
+    timed: List[Tuple[dict, float]] = []
+    for bound in candidates:
+        per_bucket = max(1, int(bound) // 4)
+        lens = [per_bucket] * (n_elems // per_bucket)
+        if n_elems % per_bucket:
+            lens.append(n_elems % per_bucket)
+
+        def reduce_all(x, lens=lens, mesh=mesh, mode=mode):
+            outs = []
+            off = 0
+            for ln in lens:
+                stacked = jax.lax.dynamic_slice_in_dim(
+                    x, off, ln * n_devices).reshape(n_devices, ln)
+                outs.append(compressed_psum(stacked, mesh, "data", mode))
+                off += ln * n_devices
+            return jnp.concatenate(outs)
+
+        x = jax.random.normal(jax.random.PRNGKey(0),
+                              (n_elems * n_devices,), jnp.float32)
+        fn = jax.jit(reduce_all)
+        ms = time_fn(fn, x)  # output is not x-shaped: re-invokes
+        timed.append(({"bucket_bytes": int(bound)}, ms))
     return _pick(timed)
 
 

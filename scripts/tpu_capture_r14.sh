@@ -1,0 +1,89 @@
+#!/usr/bin/env bash
+# Round-14 capture: ISSUE 10 (compressed, bucketed, overlapped gradient
+# all-reduce) chip evidence. The mechanism is CPU-verified end to end
+# (tests/test_grad_comm.py, the gradcomm-smoke CI job); what only
+# hardware can tell us is (a) the compressed-vs-plain collective_s /
+# collective_frac delta on a real mesh — halving wire bytes only pays
+# when the all-reduce is actually bandwidth-bound, (b) whether the
+# dependency-free bucket launches overlap with backward under the real
+# XLA scheduler (step time delta beyond the collective delta), and
+# (c) what bucket bound the measure-mode autotuner picks per
+# (param-bytes, n_devices, dtype) on chip. Each A/B leg runs x3 reps so
+# the §17 slots get medians, with explain legs attributing the windows.
+# On a single-chip tunnel every --strategy leg exits cleanly ("needs
+# more than one device") and the round costs minutes, not hours.
+# Appends to $OUT, mirrored into the repo per step.
+
+set -uo pipefail
+cd "$(dirname "$0")/.."
+OUT="${OUT:-/tmp/tpu_capture_r14.log}"
+REPO_LOG="${REPO_LOG:-TPU_CAPTURE_r14.log}"
+TRACE_ROOT="${TRACE_ROOT:-/tmp/gradcomm_r14}"
+trap 'cp -f "$OUT" "$REPO_LOG" 2>/dev/null || true' EXIT
+
+step() {
+  local name="$1" tmo="$2"; shift 2
+  echo "=== $name ($(date -u +%H:%M:%SZ))" | tee -a "$OUT"
+  timeout "$tmo" "$@" 2>&1 | tail -40 | tee -a "$OUT"
+  echo "=== end $name rc=$?" | tee -a "$OUT"
+  cp -f "$OUT" "$REPO_LOG" 2>/dev/null || true
+}
+
+# 0. the grad-comm tests on the bench env first
+step "pytest_grad_comm" 600 python -m pytest tests/test_grad_comm.py \
+  tests/test_strategy_perf.py -q
+
+# 1. THE r14 table: compressed-vs-plain gradient all-reduce A/B on dp,
+#    x3 reps each so PERF.md §17 gets medians. Every line stamps
+#    grad_compress/grad_buckets next to collective_s/collective_frac;
+#    the capture window attributes the collective bucket per leg.
+for REP in 1 2 3; do
+  for GC in off bf16 bf16+ec fp16; do
+    step "ab_dp_${GC}_r${REP}" 1800 python -m bigdl_tpu.cli.main perf \
+      -m resnet50 -b 128 -i 30 --strategy dp --gradCompress "$GC" \
+      --obs --traceDir "$TRACE_ROOT/dp_${GC}_r${REP}" \
+      --traceSteps 4@15 || true
+  done
+done
+
+# 2. the LM leg (gradient tree dominated by a few big matmul leaves —
+#    the bucket layout stress case opposite resnet's many small ones)
+for REP in 1 2 3; do
+  for GC in off bf16; do
+    step "ab_lm_${GC}_r${REP}" 1800 python -m bigdl_tpu.cli.main perf \
+      -m transformer_lm_1k_hd128 -b 8 -i 30 --strategy dp \
+      --gradCompress "$GC" \
+      --obs --traceDir "$TRACE_ROOT/lm_${GC}_r${REP}" \
+      --traceSteps 4@15 || true
+  done
+done
+
+# 3. bucket-bound sweep at fixed compression: explicit 1/4/16 MiB vs
+#    the measure-mode autotuned pick (persisted under the grad_comm
+#    cache namespace; the cached leg replays it with zero overhead)
+for BK in 1 4 16; do
+  step "buckets_${BK}mib" 1800 python -m bigdl_tpu.cli.main perf \
+    -m resnet50 -b 128 -i 30 --strategy dp --gradCompress bf16 \
+    --gradBuckets "$BK" || true
+done
+step "buckets_autotune_measure" 2400 python -m bigdl_tpu.cli.main perf \
+  -m resnet50 -b 128 -i 30 --strategy dp --gradCompress bf16 \
+  --gradBuckets auto --autotune measure || true
+step "buckets_autotune_cached" 1800 python -m bigdl_tpu.cli.main perf \
+  -m resnet50 -b 128 -i 30 --strategy dp --gradCompress bf16 \
+  --gradBuckets auto --autotune cached || true
+
+# 4. explain the compressed vs plain windows — the collective row of
+#    the attribution table is the wire-byte halving made visible
+step "explain_dp_off" 600 python -m bigdl_tpu.cli.main explain \
+  "$TRACE_ROOT/dp_off_r1/capture_15" --steps 4 || true
+step "explain_dp_bf16" 600 python -m bigdl_tpu.cli.main explain \
+  "$TRACE_ROOT/dp_bf16_r1/capture_15" --steps 4 || true
+
+# 5. bench.py with compression plumbed through (the multichip bench row
+#    with grad_compress/grad_buckets columns in the line)
+step "bench_dp_bf16" 2400 env BENCH_COMPANIONS=0 python bench.py \
+  resnet50 128 20 --strategy dp --gradCompress bf16
+
+# 6. summarize every JSON line in this log for PERF.md §17
+step "summarize" 300 python scripts/update_perf_from_capture.py "$OUT"
