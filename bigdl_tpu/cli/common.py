@@ -9,6 +9,7 @@ import argparse
 import json
 import logging
 import os
+import time
 from typing import Optional, Sequence
 
 import numpy as np
@@ -91,9 +92,27 @@ def add_resilience_args(p: argparse.ArgumentParser) -> None:
                         "before step 7), 'dispatch@step:p0.01;seed=3' "
                         "(1%% transient step failures), "
                         "'corrupt@ckpt_save:2' (bit-rot the 2nd "
-                        "checkpoint), 'stall@step:4:0.25'. Sites: data, "
-                        "step, ckpt_save, ckpt_restore, infer, request. "
-                        "No-op when unset")
+                        "checkpoint), 'stall@step:4:0.25', "
+                        "'kill_device@step:5:1' (lose 1 device before "
+                        "step 5 — recoverable only under --elastic). "
+                        "Sites: data, step, ckpt_save, ckpt_restore, "
+                        "infer, request. No-op when unset")
+    p.add_argument("--elastic", default=None, choices=["hold", "scale"],
+                   metavar="POLICY",
+                   help="elastic data-parallelism "
+                        "(bigdl_tpu.resilience.elastic): on device loss "
+                        "(kill_device fault / DeviceLossFault) re-form "
+                        "the mesh at the surviving count, re-resolve the "
+                        "grad-comm bucket bound for the new n_devices, "
+                        "and resume from the last valid checkpoint — "
+                        "holding the global batch (hold: pad per-device "
+                        "batches) or scaling it (scale: trim to "
+                        "divisibility). dp strategy only")
+    p.add_argument("--minDevices", type=int, default=1, metavar="N",
+                   help="give up cleanly (SupervisorGaveUp) when fewer "
+                        "than N healthy devices survive — elastic "
+                        "reshape never thrashes below a viable mesh "
+                        "(default 1)")
 
 
 def add_obs_args(p: argparse.ArgumentParser) -> None:
@@ -234,7 +253,8 @@ def run_optimize(make_optimizer, args):
         return opt
 
     budget = getattr(args, "supervise", None)
-    if budget is None:
+    elastic = getattr(args, "elastic", None)
+    if budget is None and elastic is None:
         try:
             return _make().optimize()
         finally:
@@ -242,10 +262,23 @@ def run_optimize(make_optimizer, args):
                 obs_state.finalize()
     from bigdl_tpu.resilience.supervisor import RetryPolicy, Supervisor
     ckpt_dir = getattr(args, "checkpoint", None)
-    sup = Supervisor(RetryPolicy(budget=int(budget),
-                                 seed=getattr(args, "seed", 0)))
+    policy = RetryPolicy(budget=int(budget if budget is not None else 5),
+                         seed=getattr(args, "seed", 0))
+    if elastic is not None:
+        # device loss becomes retryable: each retry's make_optimizer()
+        # re-probes healthy_devices() through build_strategy, so the
+        # fresh Optimizer is born on the surviving-count mesh with its
+        # grad-comm bucket bound re-resolved for the new n_devices
+        from bigdl_tpu.resilience.elastic import ElasticSupervisor
+        sup = ElasticSupervisor(policy, batch_policy=elastic,
+                                min_devices=getattr(args, "minDevices", 1))
+    else:
+        sup = Supervisor(policy)
 
     def attempt(n):
+        t0 = time.perf_counter()
+        if elastic is not None:
+            sup.probe()  # SupervisorGaveUp below --minDevices
         opt = _make()
         if n > 0 and ckpt_dir:
             # resume() is a no-op on an empty dir, picks the newest
@@ -253,6 +286,17 @@ def run_optimize(make_optimizer, args):
             # model-only blob when the kill landed mid-checkpoint (its
             # orphan allowance lets the retry overwrite torn names)
             opt.resume(ckpt_dir)
+        if elastic is not None:
+            strat = getattr(opt, "strategy", None)
+            mesh = getattr(strat, "mesh", None)
+            if mesh is not None:
+                n_dev = int(mesh.devices.size)
+            else:
+                import jax
+                n_dev = len(jax.devices())
+            sup.observe_topology(
+                n_dev, restore_ms=((time.perf_counter() - t0) * 1000.0
+                                   if n > 0 else None))
         return opt.optimize()
 
     try:
@@ -602,11 +646,27 @@ def build_strategy(args, model=None):
     sp/pp/ep need harness-side model composition (ring attention /
     pipeline stack / MoE) and are wired in ``cli/perf.py``."""
     name, k = resolve_strategy(args)
+    elastic = getattr(args, "elastic", None)
     if name is None:
+        if elastic is not None:
+            raise SystemExit("--elastic needs --strategy dp (elastic "
+                             "reshape is a data-parallel contract)")
         return None
     import jax
 
-    n = len(jax.devices())
+    if elastic is not None and name != "dp":
+        raise SystemExit(f"--elastic composes with --strategy dp only "
+                         f"(got {name}); tp/sp/pp/ep meshes cannot "
+                         "re-form at arbitrary surviving counts")
+    # elastic runs build their mesh from the SURVIVING roster: after a
+    # kill_device fault the retry's fresh strategy lands on fewer devices
+    devices = None
+    if elastic is not None:
+        from bigdl_tpu.resilience.faults import healthy_devices
+        devices = healthy_devices()
+        n = len(devices)
+    else:
+        n = len(jax.devices())
     if n <= 1:
         if getattr(args, "strategy", None):
             raise SystemExit(
@@ -622,6 +682,11 @@ def build_strategy(args, model=None):
     grad_comm = make_grad_comm(args)
     axes = strategy_mesh_axes(name, n, k)
     if name == "dp":
+        if elastic is not None:
+            from bigdl_tpu.resilience.elastic import ElasticDataParallel
+            return ElasticDataParallel(make_mesh(axes, devices),
+                                       batch_policy=elastic,
+                                       grad_comm=grad_comm)
         return DataParallel(make_mesh(axes), grad_comm=grad_comm)
     if name == "tp":
         if model is None:

@@ -436,7 +436,7 @@ def run(model_name: str, batch: int, iterations: int, data_type: str,
         fused_bn: str | None = None, lint: dict | None = None,
         supervisor=None, obs_state=None, strategy: str | None = None,
         seq_len: int | None = None, grad_compress: str | None = None,
-        grad_buckets: str | None = None):
+        grad_buckets: str | None = None, elastic=None):
     """Throughput harness entry. ``autotune`` optionally installs the
     tuning mode (the CLI does it via --autotune/apply_platform; bench.py
     children pass it directly). ``fused_bn`` ('off'/'stats'/'apply')
@@ -464,7 +464,7 @@ def run(model_name: str, batch: int, iterations: int, data_type: str,
                           lint=lint, supervisor=supervisor,
                           obs_state=obs_state, strategy=strategy,
                           seq_len=seq_len, grad_compress=grad_compress,
-                          grad_buckets=grad_buckets)
+                          grad_buckets=grad_buckets, elastic=elastic)
     finally:
         conv2d.restore_policy(snap)
 
@@ -477,12 +477,16 @@ def _run_timed(model_name: str, batch: int, iterations: int, data_type: str,
                supervisor=None, obs_state=None,
                strategy: str | None = None, seq_len: int | None = None,
                grad_compress: str | None = None,
-               grad_buckets: str | None = None):
+               grad_buckets: str | None = None, elastic=None):
     import os
 
     import jax
     import jax.numpy as jnp
     import numpy as np
+
+    # elastic attempt wall-clock starts here: on a post-loss retry the
+    # mesh re-formation + rebuild + recompile up to warmup IS restore_ms
+    t_attempt0 = time.perf_counter()
 
     # persistent compile cache: repeat benchmark runs (the capture
     # sweeps re-measure the same configs) skip the 20-40s TPU compile
@@ -500,8 +504,19 @@ def _run_timed(model_name: str, batch: int, iterations: int, data_type: str,
     strat_name, strat_k = _common.parse_strategy_spec(strat_spec)
     mesh = None
     mesh_axes = None
+    elastic_devices = None
+    if elastic is not None:
+        if strat_name != "dp":
+            raise SystemExit(
+                "--elastic composes with --strategy dp only (elastic "
+                "reshape is a data-parallel contract)")
+        # the surviving-device roster for THIS attempt; below
+        # --minDevices this raises SupervisorGaveUp (clean give-up,
+        # never a retry)
+        elastic_devices = elastic.probe()
     if strat_name is not None:
-        n_all = len(jax.devices())
+        n_all = (len(elastic_devices) if elastic_devices is not None
+                 else len(jax.devices()))
         if n_all <= 1:
             if strategy is not None:
                 raise SystemExit(
@@ -533,9 +548,11 @@ def _run_timed(model_name: str, batch: int, iterations: int, data_type: str,
             mesh_axes = _common.strategy_mesh_axes(strat_name, n_all,
                                                    strat_k)
             from bigdl_tpu.parallel import make_mesh
-            mesh = make_mesh(mesh_axes)
+            mesh = make_mesh(mesh_axes, elastic_devices)
             data_ax = mesh_axes.get("data", 1)
-            if batch % data_ax:
+            if batch % data_ax and elastic is None:
+                # elastic runs pad/trim to divisibility instead
+                # (ElasticDataParallel.shard_batch, --elastic policy)
                 raise SystemExit(
                     f"batch {batch} must be divisible by the data axis "
                     f"({data_ax}) of --strategy {strat_name} "
@@ -641,7 +658,16 @@ def _run_timed(model_name: str, batch: int, iterations: int, data_type: str,
         opt_state = opt.init(params)
 
         strat = None
-        if strat_name == "dp" or strat_name == "sp":
+        if strat_name == "dp" and elastic is not None:
+            # elastic dp: batch placement pads (hold) or trims (scale)
+            # to the surviving data-axis size; everything else is plain
+            # DataParallel, so at full topology this is bit-identical
+            from bigdl_tpu.resilience.elastic import ElasticDataParallel
+
+            strat = ElasticDataParallel(mesh,
+                                        batch_policy=elastic.batch_policy,
+                                        grad_comm=grad_comm_cfg)
+        elif strat_name == "dp" or strat_name == "sp":
             from bigdl_tpu.parallel import DataParallel
 
             strat = DataParallel(mesh, grad_comm=grad_comm_cfg)
@@ -769,6 +795,16 @@ def _run_timed(model_name: str, batch: int, iterations: int, data_type: str,
     # scalar host transfer = true sync; on the tunneled (axon) platform
     # block_until_ready was observed returning before execution finished
     float(loss)  # compile + warmup
+
+    if elastic is not None:
+        # topology is live (mesh formed, step compiled, bucket bound
+        # re-resolved in the fresh trace): report it — the call after a
+        # caught DeviceLossFault closes out the reshape event with the
+        # from/to counts, restore_ms, and bucket bound before/after
+        info = strat.grad_comm_info() if strat is not None else None
+        elastic.observe_topology(
+            n_dev, bucket_bytes=(info or {}).get("bucket_bytes"),
+            restore_ms=(time.perf_counter() - t_attempt0) * 1000.0)
 
     feed = None
     if data_source is not None:
@@ -908,6 +944,16 @@ def _run_timed(model_name: str, batch: int, iterations: int, data_type: str,
         # the full wire accounting (bucket bound + provenance, wire
         # bytes vs f32 bytes, plan signature) for PERF.md §17 tables
         out["grad_comm"] = dict(strat.grad_comm_info())
+    if elastic is not None:
+        # ISSUE 11: the elastic columns. `reshape` is the most recent
+        # mesh re-formation (from/to device counts, restore_ms, bucket
+        # bound before/after + total count) or null when the topology
+        # never changed; effective_batch exposes hold-padding/scale-
+        # trimming (== batch at full topology)
+        out["elastic"] = {"policy": elastic.batch_policy,
+                          "min_devices": elastic.min_devices,
+                          "effective_batch": int(x.shape[0])}
+        out["reshape"] = elastic.reshape_annotation()
     _annotate_obs_phases(out, obs_state, phase, dt)
     _annotate_conv_layouts(out)
     _annotate_autotune(out)
@@ -1288,7 +1334,7 @@ def main(argv=None):
             return rc
     obs_state = getattr(args, "_obs", None)
 
-    def _go(supervisor=None):
+    def _go(supervisor=None, elastic=None):
         if args.timeToAcc is not None:
             if args.strategy and args.strategy != "dp":
                 raise SystemExit(
@@ -1321,7 +1367,33 @@ def main(argv=None):
             profile_dir=args.profile, fused_bn=args.fusedBN,
             lint=lint_ann, supervisor=supervisor, obs_state=obs_state,
             strategy=args.strategy, seq_len=args.seq,
-            grad_compress=args.gradCompress, grad_buckets=args.gradBuckets)
+            grad_compress=args.gradCompress, grad_buckets=args.gradBuckets,
+            elastic=elastic)
+
+    if args.elastic is not None:
+        # elastic perf (ISSUE 11): a kill_device fault mid-loop marks
+        # the victims lost and raises DeviceLossFault; the retry probes
+        # the survivors, re-forms the mesh at the smaller count, pads or
+        # trims the batch per --elastic, and the JSON line carries the
+        # reshape dict. Below --minDevices the run gives up cleanly.
+        if args.timeToAcc is not None:
+            raise SystemExit(
+                "--elastic + --timeToAcc: use the training CLIs (their "
+                "run_optimize path reshapes through checkpoint resume); "
+                "the perf throughput loop is the elastic harness here")
+        from bigdl_tpu.resilience.elastic import ElasticSupervisor
+        from bigdl_tpu.resilience.supervisor import (RetryPolicy,
+                                                     SupervisorGaveUp)
+        sup = ElasticSupervisor(
+            RetryPolicy(budget=(args.supervise if args.supervise is not None
+                                else 5)),
+            min_devices=args.minDevices, batch_policy=args.elastic,
+            name="perf")
+        try:
+            sup.run(lambda _n: _go(sup, elastic=sup))
+        except SupervisorGaveUp as e:
+            raise SystemExit(f"elastic: {e}")
+        return
 
     if args.supervise is not None:
         # supervised perf: transient injected faults retry with backoff

@@ -860,9 +860,16 @@ class Optimizer:
                          os.path.join(self._ckpt_path, f"state.{n}"),
                          overwrite=overwrite)
         else:
+            layout = None
             if self.strategy is not None:
                 params, mod_state, opt_state = self.strategy.gather(
                     params, mod_state, opt_state)
+                # dp layout signature for the topology manifest: the
+                # blobs below hold gathered LOGICAL arrays, so a later
+                # resume may re-place them into any mesh (ISSUE 11)
+                sig = getattr(self.strategy, "layout_signature", None)
+                if sig is not None:
+                    layout = sig()
             state_target = os.path.join(self._ckpt_path, f"state.{n}")
             if getattr(self, "_ckpt_async", False):
                 self._join_ckpt_writer()  # one in-flight write at a time
@@ -875,8 +882,8 @@ class Optimizer:
                 snap_opt = jax.device_get(opt_state)
 
                 def _write():
-                    save_pytree(snap_model, target)
-                    save_pytree(snap_opt, state_target)
+                    save_pytree(snap_model, target, layout=layout)
+                    save_pytree(snap_opt, state_target, layout=layout)
                     self._gc_ckpts()
                     logger.info("Checkpoint written at iteration %d to %s "
                                 "(async)", n, self._ckpt_path)
@@ -887,8 +894,8 @@ class Optimizer:
                 self._ckpt_thread.start()
                 return
             save_pytree({"params": params, "mod_state": mod_state,
-                         "driver": drv}, target)
-            save_pytree(opt_state, state_target)
+                         "driver": drv}, target, layout=layout)
+            save_pytree(opt_state, state_target, layout=layout)
         self._gc_ckpts()
         logger.info("Checkpoint written at iteration %d to %s", n,
                     self._ckpt_path)

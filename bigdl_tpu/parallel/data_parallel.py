@@ -117,6 +117,19 @@ class DataParallel:
         self._batch = NamedSharding(mesh, P(axis))
         self._opt_shardings = None
 
+    # ------------------------------------------------------------- identity
+    def layout_signature(self) -> dict:
+        """The dp layout this strategy writes checkpoints under — stamped
+        into the topology manifest (``utils/file.save_pytree(layout=...)``)
+        so a resharded restore knows what the writer looked like. Pure
+        provenance: blobs hold gathered logical arrays regardless."""
+        return {"strategy": type(self).__name__,
+                "axis": self.axis,
+                "zero1": bool(self.zero1),
+                "n_devices": int(self.mesh.devices.size),
+                "mesh": {str(name): int(self.mesh.shape[name])
+                         for name in self.mesh.axis_names}}
+
     # ------------------------------------------------------------- placement
     def _opt_sharding_tree(self, opt_state):
         def leaf_sharding(x):

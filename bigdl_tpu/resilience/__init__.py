@@ -17,28 +17,51 @@ or automates them. This package closes that gap:
   (checksum-verified) checkpoint, a bounded retry budget, and a
   structured fault/recovery log stamped into result JSON; plus
   :func:`~supervisor.supervise_command` for process-fatal preemptions
-  (the engine of ``scripts/chaos_run.py``).
+  (the engine of ``scripts/chaos_run.py``);
+* :mod:`elastic`    — elastic data-parallelism (ISSUE 11): on a
+  ``kill_device`` fault the :class:`~elastic.ElasticSupervisor`
+  re-forms the mesh at the surviving device count, reshards optimizer
+  state from the topology-independent checkpoint layout, re-resolves
+  the grad-comm bucket bound for the new ``n_devices``, and holds or
+  scales the global batch (``--elastic {hold,scale}``) — Spark's
+  lineage-based executor recovery, minus Spark.
 
 The serving-side hardening (per-request deadlines, dead-worker
 fast-fail, the watchdog, tiered shedding) lives in
 :mod:`bigdl_tpu.serving` next to the components it protects.
 """
 
-from bigdl_tpu.resilience.faults import (ChecksumError, FaultInjector,
-                                         FaultPlan, FaultRule, PREEMPT_RC,
+from bigdl_tpu.resilience.faults import (ChecksumError, DeviceLossFault,
+                                         FaultInjector, FaultPlan,
+                                         FaultRule, PREEMPT_RC,
                                          SimulatedPreemption,
                                          TransientFault, WorkerKillFault,
-                                         clear_plan, hook, injected_events,
-                                         install_plan, parse_plan)
+                                         clear_plan, healthy_devices, hook,
+                                         injected_events, install_plan,
+                                         parse_plan, restore_devices)
 from bigdl_tpu.resilience.supervisor import (RETRYABLE_EXCEPTIONS,
                                              RetryPolicy, Supervisor,
                                              SupervisorGaveUp,
                                              supervise_command)
 
 __all__ = [
-    "ChecksumError", "FaultInjector", "FaultPlan", "FaultRule",
+    "ChecksumError", "DeviceLossFault", "ElasticDataParallel",
+    "ElasticSupervisor", "FaultInjector", "FaultPlan", "FaultRule",
     "PREEMPT_RC", "RETRYABLE_EXCEPTIONS", "RetryPolicy",
     "SimulatedPreemption", "Supervisor", "SupervisorGaveUp",
-    "TransientFault", "WorkerKillFault", "clear_plan", "hook",
-    "injected_events", "install_plan", "parse_plan", "supervise_command",
+    "TransientFault", "WorkerKillFault", "clear_plan", "healthy_devices",
+    "hook", "injected_events", "install_plan", "parse_plan",
+    "restore_devices", "supervise_command",
 ]
+
+
+def __getattr__(name):
+    # elastic pulls in the parallel layer (jax, mesh machinery) — load it
+    # only when someone actually asks for the elastic classes, keeping
+    # `from bigdl_tpu.resilience.faults import hook` cheap for the hot
+    # training path that imports utils/file everywhere.
+    if name in ("ElasticDataParallel", "ElasticSupervisor",
+                "ELASTIC_POLICIES"):
+        from bigdl_tpu.resilience import elastic
+        return getattr(elastic, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,222 @@
+"""Elastic data-parallelism: survive device loss and RESHAPE (ISSUE 11).
+
+The reference's ``DistriOptimizer`` outlives executor loss because Spark
+re-forms the job from lineage and the driver still holds the last
+synchronized weights (PAPER.md layers 5-6) — the job continues with
+fewer workers, it does not merely restart. The PR 6 :class:`Supervisor`
+only knew how to restart the *same* topology; this module composes the
+existing pieces (checksummed topology-independent checkpoints, seeded
+``kill_device`` fault injection, per-``n_devices`` autotuned grad-comm)
+into the Spark behavior:
+
+* on a :class:`DeviceLossFault` the :class:`ElasticSupervisor` re-probes
+  ``faults.healthy_devices()``, and the next attempt re-forms the mesh at
+  the surviving count (``make_mesh(axes, devices)``), rebuilds the
+  strategy — a fresh trace re-resolves the ``grad_comm`` bucket bound
+  through the autotune cache, which is keyed by ``n_devices``, so the
+  new topology gets ITS OWN cached decision, never the old bound — and
+  resumes from the last valid checkpoint pair via the gathered-logical
+  blob layout (``utils/file.restore_resharded`` is the standalone
+  spelling; the Optimizer's resume + ``place()`` path reshards the same
+  way);
+* the global batch is held (``--elastic hold``: per-device batches are
+  padded with wrap-around rows to the next multiple of the surviving
+  count) or scaled (``--elastic scale``: trimmed down to divisibility)
+  by :class:`ElasticDataParallel`;
+* dropping below ``--minDevices`` is a clean :class:`SupervisorGaveUp`
+  — there is no point thrashing retries on a pod that has lost too much;
+* every reshape is recorded (from/to device counts, restore_ms, bucket
+  bound before/after), published as ``elastic_reshapes_total`` /
+  ``elastic_devices`` on the shared ``/metrics`` registry, and stamped
+  into the perf JSON line as the ``reshape`` dict.
+
+What IS bit-identical across a reshape: the restored params/opt state
+(blobs hold gathered logical arrays; placement is just sharding). What
+is NOT: the forward loss after the reshape under ``hold`` (padded rows
+enter the batch mean) and any step math at a different device count
+(reduction orders differ) — PERF.md §18 documents the contract.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import List, Optional
+
+import numpy as np
+
+from bigdl_tpu.parallel.data_parallel import DataParallel
+from bigdl_tpu.resilience.faults import DeviceLossFault, healthy_devices
+from bigdl_tpu.resilience.supervisor import (RETRYABLE_EXCEPTIONS,
+                                             RetryPolicy, Supervisor,
+                                             SupervisorGaveUp)
+
+logger = logging.getLogger("bigdl_tpu")
+
+__all__ = ["ELASTIC_POLICIES", "ElasticDataParallel", "ElasticSupervisor"]
+
+# --elastic choices: how the global batch reacts when the device count
+# changes. `hold` keeps every real row and pads to divisibility (the
+# DistriOptimizer behavior — global batch is a training hyperparameter);
+# `scale` trims rows so the per-device batch stays constant.
+ELASTIC_POLICIES = ("hold", "scale")
+
+
+class ElasticSupervisor(Supervisor):
+    """A :class:`Supervisor` that treats device loss as retryable and
+    owns the reshape ledger.
+
+    The attempt callable drives the protocol:
+
+    * ``probe()`` at the top of each attempt returns the healthy device
+      roster — or raises :class:`SupervisorGaveUp` once fewer than
+      ``min_devices`` survive (a clean give-up, not budget exhaustion);
+    * ``observe_topology(n_devices, ...)`` once the mesh/strategy is
+      (re)built: the first call records the baseline, and the first call
+      *after* a caught :class:`DeviceLossFault` closes out a reshape
+      event (from/to counts, restore_ms, bucket bound before/after) and
+      bumps the shared-registry metrics.
+    """
+
+    def __init__(self, policy: Optional[RetryPolicy] = None, *,
+                 min_devices: int = 1, batch_policy: str = "hold",
+                 name: str = "elastic", **kwargs):
+        if batch_policy not in ELASTIC_POLICIES:
+            raise ValueError(f"unknown --elastic policy {batch_policy!r} "
+                             f"(choices: {', '.join(ELASTIC_POLICIES)})")
+        if min_devices < 1:
+            raise ValueError(f"--minDevices must be >= 1, got {min_devices}")
+        retryable = tuple(kwargs.pop("retryable", RETRYABLE_EXCEPTIONS))
+        if DeviceLossFault not in retryable:
+            retryable = retryable + (DeviceLossFault,)
+        super().__init__(policy, retryable=retryable, name=name, **kwargs)
+        self.min_devices = int(min_devices)
+        self.batch_policy = batch_policy
+        self.reshapes: List[dict] = []
+        self._last_seen: Optional[dict] = None
+        self._pending_loss: Optional[str] = None
+
+    # ------------------------------------------------------------- protocol
+    def probe(self) -> list:
+        """The surviving device roster for this attempt's mesh. Raising
+        :class:`SupervisorGaveUp` here (below ``min_devices``) escapes
+        ``run()`` unretried — give-up is not a retryable fault."""
+        devs = healthy_devices()
+        if len(devs) < self.min_devices:
+            raise SupervisorGaveUp(
+                f"{len(devs)} healthy device(s) < --minDevices "
+                f"{self.min_devices} — cannot re-form a viable mesh",
+                self.annotation()["events"])
+        return devs
+
+    def observe_topology(self, n_devices: int,
+                         bucket_bytes: Optional[int] = None,
+                         restore_ms: Optional[float] = None) -> None:
+        """Record the topology an attempt actually built. Closes out a
+        pending reshape (device loss was caught since the last call)."""
+        prev, self._last_seen = self._last_seen, {
+            "n_devices": int(n_devices),
+            "bucket_bytes": (int(bucket_bytes)
+                             if bucket_bytes is not None else None)}
+        try:  # shared registry backs the live /metrics endpoint
+            from bigdl_tpu.obs.metrics import get_registry
+            get_registry().gauge(
+                "elastic_devices",
+                "devices in the current elastic mesh").set(int(n_devices))
+        except Exception:
+            pass  # observability must never break recovery
+        if self._pending_loss is None or prev is None:
+            return
+        ev = {"event": "reshape",
+              "from_devices": prev["n_devices"],
+              "to_devices": int(n_devices),
+              "restore_ms": (round(float(restore_ms), 3)
+                             if restore_ms is not None else None),
+              "bucket_bytes_before": prev["bucket_bytes"],
+              "bucket_bytes_after": self._last_seen["bucket_bytes"]}
+        self.reshapes.append(ev)
+        self.events.append(dict(ev))
+        self._pending_loss = None
+        try:
+            from bigdl_tpu.obs.metrics import get_registry
+            get_registry().counter(
+                "elastic_reshapes_total",
+                "mesh re-formations after device loss").inc()
+        except Exception:
+            pass
+        logger.info("elastic[%s]: reshaped %d -> %d devices "
+                    "(restore %.1f ms, bucket %s -> %s)", self.name,
+                    ev["from_devices"], ev["to_devices"],
+                    ev["restore_ms"] or 0.0, ev["bucket_bytes_before"],
+                    ev["bucket_bytes_after"])
+
+    # ------------------------------------------------------------------ run
+    def run(self, attempt_fn):
+        def wrapped(attempt: int):
+            try:
+                return attempt_fn(attempt)
+            except DeviceLossFault as e:
+                self._pending_loss = str(e)
+                raise
+
+        return super().run(wrapped)
+
+    # ------------------------------------------------------------ reporting
+    def reshape_annotation(self) -> Optional[dict]:
+        """The ``reshape`` dict for the perf JSON line: the most recent
+        reshape plus the total count — None when the topology never
+        changed (schema-stable null column)."""
+        if not self.reshapes:
+            return None
+        last = {k: v for k, v in self.reshapes[-1].items() if k != "event"}
+        last["count"] = len(self.reshapes)
+        return last
+
+    def annotation(self) -> dict:
+        out = super().annotation()
+        out["reshapes"] = len(self.reshapes)
+        out["min_devices"] = self.min_devices
+        out["batch_policy"] = self.batch_policy
+        return out
+
+
+class ElasticDataParallel(DataParallel):
+    """:class:`DataParallel` whose batch placement tolerates a global
+    batch that no longer divides the (post-loss) device count.
+
+    ``hold`` keeps the global batch: rows are padded with wrap-around
+    copies of leading rows up to the next multiple of the data-axis
+    size — every real example still contributes, at the cost of a few
+    duplicated rows in the batch mean. ``scale`` keeps the per-device
+    batch: trailing rows are trimmed down to divisibility. Both are
+    identity when the batch already divides, so at full topology this
+    class is bit-identical to :class:`DataParallel`.
+    """
+
+    def __init__(self, mesh=None, axis: str = "data",
+                 batch_policy: str = "hold", **kwargs):
+        if batch_policy not in ELASTIC_POLICIES:
+            raise ValueError(
+                f"unknown --elastic policy {batch_policy!r} "
+                f"(choices: {', '.join(ELASTIC_POLICIES)})")
+        super().__init__(mesh, axis, **kwargs)
+        self.batch_policy = batch_policy
+
+    def _fit_rows(self, arr):
+        n = int(self.mesh.shape[self.axis])
+        b = int(arr.shape[0])
+        if n <= 1 or b % n == 0:
+            return arr
+        if self.batch_policy == "hold":
+            per = -(-b // n)  # ceil
+            idx = np.arange(per * n - b) % b
+            return np.concatenate([arr, arr[idx]], axis=0)
+        keep = (b // n) * n
+        if keep == 0:
+            raise ValueError(
+                f"batch of {b} rows cannot be scaled onto {n} "
+                f"devices (fewer rows than devices)")
+        return arr[:keep]
+
+    def shard_batch(self, x, y):
+        return super().shard_batch(self._fit_rows(np.asarray(x)),
+                                   self._fit_rows(np.asarray(y)))

@@ -40,7 +40,12 @@ Kinds:
 * ``stall``        — sleeps ``arg`` seconds (slow-step straggler);
 * ``worker_kill``  — raises :class:`WorkerKillFault`
   (``worker_fatal=True``): serving worker threads treat it as fatal and
-  die, exercising the dead-worker fast-fail + watchdog path.
+  die, exercising the dead-worker fast-fail + watchdog path;
+* ``kill_device``  — marks ``arg`` devices (default 1, taken from the
+  tail of the healthy roster) as LOST process-wide and raises
+  :class:`DeviceLossFault`. Only an ``ElasticSupervisor`` treats it as
+  retryable — recovery means re-forming the mesh at the surviving count
+  from :func:`healthy_devices`, not restarting the same topology.
 
 Everything is a no-op unless a plan is installed (``install_plan``); the
 inactive hook is one global load and a ``None`` check, cheap enough to
@@ -63,15 +68,17 @@ from typing import Callable, Dict, List, Optional, Sequence
 
 __all__ = [
     "FAULT_SITES", "FAULT_KINDS", "PREEMPT_RC", "ChecksumError",
-    "FaultPlan", "FaultRule", "FaultInjector", "SimulatedPreemption",
-    "TransientFault", "WorkerKillFault", "active", "clear_plan", "hook",
-    "injected_events", "install_plan", "parse_plan", "post_write_hook",
+    "DeviceLossFault", "FaultPlan", "FaultRule", "FaultInjector",
+    "SimulatedPreemption", "TransientFault", "WorkerKillFault", "active",
+    "clear_plan", "healthy_devices", "hook", "injected_events",
+    "install_plan", "lost_device_ids", "parse_plan", "post_write_hook",
+    "restore_devices",
 ]
 
 FAULT_SITES = ("data", "step", "ckpt_save", "ckpt_restore", "infer",
                "request")
 FAULT_KINDS = ("preempt", "preempt_soft", "dispatch", "io", "corrupt",
-               "stall", "worker_kill")
+               "stall", "worker_kill", "kill_device")
 
 # EX_TEMPFAIL: the rc a simulated preemption dies with — supervising
 # parents treat exactly this as "retry with resume" (a real crash keeps
@@ -102,6 +109,41 @@ class ChecksumError(ValueError):
     or bit-rot). Defined here — next to the fault that causes it — so
     ``utils/file`` and the supervisor's retryable set share one type
     without an import cycle."""
+
+
+class DeviceLossFault(RuntimeError):
+    """Simulated loss of one or more devices (ICI link drop, host
+    eviction from a pod). RETRYABLE only under an ``ElasticSupervisor``
+    — the plain PR 6 supervisor would rebuild the same mesh and trip
+    over the missing devices again, so it does NOT list this type. The
+    injector marks the victims in :data:`_LOST_DEVICE_IDS` before
+    raising; :func:`healthy_devices` is the survivors' roster every
+    elastic rebuild reads."""
+
+
+# ids of devices the kill_device fault has "lost" in this process — jax
+# can't actually detach a CPU device, so elasticity is simulated by
+# making every mesh builder go through healthy_devices() instead of
+# jax.devices(). clear_plan() heals them: no plan, no simulated losses.
+_LOST_DEVICE_IDS: set = set()
+
+
+def lost_device_ids() -> set:
+    return set(_LOST_DEVICE_IDS)
+
+
+def healthy_devices() -> list:
+    """The devices still usable after injected losses, in jax.devices()
+    order — the roster elastic mesh re-formation builds from."""
+    import jax
+
+    return [d for d in jax.devices() if d.id not in _LOST_DEVICE_IDS]
+
+
+def restore_devices() -> None:
+    """Forget all simulated device losses (tests; also part of
+    :func:`clear_plan`)."""
+    _LOST_DEVICE_IDS.clear()
 
 
 def _u01(seed: int, tag: str, n: int) -> float:
@@ -305,6 +347,22 @@ class FaultInjector:
             self._record(site, n, rule, "raise WorkerKillFault")
             raise WorkerKillFault(
                 f"injected worker-fatal failure at {site} visit {n}")
+        if kind == "kill_device":
+            k = int(rule.arg or 1)
+            import jax
+
+            alive = [d for d in jax.devices()
+                     if d.id not in _LOST_DEVICE_IDS]
+            victims = alive[-k:] if 0 < k < len(alive) else alive[1:]
+            for d in victims:
+                _LOST_DEVICE_IDS.add(d.id)
+            survivors = len(alive) - len(victims)
+            self._record(site, n, rule,
+                         f"kill {len(victims)} device(s) -> "
+                         f"{survivors} healthy")
+            raise DeviceLossFault(
+                f"injected loss of {len(victims)} device(s) at {site} "
+                f"visit {n}; {survivors} healthy device(s) remain")
         if kind == "stall":
             secs = float(rule.arg or 0.1)
             self._record(site, n, rule, f"stall {secs}s")
@@ -340,6 +398,7 @@ def install_plan(plan: FaultPlan, *, log_path: Optional[str] = None
 def clear_plan() -> None:
     global _ACTIVE
     _ACTIVE = None
+    restore_devices()  # no plan, no simulated device losses
 
 
 def active() -> Optional[FaultInjector]:

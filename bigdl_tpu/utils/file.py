@@ -19,10 +19,11 @@ from __future__ import annotations
 
 import hashlib
 import io
+import json
 import logging
 import os
 import pickle
-from typing import Any
+from typing import Any, Optional
 
 import jax
 import numpy as np
@@ -37,16 +38,30 @@ logger = logging.getLogger("bigdl_tpu")
 __all__ = ["save_pytree", "load_pytree", "latest_checkpoint", "is_remote",
            "isdir", "exists", "ChecksumError", "checksum_path",
            "verify_checkpoint", "latest_valid_checkpoint_pair",
-           "gc_checkpoints"]
+           "gc_checkpoints", "manifest_path", "read_manifest",
+           "verify_manifest", "restore_resharded"]
 
 # every save_pytree/save_module writes `<path>.sha256` next to the blob;
 # load verifies it, so a torn-then-renamed or bit-rotted checkpoint is
 # caught at restore (ChecksumError) instead of producing silent garbage
 CHECKSUM_SUFFIX = ".sha256"
 
+# topology manifest (ISSUE 11): `<path>.manifest.json` records the
+# LOGICAL (unsharded) leaf shapes/dtypes plus the dp layout signature the
+# writer ran under. Blobs already hold gathered host arrays, so the
+# manifest is what lets `restore_resharded` place a checkpoint written at
+# 8 devices into a 7- or 4-device mesh with shape validation instead of
+# trust. Version bumps invalidate parsing, never the blob.
+MANIFEST_SUFFIX = ".manifest.json"
+MANIFEST_VERSION = 1
+
 
 def checksum_path(path: str) -> str:
     return path + CHECKSUM_SUFFIX
+
+
+def manifest_path(path: str) -> str:
+    return path + MANIFEST_SUFFIX
 
 
 def _file_sha256(path: str) -> str:
@@ -99,13 +114,41 @@ def _fs_for(path: str):
     return fsspec.core.url_to_fs(path)  # (fs, stripped_path)
 
 
-def save_pytree(tree: Any, path: str) -> None:
+def _write_manifest(path: str, arrays, layout: Optional[dict]) -> None:
+    """Topology manifest sidecar: logical leaf shapes/dtypes + the
+    writer's dp layout signature. Written LAST (after blob + checksum),
+    so its presence implies a complete pair; like the sidecar, local
+    writes go through tmp + rename so readers only ever see whole JSON
+    or nothing — a torn write truncates mid-document and fails to
+    parse, which the pair scan treats like a torn blob."""
+    doc = {"version": MANIFEST_VERSION,
+           "n_leaves": len(arrays),
+           "leaves": [{"shape": list(a.shape), "dtype": str(a.dtype)}
+                      for a in arrays],
+           "layout": layout}
+    body = json.dumps(doc, sort_keys=True)
+    if is_remote(path):
+        fs, p = _fs_for(path)
+        with fs.open(p + MANIFEST_SUFFIX, "w") as f:
+            f.write(body)
+        return
+    tmp = manifest_path(path) + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(body)
+    os.replace(tmp, manifest_path(path))
+
+
+def save_pytree(tree: Any, path: str, layout: Optional[dict] = None) -> None:
     """Write a pytree of arrays to ``path`` (.npz + embedded treedef)
-    plus a ``<path>.sha256`` checksum sidecar. Local writes are atomic
+    plus a ``<path>.sha256`` checksum sidecar and a
+    ``<path>.manifest.json`` topology manifest. Local writes are atomic
     (tmp + rename, sidecar written AFTER the blob so a sidecar's
     presence implies a complete blob existed); remote writes are single
     puts (object stores don't expose rename, but puts are
-    all-or-nothing)."""
+    all-or-nothing). ``layout`` is the writer's dp layout signature
+    (``DataParallel.layout_signature()``) — recorded for provenance
+    only; the blob always holds logical (gathered, unsharded) arrays, so
+    ``restore_resharded`` can place it into any mesh."""
     _fault_hook("ckpt_save")
     leaves, treedef = jax.tree_util.tree_flatten(tree)
     arrays = {f"leaf_{i}": np.asarray(x) for i, x in enumerate(leaves)}
@@ -121,6 +164,8 @@ def save_pytree(tree: Any, path: str) -> None:
         with fs.open(p, "wb") as f:
             f.write(payload.getbuffer())
         _write_sidecar(path, hashlib.sha256(payload.getbuffer()).hexdigest())
+        _write_manifest(path, [arrays[f"leaf_{i}"]
+                               for i in range(len(leaves))], layout)
         _post_write_hook("ckpt_save", path)
         return
     # local: stream straight to the tmp file (no in-RAM archive copy —
@@ -132,6 +177,8 @@ def save_pytree(tree: Any, path: str) -> None:
     digest = _file_sha256(tmp)
     os.replace(tmp, path)
     _write_sidecar(path, digest)
+    _write_manifest(path, [arrays[f"leaf_{i}"]
+                           for i in range(len(leaves))], layout)
     _post_write_hook("ckpt_save", path)
 
 
@@ -205,7 +252,96 @@ def verify_checkpoint(path: str) -> bool:
         return False
 
 
-def save_module(module, params, mod_state, path: str) -> None:
+def read_manifest(path: str) -> Optional[dict]:
+    """The topology manifest for blob ``path``, or None when no manifest
+    exists (pre-ISSUE-11 snapshots stay loadable — they just carry no
+    layout provenance). A present-but-unparseable manifest raises
+    :class:`ChecksumError`: a torn manifest is a torn artifact."""
+    mp = manifest_path(path)
+    try:
+        if is_remote(path):
+            fs, p = _fs_for(path)
+            if not fs.exists(p + MANIFEST_SUFFIX):
+                return None
+            with fs.open(p + MANIFEST_SUFFIX, "r") as f:
+                body = f.read()
+        else:
+            if not os.path.exists(mp):
+                return None
+            with open(mp) as f:
+                body = f.read()
+    except OSError as e:
+        raise ChecksumError(f"{mp}: unreadable manifest: {e}") from None
+    try:
+        doc = json.loads(body)
+        if not isinstance(doc, dict) or "version" not in doc:
+            raise ValueError("not a manifest document")
+    except ValueError as e:
+        raise ChecksumError(
+            f"{mp}: torn or corrupt topology manifest ({e})") from None
+    return doc
+
+
+def verify_manifest(path: str) -> bool:
+    """True when blob ``path``'s manifest is absent (legacy) or parses
+    cleanly — the manifest leg of pair validation, mirroring
+    :func:`verify_checkpoint` for blobs."""
+    try:
+        read_manifest(path)
+        return True
+    except ChecksumError:
+        return False
+
+
+def restore_resharded(path: str, mesh, axis: str = "data",
+                      zero1: bool = True, verify: bool = True):
+    """Load the checkpoint blob at ``path`` — written under ANY dp
+    topology — and place its leaves into ``mesh`` (built by
+    ``parallel/mesh.make_mesh``), resharding optimizer state for the
+    current device count.
+
+    Blobs hold logical (gathered, unsharded) host arrays, so resharding
+    is a placement decision, not a data transform: with ``zero1`` each
+    leaf goes through the same ``_zero1_spec`` rule ``DataParallel``
+    shards live optimizer state with (largest dim divisible by the axis
+    size, else replicate), otherwise everything is fully replicated.
+    When a manifest exists its logical shapes are validated against the
+    loaded leaves first — a blob/manifest mismatch raises
+    :class:`ChecksumError` rather than silently placing wrong shapes."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from bigdl_tpu.parallel.data_parallel import _zero1_spec
+
+    tree = load_pytree(path, verify=verify)
+    man = read_manifest(path)
+    if man is not None:
+        leaves = jax.tree_util.tree_leaves(tree)
+        recorded = man.get("leaves") or []
+        if man.get("n_leaves") != len(leaves) or len(recorded) != len(leaves):
+            raise ChecksumError(
+                f"{path}: manifest records {man.get('n_leaves')} leaves, "
+                f"blob holds {len(leaves)}")
+        for i, (leaf, rec) in enumerate(zip(leaves, recorded)):
+            got = list(np.shape(leaf))
+            want = list(rec.get("shape", []))
+            if got != want:
+                raise ChecksumError(
+                    f"{path}: leaf {i} logical shape {got} != manifest "
+                    f"{want} — blob and manifest disagree")
+
+    def _place(x):
+        arr = np.asarray(x)
+        if zero1 and arr.ndim > 0:
+            spec = _zero1_spec(arr, mesh, axis)
+        else:
+            spec = PartitionSpec()
+        return jax.device_put(arr, NamedSharding(mesh, spec))
+
+    return jax.tree_util.tree_map(_place, tree)
+
+
+def save_module(module, params, mod_state, path: str,
+                layout: Optional[dict] = None) -> None:
     """Whole-model file: the module DEFINITION (pickled — modules are
     plain Python descriptions with no arrays inside) plus its
     params/mod_state pytrees, in one artifact — the analog of the
@@ -216,7 +352,7 @@ def save_module(module, params, mod_state, path: str) -> None:
     blob = {"params": params, "mod_state": mod_state,
             "__module__": np.frombuffer(pickle.dumps(module),
                                         dtype=np.uint8)}
-    save_pytree(blob, path)
+    save_pytree(blob, path, layout=layout)
 
 
 def load_module(path: str):
@@ -334,11 +470,12 @@ def latest_valid_checkpoint_pair(directory: str):
               & _snapshot_indices(names, "state."))
     for n in sorted(common, reverse=True):
         m, s = join(f"model.{n}"), join(f"state.{n}")
-        if verify_checkpoint(m) and verify_checkpoint(s):
+        if (verify_checkpoint(m) and verify_checkpoint(s)
+                and verify_manifest(m) and verify_manifest(s)):
             return m, s
-        logger.warning("checkpoint pair %d in %s fails checksum "
-                       "verification — falling back to the previous "
-                       "snapshot", n, directory)
+        logger.warning("checkpoint pair %d in %s fails checksum or "
+                       "manifest verification — falling back to the "
+                       "previous snapshot", n, directory)
     return None, None
 
 
@@ -369,8 +506,12 @@ def gc_checkpoints(directory: str, keep_last: int,
         for prefix in prefixes:
             if n not in _snapshot_indices(names, prefix):
                 continue
+            # a blob's sidecar AND manifest leave with it — never before
+            # (a surviving pair keeps its manifest), never after (no
+            # orphaned manifests describing deleted blobs)
             for path in (join(f"{prefix}{n}"),
-                         join(f"{prefix}{n}") + CHECKSUM_SUFFIX):
+                         join(f"{prefix}{n}") + CHECKSUM_SUFFIX,
+                         join(f"{prefix}{n}") + MANIFEST_SUFFIX):
                 try:
                     if remote:
                         fs, p = _fs_for(path)

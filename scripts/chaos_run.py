@@ -12,6 +12,12 @@ checksum-verified newest-valid-pair resume -> step-equivalent replay.
     # kill mid-checkpoint too (torn pair -> fallback to previous)
     python scripts/chaos_run.py --kills 1 --kill-in-ckpt --platform cpu
 
+    # ISSUE 11: elastic reshape — lose 1 of 8 virtual CPU devices
+    # before step 5, assert the run FINISHES AT 7 with loss within
+    # tolerance of the uninterrupted 8-device run and the reshape
+    # event stamped in the child's perf JSON + fault log
+    python scripts/chaos_run.py --kill-device 1@5 --platform cpu
+
 The trainee (``--worker`` mode, same file) is a deterministic tiny
 model with Dropout — rng-SENSITIVE on purpose, so a resume that
 replayed the wrong key stream would diverge measurably, not silently.
@@ -81,6 +87,113 @@ def worker_main(args) -> int:
     return 0
 
 
+# ------------------------------------------------- elastic kill-device mode
+def _kill_device_mode(args, wd: str) -> int:
+    """``--kill-device N@STEP``: run the perf harness under --elastic on
+    8 virtual CPU devices, fire the kill_device fault at STEP, and assert
+    the run finishes on the surviving count with loss within --tolerance
+    of an uninterrupted 8-device run, the reshape dict in its JSON line,
+    and the kill_device event in the fault log."""
+    import subprocess
+
+    try:
+        n_kill_s, _, step_s = args.killDevice.partition("@")
+        n_kill, step = int(n_kill_s), int(step_s)
+        if n_kill < 1 or step < 1:
+            raise ValueError
+    except ValueError:
+        print(f"chaos: bad --kill-device {args.killDevice!r} "
+              "(expected N@STEP, e.g. 1@5)", flush=True)
+        return 2
+
+    n_devices = 8
+    fault_log = os.path.join(wd, "faults.jsonl")
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                        + " --xla_force_host_platform_device_count="
+                        + str(n_devices)).strip()
+    env["BIGDL_FAULT_LOG"] = fault_log
+    base = [sys.executable, "-m", "bigdl_tpu.cli.perf", "-m", "lenet5",
+            "-b", "16", "-i", str(args.maxIt), "--strategy", "dp",
+            # constant data + f32: hold-padding duplicates identical
+            # rows, so the post-reshape loss stays comparable to the
+            # uninterrupted run within a tight tolerance
+            "--dataType", "constant", "--f32"]
+    if args.platform:
+        base += ["--platform", args.platform]
+        env["JAX_PLATFORMS"] = args.platform
+
+    def _perf(cmd, tag):
+        out_path = os.path.join(wd, f"{tag}.json")
+        with open(out_path, "w") as f:
+            rc = subprocess.call(cmd, env=env, stdout=f)
+        if rc != 0:
+            print(f"chaos: {tag} perf run failed rc={rc}", flush=True)
+            return rc, None
+        with open(out_path) as f:
+            lines = [ln for ln in f.read().splitlines() if ln.strip()]
+        return 0, json.loads(lines[-1])
+
+    print(f"chaos: kill-device mode — lose {n_kill} of {n_devices} "
+          f"device(s) before step {step}, max_it={args.maxIt}, "
+          f"workdir={wd}", flush=True)
+    rc, ref = _perf(base, "ref")
+    if rc != 0:
+        return 2
+    elastic_cmd = base + ["--elastic", "hold",
+                          "--minDevices", str(args.minDevices),
+                          "--faultPlan",
+                          f"kill_device@step:{step}:{n_kill}"]
+    rc, el = _perf(elastic_cmd, "elastic")
+    if rc != 0:
+        return 2
+
+    kill_events = []
+    if os.path.exists(fault_log):
+        with open(fault_log) as f:
+            kill_events = [e for e in (json.loads(ln) for ln in f
+                                       if ln.strip())
+                           if e.get("fault") == "kill_device"]
+
+    surviving = n_devices - n_kill
+    reshape = el.get("reshape")
+    rel = (abs(el["final_loss"] - ref["final_loss"])
+           / max(abs(ref["final_loss"]), 1e-9))
+    checks = {
+        "finished_at_surviving_count": el["n_devices"] == surviving,
+        "reshape_stamped": bool(
+            reshape and reshape.get("from_devices") == n_devices
+            and reshape.get("to_devices") == surviving
+            and reshape.get("restore_ms") is not None),
+        "kill_logged": len(kill_events) >= 1,
+        "loss_within_tolerance": rel <= args.tolerance,
+        "supervised_retry_recorded": bool(
+            el.get("supervisor", {}).get("retries", 0) >= 1),
+    }
+    out = {
+        "chaos": "kill_device_reshape",
+        "kill": f"{n_kill}@{step}",
+        "devices": {"before": n_devices, "after": el["n_devices"]},
+        "reshape": reshape,
+        "ref_loss": ref["final_loss"],
+        "elastic_loss": el["final_loss"],
+        "rel_loss_delta": round(rel, 6),
+        "tolerance": args.tolerance,
+        "fault_events": kill_events,
+        "checks": checks,
+    }
+    print(json.dumps(out), flush=True)
+    if not all(checks.values()):
+        failed = sorted(k for k, v in checks.items() if not v)
+        print(f"chaos: FAILED ({', '.join(failed)})", flush=True)
+        return 1
+    print(f"chaos: OK — lost {n_kill} device(s) at step {step}, run "
+          f"finished at {surviving} devices, loss delta "
+          f"{rel * 100:.2f}% <= {args.tolerance * 100:.0f}%, reshape "
+          "stamped in perf JSON + fault log", flush=True)
+    return 0
+
+
 # ------------------------------------------------------------------ parent
 def _resumed_iteration(ckpt_dir: str) -> int:
     """Mirror Optimizer.resume's selection exactly (valid pair, else a
@@ -135,6 +248,19 @@ def main(argv=None) -> int:
     p.add_argument("--ckpt-every", dest="ckptEvery", type=int, default=3)
     p.add_argument("--budget", type=int, default=8,
                    help="restart budget for the supervising parent")
+    p.add_argument("--kill-device", dest="killDevice", nargs="?",
+                   const="1@5", default=None, metavar="N@STEP",
+                   help="elastic mode: lose N of 8 virtual devices at "
+                        "STEP and assert the run finishes on the "
+                        "survivors with the reshape stamped (default "
+                        "1@5)")
+    p.add_argument("--tolerance", type=float, default=0.05,
+                   help="kill-device mode: max relative final-loss "
+                        "delta vs the uninterrupted run")
+    p.add_argument("--min-devices", dest="minDevices", type=int,
+                   default=4,
+                   help="kill-device mode: --minDevices for the "
+                        "elastic child")
     p.add_argument("--platform", default=None, choices=["cpu", "tpu"])
     p.add_argument("--workdir", default=None,
                    help="keep artifacts here instead of a fresh tempdir")
@@ -155,6 +281,8 @@ def main(argv=None) -> int:
 
     wd = args.workdir or tempfile.mkdtemp(prefix="chaos_")
     os.makedirs(wd, exist_ok=True)
+    if args.killDevice:
+        return _kill_device_mode(args, wd)
     if args.kill_steps:
         kills = sorted(int(t) for t in args.kill_steps.split(",") if t)
     else:

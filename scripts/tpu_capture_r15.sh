@@ -1,0 +1,95 @@
+#!/usr/bin/env bash
+# Round-15 capture: ISSUE 11 (elastic data-parallel training) chip
+# evidence. The reshape mechanism is CPU-verified end to end
+# (tests/test_elastic.py, the elastic-smoke CI job, chaos_run.py
+# --kill-device); what only hardware can tell us is (a) the real
+# restore_ms of a resharded resume — how long the 8->7 / 8->4 re-form
+# actually stalls the job on a slice where device_put crosses ICI,
+# (b) whether the reshaped run keeps useful throughput (the hold
+# policy's padded batch vs the scale policy's smaller one, against the
+# uninterrupted baseline), and (c) the grad-comm bucket bound the
+# autotuner re-resolves for the surviving count (per-n_devices cache
+# key — the 7-device decision is NOT the 8-device one). Each A/B leg
+# runs x3 reps so the §18.4 slots get medians. On a single-chip tunnel
+# every --strategy leg exits cleanly ("needs more than one device")
+# and the round costs minutes, not hours. Appends to $OUT, mirrored
+# into the repo per step.
+
+set -uo pipefail
+cd "$(dirname "$0")/.."
+OUT="${OUT:-/tmp/tpu_capture_r15.log}"
+REPO_LOG="${REPO_LOG:-TPU_CAPTURE_r15.log}"
+TRACE_ROOT="${TRACE_ROOT:-/tmp/elastic_r15}"
+trap 'cp -f "$OUT" "$REPO_LOG" 2>/dev/null || true' EXIT
+
+step() {
+  local name="$1" tmo="$2"; shift 2
+  echo "=== $name ($(date -u +%H:%M:%SZ))" | tee -a "$OUT"
+  timeout "$tmo" "$@" 2>&1 | tail -40 | tee -a "$OUT"
+  echo "=== end $name rc=$?" | tee -a "$OUT"
+  cp -f "$OUT" "$REPO_LOG" 2>/dev/null || true
+}
+
+# 0. the elastic + resilience tests on the bench env first
+step "pytest_elastic" 600 python -m pytest tests/test_elastic.py \
+  tests/test_resilience.py -q
+
+# 1. THE r15 table: uninterrupted baseline vs elastic kill/reshape A/B
+#    on dp, x3 reps each so PERF.md §18.4 gets medians. Every elastic
+#    line stamps the reshape dict (from/to devices, restore_ms, bucket
+#    bound before/after) next to throughput.
+for REP in 1 2 3; do
+  step "baseline_dp_r${REP}" 1800 python -m bigdl_tpu.cli.main perf \
+    -m resnet50 -b 128 -i 40 --strategy dp || true
+  for POL in hold scale; do
+    step "elastic_${POL}_8to7_r${REP}" 1800 python -m bigdl_tpu.cli.main \
+      perf -m resnet50 -b 128 -i 40 --strategy dp --elastic "$POL" \
+      --minDevices 4 --faultPlan "kill_device@step:20:1" || true
+  done
+  # the halved-slice leg: zero1 shards stay divisible at 4, so this
+  # exercises the reshard-to-shards path (7 degrades to replication)
+  step "elastic_hold_8to4_r${REP}" 1800 python -m bigdl_tpu.cli.main \
+    perf -m resnet50 -b 128 -i 40 --strategy dp --elastic hold \
+    --minDevices 4 --faultPlan "kill_device@step:20:4" || true
+done
+
+# 2. the LM leg (big-leaf gradient tree: the resharded restore moves a
+#    few large arrays instead of many small ones — opposite restore_ms
+#    economics)
+for REP in 1 2 3; do
+  step "elastic_lm_r${REP}" 1800 python -m bigdl_tpu.cli.main perf \
+    -m transformer_lm_1k_hd128 -b 8 -i 40 --strategy dp \
+    --elastic hold --minDevices 4 \
+    --faultPlan "kill_device@step:20:1" || true
+done
+
+# 3. per-n_devices bucket re-resolution on chip: measure at 8, then a
+#    reshaped run at 7 must consult the 7-device cache key (a miss ->
+#    its own measured pick, never the 8-device bound; the reshape dict's
+#    bucket_bytes_before/after makes the re-resolution visible)
+step "buckets_measure_8dev" 2400 python -m bigdl_tpu.cli.main perf \
+  -m resnet50 -b 128 -i 30 --strategy dp --gradCompress bf16 \
+  --gradBuckets auto --autotune measure || true
+step "elastic_buckets_reresolve" 2400 python -m bigdl_tpu.cli.main perf \
+  -m resnet50 -b 128 -i 40 --strategy dp --gradCompress bf16 \
+  --gradBuckets auto --autotune measure --elastic hold --minDevices 4 \
+  --faultPlan "kill_device@step:20:1" || true
+
+# 4. the still-unrun r14 multichip row folded in (§17.4's first two
+#    slots): compressed-vs-plain gradient all-reduce with attribution
+#    windows — one session captures both rounds' tables
+for REP in 1 2 3; do
+  for GC in off bf16; do
+    step "r14_ab_dp_${GC}_r${REP}" 1800 python -m bigdl_tpu.cli.main \
+      perf -m resnet50 -b 128 -i 30 --strategy dp --gradCompress "$GC" \
+      --obs --traceDir "$TRACE_ROOT/r14_dp_${GC}_r${REP}" \
+      --traceSteps 4@15 || true
+  done
+done
+step "r14_explain_dp_off" 600 python -m bigdl_tpu.cli.main explain \
+  "$TRACE_ROOT/r14_dp_off_r1/capture_15" --steps 4 || true
+step "r14_explain_dp_bf16" 600 python -m bigdl_tpu.cli.main explain \
+  "$TRACE_ROOT/r14_dp_bf16_r1/capture_15" --steps 4 || true
+
+# 5. summarize every JSON line in this log for PERF.md §18.4 / §17.4
+step "summarize" 300 python scripts/update_perf_from_capture.py "$OUT"

@@ -1,0 +1,337 @@
+"""Elastic data-parallel training (ISSUE 11): topology-independent
+checkpoints, mesh re-formation on device loss, per-topology grad-comm
+re-resolution, chaos-verified reshape.
+
+Runs on 8 virtual CPU devices (conftest forces
+``--xla_force_host_platform_device_count=8``), so 8/7/4-device meshes
+are all buildable in one process.
+"""
+
+import json
+import os
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+import jax
+
+from bigdl_tpu.parallel.mesh import make_mesh
+from bigdl_tpu.resilience import (ChecksumError, RetryPolicy,
+                                  SupervisorGaveUp, clear_plan,
+                                  healthy_devices, install_plan,
+                                  parse_plan)
+from bigdl_tpu.resilience.elastic import (ElasticDataParallel,
+                                          ElasticSupervisor)
+from bigdl_tpu.resilience.faults import hook
+from bigdl_tpu.utils.file import (gc_checkpoints,
+                                  latest_valid_checkpoint_pair,
+                                  manifest_path, read_manifest,
+                                  restore_resharded, save_pytree,
+                                  verify_manifest)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    clear_plan()
+    yield
+    clear_plan()
+
+
+def _mesh(k):
+    return make_mesh({"data": k}, devices=jax.devices()[:k])
+
+
+def _tree():
+    rs = np.random.RandomState(11)
+    return {"w": rs.randn(16, 24).astype(np.float32),
+            "b": rs.randn(24).astype(np.float32),
+            "step": np.float32(3.0)}
+
+
+# ----------------------------------------------------- topology manifests
+def test_manifest_written_and_read(tmp_path):
+    p = str(tmp_path / "model.3")
+    layout = {"strategy": "DataParallel", "axis": "data", "zero1": True,
+              "n_devices": 8, "mesh": {"data": 8}}
+    save_pytree(_tree(), p, layout=layout)
+    assert os.path.exists(manifest_path(p))
+    man = read_manifest(p)
+    assert man["version"] == 1
+    assert man["n_leaves"] == 3
+    # leaves are recorded in canonical pytree (sorted-key) order
+    assert [tuple(l["shape"]) for l in man["leaves"]] == \
+        [(24,), (), (16, 24)]
+    assert man["layout"] == layout
+    assert verify_manifest(p)
+
+
+def test_manifest_absent_is_legacy_valid(tmp_path):
+    p = str(tmp_path / "model.1")
+    save_pytree(_tree(), p)
+    os.remove(manifest_path(p))
+    assert read_manifest(p) is None
+    assert verify_manifest(p)  # pre-manifest snapshots stay loadable
+
+
+def test_torn_manifest_raises_and_fails_verify(tmp_path):
+    p = str(tmp_path / "model.1")
+    save_pytree(_tree(), p)
+    body = open(manifest_path(p)).read()
+    with open(manifest_path(p), "w") as f:
+        f.write(body[:len(body) // 2])  # torn mid-write
+    with pytest.raises(ChecksumError):
+        read_manifest(p)
+    assert not verify_manifest(p)
+
+
+def test_pair_scan_falls_back_past_torn_manifest(tmp_path):
+    d = str(tmp_path)
+    for n in (3, 6, 9):
+        save_pytree({"w": np.full(8, n)}, f"{d}/model.{n}")
+        save_pytree({"o": np.full(8, n)}, f"{d}/state.{n}")
+    with open(manifest_path(f"{d}/state.9"), "w") as f:
+        f.write('{"version"')  # torn manifest == torn artifact
+    m, s = latest_valid_checkpoint_pair(d)
+    assert m.endswith("model.6") and s.endswith("state.6")
+
+
+def test_gc_never_orphans_a_survivors_manifest(tmp_path):
+    d = str(tmp_path)
+    for n in (1, 2, 3):
+        save_pytree({"w": np.full(4, n)}, f"{d}/model.{n}")
+        save_pytree({"o": np.full(4, n)}, f"{d}/state.{n}")
+    gc_checkpoints(d, 1)
+    names = set(os.listdir(d))
+    assert "model.3.manifest.json" in names
+    assert "state.3.manifest.json" in names
+    assert not any(f.startswith(("model.1", "model.2", "state.1",
+                                 "state.2")) for f in names)
+
+
+# ------------------------------------------------------ resharded restore
+def test_restore_resharded_8_4_8_bit_identical(tmp_path):
+    """The tentpole acceptance: a blob written at 8 devices restores at
+    4, re-saves, and restores at 8 again — every leaf bit-identical to
+    the original, at every stop."""
+    p8 = str(tmp_path / "model.8dev")
+    tree = _tree()
+    save_pytree(tree, p8, layout={"n_devices": 8})
+
+    at4 = restore_resharded(p8, _mesh(4))
+    for k in tree:
+        np.testing.assert_array_equal(np.asarray(at4[k]), tree[k])
+
+    p4 = str(tmp_path / "model.4dev")
+    save_pytree({k: np.asarray(v) for k, v in at4.items()}, p4,
+                layout={"n_devices": 4})
+    at8 = restore_resharded(p4, _mesh(8))
+    for k in tree:
+        np.testing.assert_array_equal(np.asarray(at8[k]), tree[k])
+
+
+def test_restore_resharded_places_zero1_shards(tmp_path):
+    p = str(tmp_path / "model.1")
+    save_pytree(_tree(), p)
+    mesh = _mesh(4)
+    out = restore_resharded(p, mesh)
+    # w: (16, 24) -> largest dim divisible by 4 is 24 -> P(None, 'data')
+    spec = out["w"].sharding.spec
+    assert tuple(spec) == (None, "data")
+    # scalars replicate
+    assert tuple(out["step"].sharding.spec) == ()
+
+
+def test_restore_resharded_7_devices_degrades_to_replication(tmp_path):
+    """At a prime surviving count nothing divides — the zero1 rule
+    degrades to replication and the restore still succeeds."""
+    p = str(tmp_path / "model.1")
+    save_pytree(_tree(), p)
+    out = restore_resharded(p, _mesh(7))
+    assert tuple(out["w"].sharding.spec) == ()
+    np.testing.assert_array_equal(np.asarray(out["w"]), _tree()["w"])
+
+
+def test_restore_resharded_rejects_blob_manifest_mismatch(tmp_path):
+    p = str(tmp_path / "model.1")
+    save_pytree(_tree(), p)
+    man = json.load(open(manifest_path(p)))
+    man["leaves"][0]["shape"] = [999]
+    with open(manifest_path(p), "w") as f:
+        json.dump(man, f)
+    with pytest.raises(ChecksumError):
+        restore_resharded(p, _mesh(4), verify=False)
+
+
+# ------------------------------------------------- elastic batch policies
+def test_hold_pads_with_wraparound_rows():
+    dp = ElasticDataParallel(_mesh(7), batch_policy="hold")
+    x = np.arange(16, dtype=np.float32).reshape(16, 1)
+    fitted = dp._fit_rows(x)
+    assert fitted.shape[0] == 21  # next multiple of 7
+    np.testing.assert_array_equal(fitted[:16], x)
+    np.testing.assert_array_equal(fitted[16:], x[:5])  # wrap-around
+
+
+def test_scale_trims_to_divisibility():
+    dp = ElasticDataParallel(_mesh(7), batch_policy="scale")
+    x = np.arange(16, dtype=np.float32).reshape(16, 1)
+    fitted = dp._fit_rows(x)
+    assert fitted.shape[0] == 14
+    np.testing.assert_array_equal(fitted, x[:14])
+    with pytest.raises(ValueError):
+        dp._fit_rows(x[:3])  # fewer rows than devices
+
+
+def test_policies_are_identity_when_divisible():
+    for pol in ("hold", "scale"):
+        dp = ElasticDataParallel(_mesh(4), batch_policy=pol)
+        x = np.arange(16, dtype=np.float32).reshape(16, 1)
+        assert dp._fit_rows(x) is x
+
+
+def test_bad_policy_rejected():
+    with pytest.raises(ValueError):
+        ElasticDataParallel(_mesh(4), batch_policy="stretch")
+    with pytest.raises(ValueError):
+        ElasticSupervisor(batch_policy="stretch")
+    with pytest.raises(ValueError):
+        ElasticSupervisor(min_devices=0)
+
+
+# ------------------------------------------------------ elastic supervisor
+def test_supervisor_reshape_ledger_and_metrics():
+    from bigdl_tpu.obs.metrics import get_registry
+    reg = get_registry()
+    reshapes0 = reg.counter("elastic_reshapes_total", "").value
+    sup = ElasticSupervisor(RetryPolicy(budget=3, base_s=0.0, max_s=0.0),
+                            min_devices=4)
+    install_plan(parse_plan("kill_device@step:2:1"))
+    seen = []
+
+    def attempt(n):
+        devs = sup.probe()
+        sup.observe_topology(len(devs), bucket_bytes=1024 * (8 - n),
+                             restore_ms=12.5 if n else None)
+        seen.append(len(devs))
+        hook("step")
+        hook("step")  # visit 2 on attempt 0: device loss
+        return "done"
+
+    assert sup.run(attempt) == "done"
+    assert seen == [8, 7]
+    assert len(sup.reshapes) == 1
+    ev = sup.reshapes[0]
+    assert (ev["from_devices"], ev["to_devices"]) == (8, 7)
+    assert ev["restore_ms"] == 12.5
+    assert ev["bucket_bytes_before"] == 8192
+    assert ev["bucket_bytes_after"] == 7168
+    ann = sup.reshape_annotation()
+    assert ann["count"] == 1 and "event" not in ann
+    assert sup.annotation()["reshapes"] == 1
+    assert reg.counter("elastic_reshapes_total", "").value == reshapes0 + 1
+    assert reg.gauge("elastic_devices", "").value == 7
+
+
+def test_supervisor_gives_up_below_min_devices():
+    sup = ElasticSupervisor(RetryPolicy(budget=5, base_s=0.0, max_s=0.0),
+                            min_devices=6)
+    install_plan(parse_plan("kill_device@step:1:4"))
+
+    def attempt(n):
+        sup.probe()
+        hook("step")
+        return "done"
+
+    with pytest.raises(SupervisorGaveUp) as ei:
+        sup.run(attempt)
+    assert "minDevices" in str(ei.value)
+    # the give-up is clean: one loss, one probe rejection, budget unspent
+    assert sup.annotation()["retries"] < 5
+
+
+def test_no_reshape_event_without_device_loss():
+    sup = ElasticSupervisor(min_devices=1)
+    sup.observe_topology(8)
+    sup.observe_topology(8)
+    assert sup.reshapes == []
+    assert sup.reshape_annotation() is None
+
+
+# ---------------------------------- per-topology grad-comm re-resolution
+def test_bucket_bound_reresolved_per_device_count(tmp_path, monkeypatch):
+    """The autotune cache is keyed by n_devices: after a reshape the
+    fresh trace must pick up the NEW topology's cached decision, never
+    reuse the old bound."""
+    from bigdl_tpu import tuning
+    from bigdl_tpu.parallel.grad_comm import (GradCommConfig,
+                                              _resolve_bucket_bytes)
+    monkeypatch.setenv("BIGDL_TPU_AUTOTUNE_CACHE", str(tmp_path))
+    param_bytes = 32 * 2 ** 20  # 32 MiB of f32 gradient
+    try:
+        tuning.reset()
+        tuning.set_mode("cached")
+        cache = tuning.get_cache()
+        cache.put(tuning.make_key("grad_comm", param_mib=32, n_devices=8,
+                                  dtype="bfloat16"),
+                  {"config": {"bucket_bytes": 8 * 2 ** 20},
+                   "source": "measured"})
+        cache.put(tuning.make_key("grad_comm", param_mib=32, n_devices=7,
+                                  dtype="bfloat16"),
+                  {"config": {"bucket_bytes": 2 * 2 ** 20},
+                   "source": "measured"})
+        cfg = GradCommConfig(compress="bf16")
+        b8, src8 = _resolve_bucket_bytes(cfg, param_bytes, 8)
+        b7, src7 = _resolve_bucket_bytes(cfg, param_bytes, 7)
+        b4, src4 = _resolve_bucket_bytes(cfg, param_bytes, 4)
+        assert (b8, src8) == (8 * 2 ** 20, "autotune")
+        assert (b7, src7) == (2 * 2 ** 20, "autotune")  # its OWN decision
+        assert src4 == "autotune" and b4 == 4 * 2 ** 20  # miss -> default
+        # an explicit --gradBuckets bound still wins at any count
+        explicit = GradCommConfig(compress="bf16",
+                                  bucket_bytes=2 ** 20)
+        assert _resolve_bucket_bytes(explicit, param_bytes, 7) == \
+            (2 ** 20, "explicit")
+    finally:
+        tuning.reset()
+
+
+# ----------------------------------------------- end-to-end elastic train
+def test_run_optimize_elastic_survives_device_loss(tmp_path):
+    """The full CLI path: run_optimize under --elastic loses a device
+    mid-run, re-forms at 7, resumes from the checkpoint, and finishes
+    with a reshape recorded."""
+    from bigdl_tpu import nn
+    from bigdl_tpu.cli.common import run_optimize
+    from bigdl_tpu.dataset.dataset import BatchDataSet
+    from bigdl_tpu.optim import Optimizer, SGD, Trigger
+
+    rs = np.random.RandomState(0)
+    X = rs.randn(64, 8).astype(np.float32)
+    Y = rs.randint(0, 3, 64).astype(np.int32)
+    ckpt = str(tmp_path / "ck")
+
+    def make():
+        model = nn.Sequential(nn.Linear(8, 16), nn.ReLU(),
+                              nn.Linear(16, 3), nn.LogSoftMax())
+        n = len(healthy_devices())
+        strat = ElasticDataParallel(
+            make_mesh({"data": n}, devices=healthy_devices()),
+            batch_policy="hold")
+        opt = Optimizer(model, BatchDataSet(X, Y, 16),
+                        nn.ClassNLLCriterion(),
+                        optim_method=SGD(learning_rate=0.1),
+                        end_when=Trigger.max_iteration(10), seed=7,
+                        log_every=100, strategy=strat)
+        opt.set_checkpoint(Trigger.several_iteration(3), ckpt)
+        return opt
+
+    install_plan(parse_plan("kill_device@step:5:1"))
+    args = SimpleNamespace(supervise=None, elastic="hold", minDevices=4,
+                           checkpoint=ckpt, seed=7)
+    trained = run_optimize(make, args)
+    assert trained is not None
+    assert len(healthy_devices()) == 7  # loss happened, roster shrank
+    # every param leaf is finite after the resharded resume
+    for leaf in jax.tree_util.tree_leaves(trained.params):
+        assert np.isfinite(np.asarray(leaf)).all()

@@ -93,6 +93,26 @@ def test_injector_fires_at_exact_visit_and_logs():
     assert injected_events()[0]["fault"] == "dispatch"
 
 
+def test_kill_device_fires_once_and_heals_on_clear():
+    """kill_device shrinks healthy_devices() exactly once (visit
+    counters are monotonic across in-process retries) and clear_plan()
+    restores the full roster."""
+    from bigdl_tpu.resilience.faults import (DeviceLossFault,
+                                             healthy_devices)
+    import jax
+    total = len(jax.devices())
+    inj = install_plan(parse_plan("kill_device@step:2:1"))
+    hook("step")
+    with pytest.raises(DeviceLossFault):
+        hook("step")
+    assert len(healthy_devices()) == total - 1
+    hook("step")  # visit 3: rule already fired, no re-kill on retry
+    assert len(healthy_devices()) == total - 1
+    assert inj.events[0]["fault"] == "kill_device"
+    clear_plan()
+    assert len(healthy_devices()) == total
+
+
 def test_injector_log_file_written_before_acting(tmp_path):
     log = tmp_path / "faults.jsonl"
     install_plan(parse_plan("io@ckpt_save:1"), log_path=str(log))
@@ -200,8 +220,14 @@ def test_gc_keeps_newest_valid_pair(tmp_path):
         save_pytree({"o": np.full(2, n)}, f"{d}/state.{n}")
     corrupt_file(f"{d}/model.5")
     gc_checkpoints(d, 1)  # keep window = {5}, but 4 is the newest valid
-    left = {f for f in os.listdir(d) if not f.endswith(".sha256")}
+    left = {f for f in os.listdir(d)
+            if not f.endswith((".sha256", ".manifest.json"))}
     assert left == {"model.4", "state.4", "model.5", "state.5"}
+    # manifests ride with their blobs: survivors keep theirs, GC'd
+    # pairs lose theirs
+    manifests = {f for f in os.listdir(d) if f.endswith(".manifest.json")}
+    assert manifests == {f"{p}.{n}.manifest.json"
+                         for p in ("model", "state") for n in (4, 5)}
     m, _s = latest_valid_checkpoint_pair(d)
     assert m.endswith("model.4")
     with pytest.raises(ValueError):
