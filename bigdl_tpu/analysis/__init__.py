@@ -40,12 +40,13 @@ from bigdl_tpu.analysis.rules import (CATALOG, assert_blocks_tileable,
                                       check_block_padding,
                                       check_block_tiling, min_sublane,
                                       run_comm_rules, run_jaxpr_rules,
-                                      run_module_rules)
+                                      run_memory_rules, run_module_rules)
 
 __all__ = ["Finding", "Report", "SEVERITIES", "CATALOG",
            "check_block_tiling", "check_block_padding",
            "assert_blocks_tileable", "min_sublane",
            "run_jaxpr_rules", "run_module_rules", "run_comm_rules",
+           "run_memory_rules",
            "lint_fn", "trace_train_step", "lint_perf_model",
            "preflight_optimizer"]
 
@@ -184,6 +185,31 @@ def lint_perf_model(name: str, batch: int = 32, *, seq_len=None,
                                   is_lm=is_lm)
         run_jaxpr_rules(closed, report)
         _bn_fallback_rule(model, closed, report)
+    # HBM working-set rule (ISSUE 12): abstract plan over the same
+    # state pytrees the perf step would hold — argument-side categories
+    # only (no compilation), so "plan exceeds HBM" fires pre-compile
+    try:
+        from bigdl_tpu.obs import memory
+        from bigdl_tpu.optim import SGD
+
+        params = jax.eval_shape(model.init, jax.random.PRNGKey(0))
+        opt_state = jax.eval_shape(
+            SGD(learning_rate=0.01, momentum=0.9).init, params)
+        if is_lm:
+            x = jax.ShapeDtypeStruct((batch, *in_shape), jnp.int32)
+            y = jax.ShapeDtypeStruct((batch, *in_shape), jnp.int32)
+        else:
+            x = jax.ShapeDtypeStruct((batch, *in_shape), jnp.float32)
+            y = jax.ShapeDtypeStruct((batch,), jnp.int32)
+        plan = memory.build_plan(params=params, opt_state=opt_state,
+                                 batch=(x, y), batch_size=batch,
+                                 model_name=name)
+        run_memory_rules(plan, report)
+    except Exception as e:
+        report.add(Finding(
+            rule="lint-trace-error", family="meta", severity="info",
+            message=f"memory rules skipped ({type(e).__name__}: {e})",
+            hint="the jaxpr/module rules still ran"))
     return report
 
 

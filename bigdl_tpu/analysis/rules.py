@@ -46,11 +46,12 @@ from bigdl_tpu.analysis.jaxpr_walk import (aval_bytes, consumers_map,
 from bigdl_tpu.analysis.report import Finding, Report
 
 __all__ = ["CATALOG", "run_jaxpr_rules", "run_module_rules",
-           "run_comm_rules",
+           "run_comm_rules", "run_memory_rules",
            "check_block_tiling", "check_block_padding",
            "assert_blocks_tileable", "min_sublane",
            "UPCAST_MIN_BYTES", "DONATE_MIN_BYTES", "VMEM_BUDGET_BYTES",
-           "COMM_F32_MIN_BYTES", "COMM_MAX_COLLECTIVES"]
+           "COMM_F32_MIN_BYTES", "COMM_MAX_COLLECTIVES",
+           "HBM_WARN_FRAC"]
 
 # rule id -> (family, severity, one-line catalog description)
 CATALOG: Dict[str, Tuple[str, str, str]] = {
@@ -139,6 +140,16 @@ CATALOG: Dict[str, Tuple[str, str, str]] = {
         "gradient reduction is per-leaf (>16 collectives in one step "
         "graph / unbucketed grad tree) — per-collective launch latency "
         "is paid per parameter instead of per dense bucket"),
+    "hbm-oversubscribed": (
+        "memory", "error",
+        "the compiled step's working set (obs/memory.build_plan) "
+        "exceeds the device HBM — the run will RESOURCE_EXHAUST on "
+        "first dispatch; caught pre-compile on CPU"),
+    "hbm-tight": (
+        "memory", "warning",
+        "the compiled step's working set is within 15% of the device "
+        "HBM — fragmentation or a live-buffer spike will tip it over "
+        "(obs/memory forecasts the max batch that still fits)"),
     "lint-trace-error": (
         "meta", "info",
         "the step could not be traced; only module-level rules ran"),
@@ -150,6 +161,7 @@ VMEM_BUDGET_BYTES = 16 * 1024 * 1024  # ~16 MB/core (pallas_guide.md)
 VMEM_WARN_FRAC = 0.8
 COMM_F32_MIN_BYTES = 1 * 1024 * 1024  # grad wire worth compressing
 COMM_MAX_COLLECTIVES = 16             # per-leaf-reduce smell threshold
+HBM_WARN_FRAC = 0.85                  # plan/HBM ratio that earns hbm-tight
 
 _SUBLANE = {4: 8, 2: 16, 1: 32}
 
@@ -540,6 +552,56 @@ def run_comm_rules(params, strategy: Optional[str],
                      f"{len(plan.buckets)} dense bucket(s)",
                 detail={"n_leaves": n_inexact,
                         "n_buckets": len(plan.buckets)}))
+    return report
+
+
+# ========================================================= memory rules
+def run_memory_rules(plan: Optional[dict],
+                     report: Optional[Report] = None) -> Report:
+    """HBM working-set rules over one memory plan (ISSUE 12): ``plan``
+    is an :func:`bigdl_tpu.obs.memory.build_plan` dict — built from
+    abstract pytrees + ``compiled.memory_analysis()``, so it is exact on
+    CPU before a chip is touched. Fires **error** when the plan's total
+    exceeds the device HBM (the run would RESOURCE_EXHAUST on first
+    dispatch) and **warning** above ``HBM_WARN_FRAC`` of capacity.
+    ``plan=None`` (plan construction failed) adds nothing."""
+    report = report if report is not None else Report()
+    if not plan:
+        return report
+    total = int(plan.get("total_bytes") or 0)
+    hbm = int(plan.get("hbm_bytes") or 0)
+    if not total or not hbm:
+        return report
+    frac = total / hbm
+    cats = plan.get("categories") or {}
+    top = sorted(cats.items(), key=lambda kv: -kv[1])[:3]
+    top_s = ", ".join(f"{k} {v / 2**20:.0f} MiB" for k, v in top)
+    where = (f"{plan.get('model') or 'step'} b={plan.get('batch')} on "
+             f"{plan.get('device') or 'device'}")
+    if frac > 1.0:
+        report.add(_finding(
+            "hbm-oversubscribed",
+            f"step working set {total / 2**30:.2f} GiB exceeds device "
+            f"HBM {hbm / 2**30:.1f} GiB ({frac * 100:.0f}%) — top: "
+            f"{top_s}",
+            where=where,
+            hint="shrink the batch (bigdl-tpu explain --mem predicts "
+                 "the max that fits), drop --optim momentum state, or "
+                 "shard the model (--strategy tp)",
+            detail={"total_bytes": total, "hbm_bytes": hbm,
+                    "frac": round(frac, 4),
+                    "categories": dict(cats)}))
+    elif frac > HBM_WARN_FRAC:
+        report.add(_finding(
+            "hbm-tight",
+            f"step working set {total / 2**30:.2f} GiB is "
+            f"{frac * 100:.0f}% of device HBM {hbm / 2**30:.1f} GiB "
+            f"(threshold {HBM_WARN_FRAC * 100:.0f}%) — top: {top_s}",
+            where=where,
+            hint="headroom this thin ooms on fragmentation; "
+                 "bigdl-tpu explain --mem forecasts the fit per batch",
+            detail={"total_bytes": total, "hbm_bytes": hbm,
+                    "frac": round(frac, 4)}))
     return report
 
 

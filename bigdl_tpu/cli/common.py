@@ -142,7 +142,11 @@ def add_obs_args(p: argparse.ArgumentParser) -> None:
     p.add_argument("--metricsPort", type=int, default=None, metavar="PORT",
                    help="start a live /metrics listener (serving's "
                         "Prometheus exposition format) for this "
-                        "training/perf run; 0 = ephemeral (printed)")
+                        "training/perf run; 0 = auto-pick a free port "
+                        "(printed and stamped into the perf JSON obs "
+                        "annotation). An explicit port that is already "
+                        "taken is a clean SystemExit, not a mid-run "
+                        "socket traceback")
 
 
 class ObsState:
@@ -158,6 +162,11 @@ class ObsState:
         self.trace_dir = trace_dir
         self.capture = capture
         self.server = server
+        # HBM attribution context (ISSUE 12): the harness installs its
+        # static memory plan post-compile and a live sampler; the perf
+        # JSON mem columns read from here
+        self.mem_plan: Optional[dict] = None
+        self.mem_sampler = None
         self._final: Optional[dict] = None
 
     def finalize(self) -> dict:
@@ -185,6 +194,11 @@ class ObsState:
                 info["trace_json"] = path
                 info["span_events"] = n
                 print(f"obs: wrote {n} span(s) to {path}", flush=True)
+        if self.server is not None:
+            # the bound (possibly auto-picked) port rides in the obs
+            # annotation so a log reader can find the scrape endpoint
+            info["metrics_port"] = self.server.port
+            info["metrics_url"] = self.server.url
         self._final = info
         return info
 
@@ -214,9 +228,15 @@ def install_observability(args) -> Optional[ObsState]:
                                             trace_steps=trace_steps)
         except ValueError as e:
             raise SystemExit(str(e))
+        # arm the OOM post-mortem (ISSUE 12): a RESOURCE_EXHAUSTED
+        # anywhere in this process now has a home for its MemoryReport
+        obs.memory.install(trace_dir=trace_dir)
     server = None
     if port is not None:
-        server = obs.start_metrics_server(obs.get_registry(), port=port)
+        # an explicit port the user asked for must bind or exit cleanly;
+        # 0 auto-picks (the MetricsServer resolves the ephemeral port)
+        server = obs.start_metrics_server(obs.get_registry(), port=port,
+                                          strict=(port != 0))
     state = ObsState(enabled, trace_dir, capture, server)
     args._obs = state
     return state

@@ -12,6 +12,14 @@ FLOPs numerator and mesh peak, so the table carries FLOP share and
 roofline utilization, not just times. Output: the per-category table
 (``utils/table``) with the collective breakout and MFU decomposition,
 or ``--json`` (one line, printed last — ``tail -1`` safe).
+
+``--mem`` (ISSUE 12) is the memory twin for a model target: no run —
+the training step is lowered+compiled at two batch sizes, the
+per-category byte plan of the exact step is rendered (totalling to
+``compiled.memory_analysis()``), and the linear per-sample fit predicts
+the max batch that still fits the device HBM:
+
+    bigdl-tpu explain --mem resnet50 -b 32
 """
 
 from __future__ import annotations
@@ -35,6 +43,12 @@ def main(argv=None):
                         "(runs a short profiled loop first)")
     p.add_argument("--json", action="store_true",
                    help="machine output (one JSON line, printed last)")
+    p.add_argument("--mem", action="store_true",
+                   help="memory mode (model target only): per-category "
+                        "HBM plan of the compiled training step, "
+                        "headroom against the device capacity, and the "
+                        "predicted max batch from a two-point "
+                        "per-sample fit — no training run")
     p.add_argument("-b", "--batchSize", type=int, default=16,
                    help="batch for model-mode runs")
     p.add_argument("-i", "--iteration", type=int, default=5,
@@ -66,6 +80,31 @@ def main(argv=None):
     apply_platform(args)
 
     from bigdl_tpu.obs import attrib
+
+    if args.mem:
+        # memory mode (ISSUE 12): two abstract plans -> category table
+        # + headroom + predicted max batch; no timed run
+        if os.path.isdir(args.target):
+            raise SystemExit(
+                "--mem explains a MODEL's memory plan (it compiles the "
+                "step); pass a perf model name, not a profile dir")
+        from bigdl_tpu.obs import memory
+        b = args.batchSize
+        plan = memory.plan_for_model(args.target, b, seq_len=args.seq)
+        plan2 = memory.plan_for_model(args.target, 2 * b,
+                                      seq_len=args.seq)
+        fc = memory.forecast(plan, plan2)
+        if args.json:
+            out = memory.compact(plan)
+            out["model"] = args.target
+            out["forecast"] = fc
+            out["plan_2x"] = memory.compact(plan2)
+            print(json.dumps(out))
+        else:
+            print(f"memory plan: {args.target} b={b} "
+                  f"({plan.get('device')})")
+            print(memory.render(plan, fc))
+        return 0
 
     if os.path.isdir(args.target):
         step_flops = args.gflops * 1e9 if args.gflops else None

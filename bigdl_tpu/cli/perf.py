@@ -246,6 +246,12 @@ _PHASE_COLUMNS = ("data_wait_s", "h2d_s", "dispatch_s", "device_s",
 # of device time, and the compact per-category attribution of the run's
 # LAST verified window.
 _ATTRIB_COLUMNS = ("collective_s", "collective_frac", "attrib")
+# ISSUE 12: the memory columns, schema-stable like the attrib columns —
+# null obs-off; under --obs the peak HBM bytes (live device.memory_stats
+# when the backend has them, else the static plan's modeled total), the
+# headroom fraction against the matched per-chip capacity, and the
+# compact per-category plan as the `mem` detail dict.
+_MEM_COLUMNS = ("hbm_peak_bytes", "hbm_headroom_frac", "mem")
 
 
 def _annotate_obs_phases(out: dict, obs_state, phase: dict | None = None,
@@ -260,6 +266,8 @@ def _annotate_obs_phases(out: dict, obs_state, phase: dict | None = None,
     window additionally fills the attribution columns (ISSUE 8)."""
     for c in _ATTRIB_COLUMNS:
         out[c] = None
+    for c in _MEM_COLUMNS:
+        out[c] = None
     on = (obs_state is not None and obs_state.enabled
           and phase is not None)
     if not on:
@@ -273,11 +281,30 @@ def _annotate_obs_phases(out: dict, obs_state, phase: dict | None = None,
     out["ckpt_s"] = round(phase.get("ckpt", 0.0), 4)
     out["stall_frac"] = (round(phase.get("data_wait", 0.0) / wall_s, 4)
                          if wall_s else None)
+    plan = getattr(obs_state, "mem_plan", None)
+    sampler = getattr(obs_state, "mem_sampler", None)
+    if plan is not None:
+        from bigdl_tpu.obs import memory as _mem
+        live_peak = (sampler.peak_bytes if sampler is not None else None)
+        peak = live_peak or plan["total_bytes"]
+        cap = plan["hbm_bytes"]
+        out["hbm_peak_bytes"] = int(peak)
+        out["hbm_headroom_frac"] = (round((cap - peak) / cap, 4)
+                                    if cap else None)
+        m = _mem.compact(plan)
+        m["source"] = "live" if live_peak else "plan"
+        live = (sampler.annotation() if sampler is not None else None)
+        if live:
+            m["live"] = live
+        out["mem"] = m
     info = obs_state.finalize()
     o: dict = {}
     if "trace_json" in info:
         o["trace_json"] = info["trace_json"]
         o["span_events"] = info["span_events"]
+    if "metrics_port" in info:  # the bound (or auto-picked) listener
+        o["metrics_port"] = info["metrics_port"]
+        o["metrics_url"] = info["metrics_url"]
     if "captures" in info:
         o["captures"] = [
             {k: c[k] for k in ("start_step", "stop_step", "trigger",
@@ -753,6 +780,7 @@ def _run_timed(model_name: str, batch: int, iterations: int, data_type: str,
     flops_hlo = 0.0
     n_dev = (int(np.prod(list(mesh_axes.values())))
              if mesh_axes is not None else 1)
+    compiled = None
     try:
         compiled = step.lower(params, mod_state, opt_state, x, y, k).compile()
         if inner_steps == 1:  # multi-step: while-body cost attribution is
@@ -790,11 +818,40 @@ def _run_timed(model_name: str, batch: int, iterations: int, data_type: str,
             # compressed wire — attribution records say so
             cap.grad_comm = strat.grad_comm_info()
 
-    params, mod_state, opt_state, loss = step(params, mod_state, opt_state,
-                                              x, y, k)
-    # scalar host transfer = true sync; on the tunneled (axon) platform
-    # block_until_ready was observed returning before execution finished
-    float(loss)  # compile + warmup
+    if obs_state is not None and obs_state.enabled:
+        # HBM attribution context (ISSUE 12): the static per-category
+        # plan of the exact compiled step + a live sampler, installed
+        # BEFORE the first execution so an OOM autopsy carries the plan
+        from bigdl_tpu.obs import memory as _mem
+        try:
+            mem_plan = _mem.build_plan(
+                compiled, params=params, opt_state=opt_state,
+                batch=(x, y),
+                grad_comm=(strat.grad_comm_info() if strat is not None
+                           else None),
+                device=jax.devices()[0], batch_size=batch,
+                model_name=model_name)
+            mem_sampler = _mem.HbmSampler()
+            obs_state.mem_plan = mem_plan
+            obs_state.mem_sampler = mem_sampler
+            _mem.install(plan=mem_plan, sampler=mem_sampler)
+        except Exception:  # the plan must never break the run it plans
+            pass
+
+    try:
+        params, mod_state, opt_state, loss = step(params, mod_state,
+                                                  opt_state, x, y, k)
+        # scalar host transfer = true sync; on the tunneled (axon)
+        # platform block_until_ready was observed returning before
+        # execution finished
+        float(loss)  # compile + warmup
+    except Exception as e:
+        # first execution is where a genuinely-too-big step dies —
+        # autopsy RESOURCE_EXHAUSTED (plan + live stats + top buffers
+        # to --traceDir) before re-raising, like any other crash
+        from bigdl_tpu.obs import memory as _mem
+        _mem.handle_oom(e, "perf_warmup")
+        raise
 
     if elastic is not None:
         # topology is live (mesh formed, step compiled, bucket bound
@@ -840,6 +897,7 @@ def _run_timed(model_name: str, batch: int, iterations: int, data_type: str,
             phase = {p: 0.0 for p in ("data_wait", "h2d", "dispatch",
                                       "device", "ckpt")}
             hists = phase_histograms(get_registry(), "train")
+            mem_sampler = getattr(obs_state, "mem_sampler", None)
             pc = time.perf_counter
 
             def _meter(name, t_start):
@@ -870,6 +928,10 @@ def _run_timed(model_name: str, batch: int, iterations: int, data_type: str,
                 with span("device"):
                     jax.block_until_ready(loss)
                 _meter("device", t)
+                if mem_sampler is not None:
+                    # live HBM gauges + Chrome-trace counter series (a
+                    # cheap None on backends without memory_stats)
+                    mem_sampler.sample(step=i)
             float(loss)
             reg = get_registry()
             for p_name, secs in phase.items():

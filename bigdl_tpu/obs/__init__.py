@@ -29,28 +29,36 @@ columns (``data_wait_s``, ``h2d_s``, ``dispatch_s``, ``device_s``,
 broken out) and 4 (feed-stall metering) read from this layer.
 """
 
-from bigdl_tpu.obs import attrib
+from bigdl_tpu.obs import attrib, memory
 from bigdl_tpu.obs.attrib import (ATTRIB_CATEGORIES, attribute,
                                   attribute_profile, classify_op)
 from bigdl_tpu.obs.capture import (CaptureController, parse_trace_steps,
                                    TOUCH_FILE_NAME)
 from bigdl_tpu.obs.http import MetricsServer, start_metrics_server
+from bigdl_tpu.obs.memory import (HbmSampler, build_plan,
+                                  device_hbm_bytes, forecast, handle_oom,
+                                  is_resource_exhausted, plan_for_model,
+                                  tree_bytes, write_oom_report)
 from bigdl_tpu.obs.metrics import (Counter, DEFAULT_LATENCY_BUCKETS_MS,
                                    Gauge, Histogram, MetricsRegistry,
                                    PHASE_BUCKETS_MS, TRAIN_PHASES,
                                    get_registry, phase_histograms,
                                    reset_registry, set_registry)
-from bigdl_tpu.obs.spans import (NOOP_SPAN, Tracer, disable, enable,
-                                 enabled, get_tracer, set_tracer, span)
+from bigdl_tpu.obs.spans import (NOOP_SPAN, Tracer, counter, disable,
+                                 enable, enabled, get_tracer, instant,
+                                 set_tracer, span)
 
 __all__ = [
     "attrib", "ATTRIB_CATEGORIES", "attribute", "attribute_profile",
     "classify_op",
     "CaptureController", "parse_trace_steps", "TOUCH_FILE_NAME",
     "MetricsServer", "start_metrics_server",
+    "memory", "HbmSampler", "build_plan", "device_hbm_bytes", "forecast",
+    "handle_oom", "is_resource_exhausted", "plan_for_model", "tree_bytes",
+    "write_oom_report",
     "Counter", "Gauge", "Histogram", "MetricsRegistry",
     "DEFAULT_LATENCY_BUCKETS_MS", "PHASE_BUCKETS_MS", "TRAIN_PHASES",
     "get_registry", "phase_histograms", "reset_registry", "set_registry",
-    "NOOP_SPAN", "Tracer", "disable", "enable", "enabled", "get_tracer",
-    "set_tracer", "span",
+    "NOOP_SPAN", "Tracer", "counter", "disable", "enable", "enabled",
+    "get_tracer", "instant", "set_tracer", "span",
 ]

@@ -83,14 +83,22 @@ class MetricsServer:
 
 
 def start_metrics_server(registry, port: int = 0,
-                         host: str = "127.0.0.1"
+                         host: str = "127.0.0.1", strict: bool = False
                          ) -> Optional[MetricsServer]:
-    """Start the listener (port 0 = ephemeral); returns None instead of
-    raising when the bind fails — observability must never kill the run
-    it observes."""
+    """Start the listener (port 0 = auto-pick a free port; the bound
+    port is printed and rides in the ObsState annotation). Default bind
+    failure is a warning returning None — observability must never kill
+    the run it observes — but ``strict`` (the CLI's explicit
+    ``--metricsPort N``) turns a taken port into a clean SystemExit
+    instead of a mid-run socket traceback (ISSUE 12 satellite)."""
     try:
         srv = MetricsServer(registry, host=host, port=port)
     except OSError as e:
+        if strict:
+            raise SystemExit(
+                f"--metricsPort {port}: cannot bind {host}:{port} ({e}); "
+                "pick another port or use --metricsPort 0 to auto-pick "
+                "a free one")
         logger.warning("obs metrics listener failed to bind %s:%d: %s",
                        host, port, e)
         return None

@@ -38,8 +38,8 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional
 
-__all__ = ["Tracer", "span", "enable", "disable", "enabled",
-           "get_tracer", "set_tracer"]
+__all__ = ["Tracer", "span", "instant", "counter", "enable", "disable",
+           "enabled", "get_tracer", "set_tracer"]
 
 
 class Tracer:
@@ -87,6 +87,30 @@ class Tracer:
             self._ring.append(ev)
             self._recorded += 1
 
+    def instant(self, name: str, args: Optional[dict] = None) -> None:
+        """A zero-duration marker on the timeline (Chrome-trace ``ph: i``
+        with global scope) — fault injections, elastic reshapes, OOMs
+        land as flags next to the step phases instead of only counting
+        in the registry (ISSUE 12 satellite)."""
+        ev = {"name": name, "ts": self.clock(), "dur": 0.0,
+              "tid": self._tid(), "depth": 0, "ph": "i"}
+        if args:
+            ev["args"] = args
+        with self._lock:
+            self._ring.append(ev)
+            self._recorded += 1
+
+    def counter(self, name: str, values: dict) -> None:
+        """A Chrome-trace counter sample (``ph: C``) — Perfetto renders
+        a series per key, so per-step HBM bytes plot over the same
+        timeline the spans live on."""
+        ev = {"name": name, "ts": self.clock(), "dur": 0.0,
+              "tid": self._tid(), "depth": 0, "ph": "C",
+              "args": dict(values)}
+        with self._lock:
+            self._ring.append(ev)
+            self._recorded += 1
+
     # ------------------------------------------------------------ snapshot
     def events(self) -> List[dict]:
         with self._lock:
@@ -111,16 +135,20 @@ class Tracer:
         pid = os.getpid()
         evs = []
         for e in self.events():
-            ev = {"name": e["name"], "cat": "bigdl", "ph": "X",
+            ph = e.get("ph", "X")
+            ev = {"name": e["name"], "cat": "bigdl", "ph": ph,
                   "ts": round(e["ts"] * 1e6, 3),
-                  "dur": round(e["dur"] * 1e6, 3),
                   "pid": pid, "tid": e["tid"]}
+            if ph == "X":
+                ev["dur"] = round(e["dur"] * 1e6, 3)
+            elif ph == "i":
+                ev["s"] = "g"  # global scope: a full-height flag
             if "args" in e:
                 ev["args"] = e["args"]
             evs.append(ev)
         # stable viewer ordering (and easier assertions): by ts, with
         # parents before their children at equal ts (larger dur first)
-        evs.sort(key=lambda ev: (ev["tid"], ev["ts"], -ev["dur"]))
+        evs.sort(key=lambda ev: (ev["tid"], ev["ts"], -ev.get("dur", 0.0)))
         return {"traceEvents": evs, "displayTimeUnit": "ms",
                 "otherData": {"dropped_events": self.dropped}}
 
@@ -190,6 +218,22 @@ def span(name: str, **args):
     if t is None:
         return NOOP_SPAN
     return _Span(t, name, args or None)
+
+
+def instant(name: str, **args) -> None:
+    """Module-level instant marker — same disabled-cost contract as
+    :func:`span` (one global load + ``None`` check, then nothing)."""
+    t = _TRACER
+    if t is not None:
+        t.instant(name, args or None)
+
+
+def counter(name: str, values: dict) -> None:
+    """Module-level counter sample — no-op unless a tracer is
+    installed."""
+    t = _TRACER
+    if t is not None:
+        t.counter(name, values)
 
 
 def enable(capacity: int = 65536,

@@ -669,10 +669,18 @@ class Optimizer:
                     # same host key sequence as K=1 (counted for resume)
                     keys = [_next_key() for _ in range(K)]
                     t_d = time.perf_counter()
-                    with _span("dispatch", steps=K):
-                        params, mod_state, opt_state, loss = chunk_fn(
-                            params, mod_state, opt_state, xs, ys,
-                            jnp.stack(keys))
+                    try:
+                        with _span("dispatch", steps=K):
+                            params, mod_state, opt_state, loss = chunk_fn(
+                                params, mod_state, opt_state, xs, ys,
+                                jnp.stack(keys))
+                    except Exception as e:
+                        # RESOURCE_EXHAUSTED autopsy (ISSUE 12): write
+                        # the MemoryReport to --traceDir + fault log,
+                        # then crash exactly as before
+                        from bigdl_tpu.obs import memory as _obs_mem
+                        _obs_mem.handle_oom(e, "train_dispatch")
+                        raise
                     self._obs_phase("dispatch", time.perf_counter() - t_d)
                     if obs_on:
                         # true device wait: only metered under --obs (the
@@ -710,9 +718,15 @@ class Optimizer:
                     self._obs_phase("h2d", time.perf_counter() - t_h)
                     k_step = _next_key()
                     t_d = time.perf_counter()
-                    with _span("dispatch"):
-                        params, mod_state, opt_state, loss = step_fn(
-                            params, mod_state, opt_state, x, y, k_step)
+                    try:
+                        with _span("dispatch"):
+                            params, mod_state, opt_state, loss = step_fn(
+                                params, mod_state, opt_state, x, y,
+                                k_step)
+                    except Exception as e:
+                        from bigdl_tpu.obs import memory as _obs_mem
+                        _obs_mem.handle_oom(e, "train_dispatch")
+                        raise
                     self._obs_phase("dispatch", time.perf_counter() - t_d)
                     if obs_on:
                         t_w = time.perf_counter()

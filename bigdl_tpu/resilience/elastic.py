@@ -143,6 +143,14 @@ class ElasticSupervisor(Supervisor):
                 "mesh re-formations after device loss").inc()
         except Exception:
             pass
+        try:  # span-timeline marker (ISSUE 12): the reshape shows up at
+            # its wall-clock position next to the step phases
+            from bigdl_tpu.obs.spans import instant
+            instant("reshape", from_devices=ev["from_devices"],
+                    to_devices=ev["to_devices"],
+                    restore_ms=ev["restore_ms"])
+        except Exception:
+            pass
         logger.info("elastic[%s]: reshaped %d -> %d devices "
                     "(restore %.1f ms, bucket %s -> %s)", self.name,
                     ev["from_devices"], ev["to_devices"],

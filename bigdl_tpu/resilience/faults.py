@@ -307,6 +307,13 @@ class FaultInjector:
                 "faults fired by the installed --faultPlan").inc()
         except Exception:
             pass  # observability must never change fault semantics
+        try:  # span-timeline marker (ISSUE 12): the injection shows up
+            # at its wall-clock position in the Chrome trace
+            from bigdl_tpu.obs.spans import instant
+            instant(f"fault:{rule.kind}", site=site, visit=visit,
+                    action=action)
+        except Exception:
+            pass
         if self.log_path:
             # append + close per event: survives os._exit on the next line
             with open(self.log_path, "a") as f:

@@ -32,6 +32,7 @@ tests/test_serving.py) because both run the same ``prefill_logits`` /
 from __future__ import annotations
 
 import collections
+import logging
 import threading
 from typing import Optional, Sequence
 
@@ -40,6 +41,8 @@ import numpy as np
 from bigdl_tpu.obs.spans import span as _obs_span
 from bigdl_tpu.serving.batcher import (AdmissionError, DeadlineExceeded,
                                        WorkerDied, _Future)
+
+logger = logging.getLogger(__name__)
 
 __all__ = ["DecodeEngine", "DecodeRequest"]
 
@@ -145,6 +148,21 @@ class DecodeEngine:
                 "lifetime generated_tokens_total / uptime",
                 fn=lambda: (self._m_tokens.value
                             / max(metrics.uptime_s(), 1e-9)))
+            # KV-cache byte accounting (ISSUE 12): the resident cost of
+            # max_len x slots — the evidence base for paged KV (ROADMAP
+            # item 2: short requests pay the full max-length HBM today)
+            from bigdl_tpu.obs.memory import tree_bytes as _kv_bytes
+            kv_total = _kv_bytes(self._cache)
+            metrics.gauge("kv_cache_bytes",
+                          "resident KV cache bytes (all slots, max_len)",
+                          fn=lambda: _kv_bytes(self._cache))
+            metrics.gauge("kv_cache_bytes_per_slot",
+                          "resident KV cache bytes per decode slot",
+                          fn=lambda: (_kv_bytes(self._cache)
+                                      / max(1, self.slots)))
+            logger.info("decode KV cache: %d bytes (%d slots x max_len "
+                        "%d, %s)", kv_total, self.slots, self.max_len,
+                        self.cache_dtype)
         else:
             self._m_tokens = self._m_steps = self._m_prefills = None
             self._m_prompt_tokens = self._m_rejected = None
@@ -317,9 +335,18 @@ class DecodeEngine:
             self._key, sub = jax.random.split(self._key)
             keys = jax.random.split(sub, self.slots)
             with _obs_span("decode_step", active=len(active)):
-                toks, self._logits, self._cache = self._step_jit(
-                    self.params, self._logits, self._cache,
-                    jnp.asarray(self._pos), jnp.asarray(self._temp), keys)
+                try:
+                    toks, self._logits, self._cache = self._step_jit(
+                        self.params, self._logits, self._cache,
+                        jnp.asarray(self._pos), jnp.asarray(self._temp),
+                        keys)
+                except Exception as e:
+                    # RESOURCE_EXHAUSTED autopsy (ISSUE 12): the KV
+                    # cache is usually the culprit — report to
+                    # --traceDir + fault log, then die as before
+                    from bigdl_tpu.obs import memory as _obs_mem
+                    _obs_mem.handle_oom(e, "decode_step")
+                    raise
                 toks_host = np.asarray(toks)
             if self._m_steps is not None:
                 self._m_steps.inc()

@@ -88,6 +88,10 @@ class InferenceEngine:
         self._linted = False
         self._compiled = {}  # (bucket, feat_shape, dtype_str) -> jitted fn
         self._compile_lock = threading.Lock()
+        # ISSUE 12: compile-time memory per bucket (memory_analysis of
+        # the exact AOT-compiled program), stamped into provenance so a
+        # bucket ladder's HBM cost is visible before traffic arrives
+        self._bucket_mem: dict = {}
 
         if metrics is not None:
             self._m_rows = metrics.counter(
@@ -173,6 +177,26 @@ class InferenceEngine:
                 donate = ((2,) if self.donate_inputs
                           and self._jax.default_backend() != "cpu" else ())
                 fn = self._jax.jit(self._fwd, donate_argnums=donate)
+                try:
+                    # AOT-compile so the program's memory footprint is
+                    # known NOW (and served as-is); lazy-jit fallback if
+                    # the AOT path misbehaves on this backend
+                    x_abs = self._jax.ShapeDtypeStruct(
+                        (bucket,) + tuple(feat_shape), dtype)
+                    compiled = fn.lower(self.params, self.mod_state,
+                                        x_abs).compile()
+                    ma = compiled.memory_analysis()
+                    arg = int(getattr(ma, "argument_size_in_bytes", 0))
+                    out_b = int(getattr(ma, "output_size_in_bytes", 0))
+                    tmp = int(getattr(ma, "temp_size_in_bytes", 0))
+                    alias = int(getattr(ma, "alias_size_in_bytes", 0))
+                    self._bucket_mem[bucket] = {
+                        "argument_bytes": arg, "output_bytes": out_b,
+                        "temp_bytes": tmp,
+                        "total_bytes": arg + tmp + max(0, out_b - alias)}
+                    fn = compiled
+                except Exception:
+                    pass  # serve through the lazy jit; memory unknown
                 self._compiled[key] = fn
                 if self._m_compiles is not None:
                     self._m_compiles.inc()
@@ -226,8 +250,16 @@ class InferenceEngine:
                     [chunk, np.repeat(chunk[-1:], pad, axis=0)])
             fn = self._get_compiled(bucket, feat_shape, dtype)
             with _obs_span("infer", bucket=bucket, rows=take):
-                y = fn(self.params, self.mod_state,
-                       self._jax.numpy.asarray(chunk))
+                try:
+                    y = fn(self.params, self.mod_state,
+                           self._jax.numpy.asarray(chunk))
+                except Exception as e:
+                    # RESOURCE_EXHAUSTED autopsy (ISSUE 12): report to
+                    # --traceDir + fault log, then fail the request
+                    # exactly as before
+                    from bigdl_tpu.obs import memory as _obs_mem
+                    _obs_mem.handle_oom(e, "serving_predict")
+                    raise
                 outs.append(np.asarray(y)[:take])
             if self._m_rows is not None:
                 self._m_rows.inc(take)
@@ -266,6 +298,10 @@ class InferenceEngine:
                                if cl else "default")
         gp = geom_policy_if_any()
         out["conv_geom_decisions"] = len(gp) if gp else 0
+        for b, m in sorted(self._bucket_mem.items()):
+            # per-bucket compile-time memory (ISSUE 12): the HBM cost of
+            # each program in the ladder, scrape-visible
+            out[f"bucket_{b}_hbm_bytes"] = m["total_bytes"]
         ann = self.lint_annotation
         if isinstance(ann, dict):
             out["lint"] = (f"{ann.get('errors', 0)}e/"

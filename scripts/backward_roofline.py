@@ -153,7 +153,31 @@ def join_profile(profile_dir, cells, names, top, steps, tol):
     return pb, rows, summary
 
 
-def markdown(iso_rows, prof_rows, pb, attrib_summary=None):
+def load_perf_mem(path):
+    """Last perf JSON line of ``path`` that carries the ISSUE 12 memory
+    columns -> (hbm_peak_bytes, hbm_headroom_frac, mem-dict) or None."""
+    found = None
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line.startswith("{"):
+                continue
+            try:
+                row = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if "hbm_peak_bytes" in row or isinstance(row.get("mem"), dict):
+                found = row
+    if found is None:
+        return None
+    return {"hbm_peak_bytes": found.get("hbm_peak_bytes"),
+            "hbm_headroom_frac": found.get("hbm_headroom_frac"),
+            "mem": found.get("mem"),
+            "model": found.get("model"),
+            "batch": found.get("batch")}
+
+
+def markdown(iso_rows, prof_rows, pb, attrib_summary=None, mem=None):
     out = ["### Isolated backward roofline (probe microbenches)", "",
            "| shape | pass | NHWC ms | NHWC TF/s | best | best ms | "
            "best TF/s | best/NHWC time |",
@@ -193,6 +217,20 @@ def markdown(iso_rows, prof_rows, pb, attrib_summary=None):
             out.append(f"| coll:{kind} | {d['time_s']:.5f} "
                        f"| {100 * d['frac']:.1f} "
                        f"| {d['time_s'] * 1e3 / steps:.3f} |")
+    if mem is not None:
+        pk, hr = mem.get("hbm_peak_bytes"), mem.get("hbm_headroom_frac")
+        out += ["", "### HBM attribution (ISSUE 12, from --perfJson)", "",
+                f"run: {mem.get('model')} b={mem.get('batch')} — "
+                f"hbm peak "
+                f"{round(pk / 2**30, 2) if pk is not None else '-'} GiB, "
+                f"headroom "
+                f"{round(hr * 100, 1) if hr is not None else '-'}%", "",
+                "| category | MiB | frac % |", "|---|---|---|"]
+        m = mem.get("mem") or {}
+        total = max(1, m.get("total_bytes") or 1)
+        for cat, b in (m.get("categories") or {}).items():
+            out.append(f"| {cat} | {b / 2**20:.1f} "
+                       f"| {100.0 * b / total:.1f} |")
     return "\n".join(out) + "\n"
 
 
@@ -208,6 +246,10 @@ def main(argv=None):
                          "scaling)")
     ap.add_argument("--tol", type=float, default=0.35,
                     help="max relative duration gap for a bench match")
+    ap.add_argument("--perfJson", default=None,
+                    help="perf JSON log of the same run (an --obs line "
+                         "with the ISSUE 12 memory columns) — adds the "
+                         "HBM peak/headroom + category section")
     ap.add_argument("--out", default=None,
                     help="write the markdown table here (stdout default)")
     ap.add_argument("--json", default=None,
@@ -220,7 +262,8 @@ def main(argv=None):
     if args.profile:
         pb, prof, summary = join_profile(args.profile, cells, names,
                                          args.top, args.steps, args.tol)
-    md = markdown(iso, prof, pb, summary)
+    mem = load_perf_mem(args.perfJson) if args.perfJson else None
+    md = markdown(iso, prof, pb, summary, mem)
     if args.out:
         with open(args.out, "w") as f:
             f.write(md)
@@ -234,7 +277,7 @@ def main(argv=None):
             attrib_compact = compact(summary)
         with open(args.json, "w") as f:
             json.dump({"isolated": iso, "profile": prof,
-                       "attrib": attrib_compact,
+                       "attrib": attrib_compact, "mem": mem,
                        "xplane": pb}, f, indent=1, sort_keys=True)
             f.write("\n")
 

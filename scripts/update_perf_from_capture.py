@@ -43,14 +43,18 @@ def main(path: str) -> None:
 
     if perf:
         # ISSUE 8 columns: strategy/mesh stamping + the per-step
-        # collective breakout (null until a capture window fired)
+        # collective breakout (null until a capture window fired);
+        # ISSUE 12 columns: HBM peak + headroom (null obs-off)
         print("### Training throughput / MFU\n")
         print("| run | model | strategy | devs | batch | img/s/chip "
-              "| MFU % | basis | coll ms/step | coll % | device |")
-        print("|---|---|---|---|---|---|---|---|---|---|---|")
+              "| MFU % | basis | coll ms/step | coll % "
+              "| hbm peak GiB | headroom % | device |")
+        print("|---|---|---|---|---|---|---|---|---|---|---|---|---|")
         for s, r in perf:
             cs = r.get("collective_s")
             cf = r.get("collective_frac")
+            pk = r.get("hbm_peak_bytes")
+            hr = r.get("hbm_headroom_frac")
             print(f"| {s} | {r.get('model')} "
                   f"| {r.get('strategy') or '-'} "
                   f"| {r.get('n_devices', 1)} | {r.get('batch')} "
@@ -58,8 +62,24 @@ def main(path: str) -> None:
                   f"| {r.get('mfu_pct')} | {r.get('mfu_basis')} "
                   f"| {round(cs * 1e3, 3) if cs is not None else '-'} "
                   f"| {round(cf * 100, 2) if cf is not None else '-'} "
+                  f"| {round(pk / 2**30, 2) if pk is not None else '-'} "
+                  f"| {round(hr * 100, 1) if hr is not None else '-'} "
                   f"| {r.get('device')} |")
         print()
+        memmed = [(s, r) for s, r in perf
+                  if isinstance(r.get("mem"), dict)]
+        if memmed:
+            print("### HBM attribution (per run)\n")
+            print("| run | model | category | MiB | frac % |")
+            print("|---|---|---|---|---|")
+            for s, r in memmed:
+                m = r["mem"]
+                total = max(1, m.get("total_bytes") or 1)
+                for cat, b in (m.get("categories") or {}).items():
+                    print(f"| {s} | {r.get('model')} | {cat} "
+                          f"| {round(b / 2**20, 1)} "
+                          f"| {round(100.0 * b / total, 1)} |")
+            print()
         attribbed = [(s, r) for s, r in perf if r.get("attrib")]
         if attribbed:
             print("### Device-time attribution (per capture window)\n")
