@@ -101,12 +101,20 @@ def child(backend: str, model: str, batch: int, iters: int,
         return
 
     data_source = None
+    pipe_suffix = None
+    pipe_exec = model.endswith("_pipe_exec")
+    if pipe_exec:
+        # "<model>_pipe_exec": the executor-pipeline leg of the feed A/B
+        # (ISSUE 13) — same shards/decode recipe as _pipe, fed by the
+        # dataset/pipeline executor with device staging
+        model = model[:-len("_exec")]
     if model.endswith("_pipe"):
         # "<model>_pipe": train from generated ImageNet-shape record
         # shards — decode+augment+host->device inside the timed loop
         import sys as _sys
         import tempfile
 
+        pipe_suffix = "_pipe_exec" if pipe_exec else "_pipe"
         model = model[:-len("_pipe")]
         _sys.path.insert(0, os.path.join(
             os.path.dirname(os.path.abspath(__file__)), "scripts"))
@@ -125,9 +133,11 @@ def child(backend: str, model: str, batch: int, iters: int,
                    data_source=data_source, inner_steps=inner,
                    autotune=autotune, strategy=strategy or None,
                    grad_compress=grad_compress or None,
-                   grad_buckets=grad_buckets or None)
+                   grad_buckets=grad_buckets or None,
+                   data_workers=8 if pipe_exec else 0,
+                   stage="device" if pipe_exec else "off")
     if data_source is not None:
-        out["model"] += "_pipe"
+        out["model"] += pipe_suffix
         out["data_source"] = "record-shards (generated, ~120KB JPEGs)"
     out["backend"] = jax.default_backend()
     print("BENCH_RESULT " + json.dumps(out))
@@ -409,11 +419,16 @@ def main() -> None:
                     # of the fused-vs-stats-vs-default A/B
                     ("resnet50_fba", "resnet50_fba", batch, iters, 1,
                      "off"),
-                    # resnet50_pipe dropped from the chip sweep (VERDICT
-                    # r5 weak #5: ~32 s/window for a 0.99%-MFU row with
-                    # zero decision value; its CPU coverage lives in the
-                    # record-pipeline tests) — the reclaimed window time
-                    # funds the per-geometry layout A/B above
+                    # ISSUE 13 feed A/B: resnet50_pipe re-admitted (it
+                    # was dropped in round 5 as a 0.99%-MFU row with no
+                    # decision value — it now IS the decision: the legacy
+                    # window-feed leg) against the executor+device-staging
+                    # leg below; stall_frac/pipeline columns say which
+                    # feed kept the chip busier
+                    ("resnet50_pipe", "resnet50_pipe", batch, 10, 1,
+                     "off"),
+                    ("resnet50_pipe_exec", "resnet50_pipe_exec", batch,
+                     10, 1, "off"),
                     # accuracy-vs-wall-clock (BASELINE's second metric;
                     # hard grade pinned in child())
                     ("time_to_acc", "time_to_acc", 128, 0, 1, "off")):
@@ -436,7 +451,10 @@ def main() -> None:
                             # conv layout provenance (global triple +
                             # per-geometry decisions, ISSUE 3)
                             "conv_layouts", "conv_geom",
-                            "autotune", "bn_fused")
+                            "autotune", "bn_fused",
+                            # ISSUE 13 feed A/B columns: which machinery
+                            # fed the chip and how often it starved
+                            "pipeline", "stall_frac", "data_wait_s")
                         if cres.get(k) is not None}
                     if cres.get("backend") == "tpu":
                         _partial(cname, cres)

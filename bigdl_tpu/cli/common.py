@@ -149,6 +149,68 @@ def add_obs_args(p: argparse.ArgumentParser) -> None:
                         "socket traceback")
 
 
+# the --dataWorkers/--prefetchDepth/--stage surface (ISSUE 13): the
+# async input-pipeline executor + host->device staging, shared by perf
+# and every training CLI (must mirror dataset.pipeline.STAGE_CHOICES —
+# asserted in tests, not imported here, so argparse setup never pulls
+# the jax-importing dataset package)
+PIPELINE_STAGE_CHOICES = ("off", "host", "device")
+
+
+def add_pipeline_args(p: argparse.ArgumentParser) -> None:
+    p.add_argument("--dataWorkers", type=int, default=0, metavar="N",
+                   help="async input-pipeline executor "
+                        "(bigdl_tpu.dataset.pipeline, the reference's "
+                        "MTLabeledBGRImgToBatch model): N decode/augment "
+                        "worker threads race the epoch plan's sample "
+                        "tickets and reassemble batches in submission "
+                        "order — the batch stream is bit-identical for "
+                        "ANY worker count and under kill+resume "
+                        "(per-sample (seed, epoch, index) rngs). 0 = "
+                        "legacy single-threaded feed")
+    p.add_argument("--prefetchDepth", type=int, default=2, metavar="D",
+                   help="max batches prepared ahead of the consumer — "
+                        "bounds both the executor's in-flight batch "
+                        "reassembly (workers block past it) and the "
+                        "staging queue (default 2: double buffering)")
+    p.add_argument("--stage", default="off",
+                   choices=list(PIPELINE_STAGE_CHOICES),
+                   help="host->device staging thread: 'host' prepares "
+                        "assembled batches ahead; 'device' additionally "
+                        "jax.device_put's batch N+1 — committed to the "
+                        "--strategy sharded layout — while the device "
+                        "runs step N, so dispatch stops paying the h2d "
+                        "copy; 'off' = feed inline (default)")
+
+
+def build_feed(dataset, args, strategy=None):
+    """Wrap a training DataSet in the async pipeline stack per
+    ``(--dataWorkers, --prefetchDepth, --stage)``. Returns
+    ``(dataset, provenance|None)`` — provenance is what perf stamps as
+    the ``pipeline`` JSON column (also stashed on ``args._pipeline``)."""
+    workers = int(getattr(args, "dataWorkers", 0) or 0)
+    depth = int(getattr(args, "prefetchDepth", 2) or 2)
+    stage = getattr(args, "stage", None) or "off"
+    if workers <= 0 and stage == "off":
+        args._pipeline = None
+        return dataset, None
+    if (stage == "device"
+            and int(getattr(args, "stepsPerDispatch", 1) or 1) > 1):
+        # the K-step chunk path restacks its K batches host-side, which
+        # would immediately undo (and pay for) the device commit
+        logging.getLogger(__name__).warning(
+            "--stage device assumes one batch per dispatch; "
+            "--stepsPerDispatch > 1 restacks batches host-side — "
+            "downgrading to --stage host")
+        stage = "host"
+    from bigdl_tpu.dataset.pipeline import wrap_pipeline
+    ds, prov = wrap_pipeline(dataset, workers=workers, depth=depth,
+                             stage=stage, strategy=strategy,
+                             seed=getattr(args, "seed", 0))
+    args._pipeline = prov
+    return ds, prov
+
+
 class ObsState:
     """What install_observability wired up for this process: whether
     span tracing is on, the capture controller (--traceSteps/SIGUSR2/
@@ -479,6 +541,7 @@ def add_train_args(p: argparse.ArgumentParser) -> None:
                         "VALID pair is never deleted)")
     add_resilience_args(p)
     add_obs_args(p)
+    add_pipeline_args(p)
     p.add_argument("--dataParallel", action="store_true",
                    help="shard the batch over all visible devices")
     add_strategy_arg(p)
@@ -766,6 +829,11 @@ def build_optimizer(model, dataset, criterion, args, schedule=None,
     # contract (ADVICE r5 #5) — one validator shared with perf (ISSUE 8)
     strategy = build_strategy(args, model=model)
     k = int(getattr(args, "stepsPerDispatch", 1) or 1)
+    # --dataWorkers/--prefetchDepth/--stage: the async pipeline stack
+    # wraps the dataset BEFORE the Optimizer sees it; built fresh per
+    # supervised/elastic retry (run_optimize re-invokes make_optimizer),
+    # so device staging always commits to the current attempt's mesh
+    dataset, _ = build_feed(dataset, args, strategy=strategy)
     opt = Optimizer(model, dataset, criterion,
                     optim_method=optim_method,
                     end_when=Trigger.max_epoch(args.maxEpoch),

@@ -184,7 +184,17 @@ def build_model(name: str, class_num: int = 1000, seq_len=None,
     return model, size
 
 
-def _record_batches(source: str, batch: int, n_threads: int = 0):
+def _short_side(crop) -> int:
+    """The resize target feeding a random crop: the standard 256-for-224
+    headroom ratio, generalized so non-224 image models (resnet20_cifar)
+    can train from record shards too."""
+    if tuple(crop) == (224, 224):
+        return 256
+    return max(8, (max(crop) * 8) // 7)
+
+
+def _record_batches(source: str, batch: int, n_threads: int = 0,
+                    crop=(224, 224)):
     """Endless MiniBatch iterator over ``record:<shard-dir>`` — the
     train-from-storage bench path (decode + per-sample augment + batch +
     host->device all inside the timed loop; round-2 weak #2: the synthetic
@@ -194,14 +204,52 @@ def _record_batches(source: str, batch: int, n_threads: int = 0):
     from bigdl_tpu.dataset.streaming import RecordImageDataSet
 
     ds = RecordImageDataSet(
-        source, batch_size=batch, crop=(224, 224), train=True,
-        short_side=256, mean=[123.68, 116.779, 103.939],
+        source, batch_size=batch, crop=crop, train=True,
+        short_side=_short_side(crop),
+        mean=[123.68, 116.779, 103.939],
         std=[58.4, 57.1, 57.4],
         n_threads=n_threads or min(32, (os.cpu_count() or 4) * 2),
         window=4)
     while True:
         for mb in ds:
             yield mb
+
+
+def _executor_record_batches(source: str, batch: int, workers: int,
+                             depth: int = 2, stage: str = "off",
+                             strategy=None, crop=(224, 224)):
+    """Endless executor-fed record feed (ISSUE 13): the SAME decode/
+    augment recipe as :func:`_record_batches` (so A/B rows compare the
+    feed machinery, not the pipeline params), driven by the
+    ``dataset/pipeline/`` executor + optional host->device staging.
+    Returns ``(iterator, provenance dict)``."""
+    from bigdl_tpu.dataset.pipeline import (EpochPlan, ExecutorDataSet,
+                                            StagedDataSet,
+                                            StreamingSampleSource)
+    from bigdl_tpu.dataset.streaming import RecordImageDataSet
+
+    # worker parallelism lives in the executor now — the inner dataset
+    # only contributes its per-sample decode path (_load_sample)
+    rds = RecordImageDataSet(
+        source, batch_size=batch, crop=crop, train=True,
+        short_side=_short_side(crop), mean=[123.68, 116.779, 103.939],
+        std=[58.4, 57.1, 57.4], n_threads=1, window=1)
+    src = StreamingSampleSource(rds)
+    plan = EpochPlan(len(src), batch, seed=rds.seed, shuffle=True,
+                     process_index=0, process_count=1)
+    ds = ExecutorDataSet(src, workers=workers, depth=depth, plan=plan)
+    prov_ds = ds
+    if stage != "off":
+        ds = StagedDataSet(ds, stage=stage, depth=depth, strategy=strategy)
+        prov_ds = ds
+
+    def endless():
+        while True:
+            for mb in ds:
+                yield mb
+            ds.shuffle()  # advance the plan epoch (legacy feed parity)
+
+    return endless(), prov_ds.signature()
 
 
 def _annotate_conv_layouts(out: dict) -> None:
@@ -463,7 +511,9 @@ def run(model_name: str, batch: int, iterations: int, data_type: str,
         fused_bn: str | None = None, lint: dict | None = None,
         supervisor=None, obs_state=None, strategy: str | None = None,
         seq_len: int | None = None, grad_compress: str | None = None,
-        grad_buckets: str | None = None, elastic=None):
+        grad_buckets: str | None = None, elastic=None,
+        data_workers: int = 0, prefetch_depth: int = 2,
+        stage: str = "off"):
     """Throughput harness entry. ``autotune`` optionally installs the
     tuning mode (the CLI does it via --autotune/apply_platform; bench.py
     children pass it directly). ``fused_bn`` ('off'/'stats'/'apply')
@@ -491,7 +541,9 @@ def run(model_name: str, batch: int, iterations: int, data_type: str,
                           lint=lint, supervisor=supervisor,
                           obs_state=obs_state, strategy=strategy,
                           seq_len=seq_len, grad_compress=grad_compress,
-                          grad_buckets=grad_buckets, elastic=elastic)
+                          grad_buckets=grad_buckets, elastic=elastic,
+                          data_workers=data_workers,
+                          prefetch_depth=prefetch_depth, stage=stage)
     finally:
         conv2d.restore_policy(snap)
 
@@ -504,7 +556,9 @@ def _run_timed(model_name: str, batch: int, iterations: int, data_type: str,
                supervisor=None, obs_state=None,
                strategy: str | None = None, seq_len: int | None = None,
                grad_compress: str | None = None,
-               grad_buckets: str | None = None, elastic=None):
+               grad_buckets: str | None = None, elastic=None,
+               data_workers: int = 0, prefetch_depth: int = 2,
+               stage: str = "off"):
     import os
 
     import jax
@@ -864,11 +918,28 @@ def _run_timed(model_name: str, batch: int, iterations: int, data_type: str,
             restore_ms=(time.perf_counter() - t_attempt0) * 1000.0)
 
     feed = None
+    pipeline_prov = None
     if data_source is not None:
-        if data_source.startswith("record:"):
-            feed = _record_batches(data_source[len("record:"):], batch)
-        else:
+        if not data_source.startswith("record:"):
             raise SystemExit(f"unknown --data source {data_source!r}")
+        src_path = data_source[len("record:"):]
+        # image models: crop records to the model's own spatial dims
+        # (224 for the ImageNet family, 32 for resnet20_cifar, ...)
+        crop = (tuple(in_shape[:2])
+                if len(in_shape) == 3 and in_shape[2] == 3 else (224, 224))
+        if data_workers > 0 or stage != "off":
+            # ISSUE 13: the executor pipeline replaces the legacy
+            # windowed thread-pool feed; --stage device commits the
+            # batch to the strategy's sharded layout off-thread
+            feed, sig = _executor_record_batches(
+                src_path, batch, workers=max(1, data_workers),
+                depth=prefetch_depth, stage=stage, strategy=strat,
+                crop=crop)
+            pipeline_prov = {"workers": max(1, data_workers),
+                             "depth": prefetch_depth, "stage": stage,
+                             "signature": sig}
+        else:
+            feed = _record_batches(src_path, batch, crop=crop)
         next(feed)  # warm the decode pool outside the timed region
 
     import contextlib
@@ -915,8 +986,13 @@ def _run_timed(model_name: str, batch: int, iterations: int, data_type: str,
                     _meter("data_wait", t)
                     t = pc()
                     with span("h2d"):
-                        x = jnp.asarray(mb.input)
-                        y = jnp.asarray(mb.target)
+                        # staged feeds already committed the batch to
+                        # device (producer thread recorded the h2d span);
+                        # the asarray here would be a no-op aliasing
+                        x, y = mb.input, mb.target
+                        if not isinstance(x, jax.Array):
+                            x = jnp.asarray(x)
+                            y = jnp.asarray(y)
                     _meter("h2d", t)
                 _fault_hook("step")
                 t = pc()
@@ -943,8 +1019,10 @@ def _run_timed(model_name: str, batch: int, iterations: int, data_type: str,
             for _ in range(iterations):
                 if feed is not None:
                     mb = next(feed)
-                    x = jnp.asarray(mb.input)   # host->device each step,
-                    y = jnp.asarray(mb.target)  # as in a real epoch
+                    x, y = mb.input, mb.target
+                    if not isinstance(x, jax.Array):
+                        x = jnp.asarray(x)   # host->device each step,
+                        y = jnp.asarray(y)   # as in a real epoch
                 # fault site (one pointer check when no --faultPlan):
                 # the supervised-overhead A/B in tpu_capture_r11.sh
                 # bounds its cost
@@ -1001,6 +1079,10 @@ def _run_timed(model_name: str, batch: int, iterations: int, data_type: str,
         # single-device run lands (the DistriOptimizerSpec bar)
         "final_loss": round(float(loss), 6),
         "device": getattr(jax.devices()[0], "device_kind", "unknown"),
+        # ISSUE 13: which feed machinery produced the batches — null on
+        # the legacy window feed / synthetic data, so executor-vs-legacy
+        # A/Bs join on a schema-stable column next to stall_frac
+        "pipeline": pipeline_prov,
     }
     if strat is not None and strat.grad_comm_info() is not None:
         # the full wire accounting (bucket bound + provenance, wire
@@ -1359,6 +1441,7 @@ def main(argv=None):
     from bigdl_tpu.cli.common import (_add_platform_arg, add_autotune_arg,
                                       add_fused_bn_arg, add_grad_comm_args,
                                       add_lint_arg, add_obs_args,
+                                      add_pipeline_args,
                                       add_resilience_args,
                                       add_strategy_arg, apply_platform,
                                       run_preflight_lint)
@@ -1370,6 +1453,7 @@ def main(argv=None):
     add_lint_arg(p)
     add_resilience_args(p)
     add_obs_args(p)
+    add_pipeline_args(p)
     args = p.parse_args(argv)
     apply_platform(args)  # also installs --faultPlan and --obs
     if args.convLayout:
@@ -1430,7 +1514,8 @@ def main(argv=None):
             lint=lint_ann, supervisor=supervisor, obs_state=obs_state,
             strategy=args.strategy, seq_len=args.seq,
             grad_compress=args.gradCompress, grad_buckets=args.gradBuckets,
-            elastic=elastic)
+            elastic=elastic, data_workers=args.dataWorkers,
+            prefetch_depth=args.prefetchDepth, stage=args.stage)
 
     if args.elastic is not None:
         # elastic perf (ISSUE 11): a kill_device fault mid-loop marks

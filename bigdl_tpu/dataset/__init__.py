@@ -18,3 +18,7 @@ from bigdl_tpu.dataset.streaming import (
     StreamingImageFolder, RecordImageDataSet,
 )
 from bigdl_tpu.dataset.mixup import CutMix, Mixup, MixupCriterion
+from bigdl_tpu.dataset.pipeline import (
+    EpochPlan, ExecutorDataSet, ArraySampleSource, StreamingSampleSource,
+    DeviceBatch, StagedDataSet, wrap_pipeline,
+)

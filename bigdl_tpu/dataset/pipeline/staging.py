@@ -1,0 +1,196 @@
+"""Double-buffered host→device staging (ISSUE 13 tentpole #2).
+
+While the device runs step N, a staging thread ``jax.device_put``s batch
+N+1 — committed to the run's sharded layout when a ``--strategy`` object
+is given (``strategy.shard_batch``: ``NamedSharding`` single-host,
+``make_array_from_process_local_data`` multi-host), so staged batches
+compose with dp/tp/sp and with ``--elastic`` mesh rebuilds (the staging
+wrapper is rebuilt with the fresh strategy on every supervised retry).
+
+Staged batches arrive as :class:`DeviceBatch` — the Optimizer's h2d
+block recognizes device-committed inputs and skips its conversion, so
+dispatch no longer pays the host→device copy. The producer thread's
+``device_put`` runs under an ``h2d`` span (the span ring is
+thread-safe), keeping the copy visible on the obs timeline even though
+it no longer stalls the loop thread.
+
+Backpressure is a bounded queue of ``depth`` batches; shutdown drains
+until the producer THREAD exits (the same contract as the fixed
+``PrefetchDataSet`` — an empty-queue check alone races a producer
+blocked in ``put()``).
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+import time
+from typing import Any, Callable, Iterator, Optional
+
+from bigdl_tpu.dataset.dataset import DataSet
+from bigdl_tpu.obs.spans import span as _span
+
+__all__ = ["DeviceBatch", "StagedDataSet", "staged_batches", "make_put_fn",
+           "STAGE_CHOICES"]
+
+logger = logging.getLogger("bigdl_tpu")
+
+STAGE_CHOICES = ("off", "host", "device")
+
+_DONE = object()
+
+
+class DeviceBatch:
+    """An (input, target) pair already committed to device (and to the
+    strategy's sharded layout) — consumers skip their h2d conversion.
+    Iterates like MiniBatch for tuple unpacking."""
+
+    __slots__ = ("input", "target")
+
+    def __init__(self, input: Any, target: Any):
+        self.input = input
+        self.target = target
+
+    def __iter__(self):
+        yield self.input
+        yield self.target
+
+    @property
+    def size(self) -> int:
+        return len(self.input)
+
+
+def make_put_fn(strategy=None) -> Callable:
+    """The host→device commit for one (x, y) batch: the strategy's
+    sharded placement when one is given, plain device arrays otherwise
+    (target may be a pytree — Mixup's ``(y_a, y_b, lam)``)."""
+    if strategy is not None:
+        return strategy.shard_batch
+    import jax
+    import jax.numpy as jnp
+
+    def put(x, y):
+        return jnp.asarray(x), jax.tree_util.tree_map(jnp.asarray, y)
+
+    return put
+
+
+def staged_batches(batches, put_fn: Optional[Callable] = None,
+                   depth: int = 2, stage: str = "device",
+                   join_timeout: float = 5.0) -> Iterator:
+    """Drive ``batches`` (any (x, y) iterable) through a staging thread.
+
+    ``stage="host"``: prepare-ahead only (host batches pass through);
+    ``stage="device"``: also commit each batch via ``put_fn`` on the
+    staging thread, yielding :class:`DeviceBatch`; ``stage="off"``:
+    passthrough, no thread."""
+    if stage not in STAGE_CHOICES:
+        raise ValueError(f"stage must be one of {STAGE_CHOICES}, "
+                         f"got {stage!r}")
+    if stage == "off":
+        yield from batches
+        return
+    put = put_fn
+    if stage == "device" and put is None:
+        put = make_put_fn()
+    if stage == "host":
+        put = None
+    q: queue.Queue = queue.Queue(maxsize=max(1, int(depth)))
+    err: list = []
+    stop = threading.Event()  # set when the consumer abandons the stream
+
+    def offer(item) -> bool:
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def produce():
+        try:
+            for mb in batches:
+                if put is not None:
+                    x, y = mb
+                    with _span("h2d", staged=True):
+                        x, y = put(x, y)
+                    mb = DeviceBatch(x, y)
+                if not offer(mb):
+                    return  # consumer gone — unwind, don't block forever
+        except BaseException as e:  # surfaced on the consumer side
+            err.append(e)
+        finally:
+            offer(_DONE)
+
+    t = threading.Thread(target=produce, daemon=True, name="bigdl-stage")
+    t.start()
+    try:
+        while True:
+            item = q.get()
+            if item is _DONE:
+                break
+            yield item
+    finally:
+        stop.set()
+        # drain until the THREAD exits, not until the queue momentarily
+        # looks empty — the producer can refill between an empty-check
+        # and the join (the PrefetchDataSet race, fixed here too)
+        deadline = time.monotonic() + join_timeout
+        while t.is_alive() and time.monotonic() < deadline:
+            try:
+                q.get_nowait()
+            except queue.Empty:
+                pass
+            t.join(timeout=0.05)
+        if t.is_alive():
+            logger.warning(
+                "staging: producer thread failed to exit within %.1fs "
+                "(daemon thread leaked past shutdown — a device_put or "
+                "the wrapped feed is stuck)", join_timeout)
+    if err:
+        raise err[0]
+
+
+class StagedDataSet(DataSet):
+    """DataSet front over :func:`staged_batches` — what the CLI wiring
+    wraps around the executor (or any feed) under ``--stage``."""
+
+    def __init__(self, inner: DataSet, stage: str = "device",
+                 depth: int = 2, strategy=None,
+                 put_fn: Optional[Callable] = None):
+        if stage not in STAGE_CHOICES:
+            raise ValueError(f"stage must be one of {STAGE_CHOICES}, "
+                             f"got {stage!r}")
+        self.inner = inner
+        self.stage = stage
+        self.depth = max(1, int(depth))
+        self.strategy = strategy
+        self._put_fn = put_fn
+
+    @property
+    def plan(self):
+        """Expose the wrapped executor's epoch plan (checkpoint driver
+        blobs stamp its signature through this)."""
+        return getattr(self.inner, "plan", None)
+
+    def __iter__(self) -> Iterator:
+        put = self._put_fn
+        if put is None and self.stage == "device":
+            put = make_put_fn(self.strategy)
+        yield from staged_batches(iter(self.inner), put_fn=put,
+                                  depth=self.depth, stage=self.stage)
+
+    def size(self) -> int:
+        return self.inner.size()
+
+    def shuffle(self, seed: Optional[int] = None) -> None:
+        self.inner.shuffle(seed)
+
+    def signature(self) -> dict:
+        sig = {"stage": self.stage, "depth": self.depth}
+        inner_sig = getattr(self.inner, "signature", None)
+        if inner_sig is not None:
+            sig.update(inner_sig())
+        return sig

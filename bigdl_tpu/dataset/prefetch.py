@@ -16,13 +16,17 @@ it:
 
 from __future__ import annotations
 
+import logging
 import queue
 import threading
+import time
 from typing import Iterator, Optional
 
 from bigdl_tpu.dataset.dataset import DataSet
 
 __all__ = ["PrefetchDataSet"]
+
+logger = logging.getLogger("bigdl_tpu")
 
 _DONE = object()
 
@@ -74,14 +78,24 @@ class PrefetchDataSet(DataSet):
                 yield item
         finally:
             # normal exhaustion AND early exit (break / GeneratorExit):
-            # release the producer if it is blocked on a full queue
+            # release the producer if it is blocked on a full queue.
+            # Drain until the THREAD exits — a single empty-queue sweep
+            # races a producer blocked in put(), which can refill the
+            # queue between the emptiness check and the join and leak
+            # the daemon thread past the timeout.
             stop.set()
-            while not q.empty():
+            deadline = time.monotonic() + 5.0
+            while t.is_alive() and time.monotonic() < deadline:
                 try:
                     q.get_nowait()
                 except queue.Empty:
-                    break
-            t.join(timeout=5.0)
+                    pass
+                t.join(timeout=0.05)
+            if t.is_alive():
+                logger.warning(
+                    "prefetch: producer thread failed to exit within 5s "
+                    "(daemon thread leaked past shutdown — the wrapped "
+                    "dataset is stuck mid-batch)")
         if err:
             raise err[0]
 

@@ -294,6 +294,23 @@ class Optimizer:
             self._resume_driver = {k: int(v) for k, v in dict(drv).items()
                                    if k in ("epoch", "iteration",
                                             "rng_splits", "epoch_records")}
+            saved_plan = dict(drv).get("plan")
+            if saved_plan:
+                # blob round-trip turns scalars into 0-d arrays; epoch is
+                # expected to differ (the snapshot's cursor, not identity)
+                theirs = {k: (v.item() if hasattr(v, "item") else v)
+                          for k, v in dict(saved_plan).items()
+                          if k != "epoch"}
+                cur = getattr(self.dataset, "plan", None)
+                if cur is not None and hasattr(cur, "signature"):
+                    mine = {k: v for k, v in cur.signature().items()
+                            if k != "epoch"}
+                    if mine != theirs:
+                        logger.warning(
+                            "resume: checkpoint epoch plan %s differs "
+                            "from this run's %s — the replayed batch "
+                            "stream will NOT match the killed run's",
+                            theirs, mine)
             # a kill between the model.<n> and state.<n> writes leaves an
             # unmatched (unusable) newer snapshot; with counters resuming,
             # the deterministic trigger will re-reach exactly that name —
@@ -708,7 +725,13 @@ class Optimizer:
                     _fault_hook("step")
                     t_h = time.perf_counter()
                     with _span("h2d"):
-                        if self.strategy is not None:
+                        if isinstance(x, jax.Array):
+                            # staged upstream (pipeline --stage device):
+                            # the batch is already committed to device
+                            # (and to the strategy's sharded layout) —
+                            # dispatch no longer pays the h2d copy
+                            pass
+                        elif self.strategy is not None:
                             x, y = self.strategy.shard_batch(x, y)
                         else:
                             # target may be a pytree (Mixup's
@@ -864,6 +887,11 @@ class Optimizer:
                # the open epoch (0 at an epoch boundary)
                "rng_splits": int(getattr(self, "_rng_splits", 0)),
                "epoch_records": int(driver.get("epoch_records", 0))}
+        plan = getattr(self.dataset, "plan", None)
+        if plan is not None and hasattr(plan, "signature"):
+            # the executor feed's epoch-plan signature: resume verifies
+            # the replayed batch schedule matches the killed run's
+            drv["plan"] = plan.signature()
         if getattr(self, "_ckpt_sharded", False):
             # pod-scale path: every host writes its own shards, no gather
             from bigdl_tpu.utils.orbax_ckpt import save_sharded

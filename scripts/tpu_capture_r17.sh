@@ -1,0 +1,99 @@
+#!/usr/bin/env bash
+# Round-17 capture: ISSUE 13 (executor input pipeline) chip evidence.
+# The executor's determinism/backpressure/resume contracts are
+# CPU-verified end to end (tests/test_pipeline_exec.py, the
+# pipeline-smoke CI job) — what only hardware can tell us is whether the
+# N-worker executor + double-buffered device staging actually closes the
+# feed gap the legacy single-window pipe leg measured (0.99% MFU,
+# PERF.md §4): (a) the before/after leg trains resnet50 from the SAME
+# record shards under the legacy feed and under the executor at matched
+# batch/iterations, with --obs so every line carries data_wait_s /
+# stall_frac; (b) the sweep leg grids dataWorkers x prefetchDepth x
+# stage to find the knee on real decode + real h2d; (c) the staging A/B
+# isolates --stage device (producer-thread jax.device_put) vs host.
+# Appends to $OUT, mirrored into the repo per step.
+
+set -uo pipefail
+cd "$(dirname "$0")/.."
+OUT="${OUT:-/tmp/tpu_capture_r17.log}"
+REPO_LOG="${REPO_LOG:-TPU_CAPTURE_r17.log}"
+SHARDS="${SHARDS:-/tmp/pipe_r17_shards}"
+trap 'cp -f "$OUT" "$REPO_LOG" 2>/dev/null || true' EXIT
+
+step() {
+  local name="$1" tmo="$2"; shift 2
+  echo "=== $name ($(date -u +%H:%M:%SZ))" | tee -a "$OUT"
+  timeout "$tmo" "$@" 2>&1 | tail -40 | tee -a "$OUT"
+  echo "=== end $name rc=$?" | tee -a "$OUT"
+  cp -f "$OUT" "$REPO_LOG" 2>/dev/null || true
+}
+
+# 0. the pipeline tests on the bench env first
+step "pytest_pipeline" 600 python -m pytest tests/test_pipeline_exec.py \
+  tests/test_record_pipeline.py -q
+
+# 1. shared shard set for every leg (1024 ImageNet-shape JPEGs) — the
+#    A/B must compare feed machinery, not datasets
+step "make_shards" 900 python - "$SHARDS" <<'EOF'
+import os, sys
+sys.path.insert(0, "scripts")
+from input_pipeline_bench import make_jpegs
+from bigdl_tpu.dataset.recordfile import write_image_shards
+root = sys.argv[1]
+img = os.path.join(root, "imgs")
+if not os.path.isdir(os.path.join(root, "shards")):
+    make_jpegs(img, 1024)
+    write_image_shards(img, os.path.join(root, "shards"),
+                       images_per_shard=256)
+print("shards ready:", os.listdir(os.path.join(root, "shards")))
+EOF
+
+# 2. THE r17 leg — before/after at matched config. Legacy window feed
+#    (the re-admitted resnet50_pipe shape) vs executor + device staging
+#    (resnet50_pipe_exec shape). stall_frac and data_wait_s in the two
+#    JSON lines are the whole story; images_per_second_per_chip is the
+#    headline delta for PERF.md §20.
+for LEG in "legacy:--dataWorkers 0 --stage off" \
+           "exec:--dataWorkers 8 --prefetchDepth 2 --stage device"; do
+  NAME="${LEG%%:*}"; FLAGS="${LEG#*:}"
+  # shellcheck disable=SC2086
+  step "ab_resnet50_${NAME}" 1800 python -m bigdl_tpu.cli.main perf \
+    -m resnet50 -b 128 -i 30 --data "record:$SHARDS/shards" \
+    --obs $FLAGS || true
+done
+
+# 3. sweep leg: dataWorkers x prefetchDepth x stage on real decode +
+#    real h2d — one perf JSON line per config (the knee feeds the §20
+#    table and the shipped default)
+for W in 1 2 4 8 16; do
+  for D in 2 4; do
+    step "sweep_w${W}_d${D}_device" 1200 python -m bigdl_tpu.cli.main \
+      perf -m resnet50 -b 128 -i 20 --data "record:$SHARDS/shards" \
+      --obs --dataWorkers "$W" --prefetchDepth "$D" --stage device \
+      || true
+  done
+done
+
+# 4. staging A/B at the knee: host-staged (consumer-thread h2d) vs
+#    device-staged (producer-thread h2d overlapped with the step)
+for S in host device; do
+  step "stage_${S}_w8_d2" 1200 python -m bigdl_tpu.cli.main perf \
+    -m resnet50 -b 128 -i 30 --data "record:$SHARDS/shards" \
+    --obs --dataWorkers 8 --prefetchDepth 2 --stage "$S" || true
+done
+
+# 5. multichip composition: the executor feed under --strategy dp —
+#    device staging commits straight to the NamedSharding layout
+step "dp_exec_w8" 1800 python -m bigdl_tpu.cli.main perf \
+  -m resnet50 -b 256 -i 20 --data "record:$SHARDS/shards" \
+  --obs --strategy dp --dataWorkers 8 --prefetchDepth 2 \
+  --stage device || true
+
+# 6. host-side offline sweep (no chip in the loop): simulated-step
+#    stall_frac grid for the PERF.md §20 sidebar
+step "offline_sweep" 1800 python scripts/input_pipeline_bench.py \
+  --sweep --images 512 --batch 128 --stepMs 45 \
+  --workers 1,2,4,8,16 --depths 1,2,4 --stages off,host,device || true
+
+# 7. summarize every JSON line in this log for PERF.md §20
+step "summarize" 300 python scripts/update_perf_from_capture.py "$OUT"

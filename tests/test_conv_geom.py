@@ -439,11 +439,15 @@ class TestBenchHygiene:
         line = bench._build_line("resnet50", None, {}, ["no result"])
         assert line["vs_baseline"] is None
 
-    def test_pipe_dropped_and_geom_ab_present(self):
+    def test_pipe_ab_and_geom_ab_present(self):
+        # PR 3 dropped resnet50_pipe (0.99% MFU told us nothing new);
+        # ISSUE 13 re-admits it as the before leg of the executor feed
+        # A/B, paired with resnet50_pipe_exec
         src = open(os.path.join(os.path.dirname(os.path.dirname(
             os.path.abspath(__file__))), "bench.py")).read()
         sweep = src[src.index("for cname, cmodel"):]
-        assert '("resnet50_pipe"' not in sweep
+        assert '("resnet50_pipe"' in sweep
+        assert '("resnet50_pipe_exec"' in sweep
         assert '("resnet50_geom"' in sweep
 
     def test_hard_grade_tta_pinned(self):
