@@ -46,7 +46,7 @@ from bigdl_tpu.analysis.jaxpr_walk import (aval_bytes, consumers_map,
 from bigdl_tpu.analysis.report import Finding, Report
 
 __all__ = ["CATALOG", "run_jaxpr_rules", "run_module_rules",
-           "run_comm_rules", "run_memory_rules",
+           "run_comm_rules", "run_memory_rules", "run_decode_rules",
            "check_block_tiling", "check_block_padding",
            "assert_blocks_tileable", "min_sublane",
            "UPCAST_MIN_BYTES", "DONATE_MIN_BYTES", "VMEM_BUDGET_BYTES",
@@ -153,6 +153,19 @@ CATALOG: Dict[str, Tuple[str, str, str]] = {
     "lint-trace-error": (
         "meta", "info",
         "the step could not be traced; only module-level rules ran"),
+    "decode-sampling-sort": (
+        "decode", "warning",
+        "full-vocab sort inside the per-token decode step — top-k/top-p "
+        "warping pays O(V log V) per slot per token; at large vocab the "
+        "sampler dominates the step (serve only the sort-free program "
+        "to greedy/temperature traffic, or filter on a partial "
+        "threshold)"),
+    "kv-page-misfit": (
+        "decode", "warning",
+        "KV page token size misfits the layout: off the 8-sublane grid "
+        "every pool page pads its tile, and when neither the flash "
+        "block_k nor the page divides the other, K blocks straddle "
+        "page boundaries in the gathered view (kv_page_plan)"),
 }
 
 UPCAST_MIN_BYTES = 2 * 1024 * 1024    # ignore small/scalar converts
@@ -162,6 +175,7 @@ VMEM_WARN_FRAC = 0.8
 COMM_F32_MIN_BYTES = 1 * 1024 * 1024  # grad wire worth compressing
 COMM_MAX_COLLECTIVES = 16             # per-leaf-reduce smell threshold
 HBM_WARN_FRAC = 0.85                  # plan/HBM ratio that earns hbm-tight
+DECODE_SORT_MIN_LANES = 16384         # vocab size where the warp sort bites
 
 _SUBLANE = {4: 8, 2: 16, 1: 32}
 
@@ -488,6 +502,68 @@ def _rule_host_sync(levels, report: Report) -> None:
                     hint="move host I/O outside the jitted step (log "
                          "from returned scalars; debug prints only "
                          "under a debug flag)"))
+
+
+def _rule_decode_sort(levels, report: Report) -> None:
+    for lv in levels:
+        for i, eqn in enumerate(lv.jaxpr.eqns):
+            if eqn.primitive.name != "sort":
+                continue
+            aval = eqn.invars[0].aval
+            lanes = int(aval.shape[-1]) if getattr(aval, "shape", ()) \
+                else 0
+            if lanes >= DECODE_SORT_MIN_LANES:
+                report.add(_finding(
+                    "decode-sampling-sort",
+                    f"sort over {lanes} lanes in the decode step "
+                    "(top-k/top-p warp) — O(V log V) per slot per "
+                    "token",
+                    where=lv.where(i, eqn),
+                    hint="route greedy/temperature-only traffic "
+                         "through the sort-free step program (the "
+                         "engine picks per round); consider a "
+                         "threshold-filter sampler at this vocab",
+                    detail={"lanes": lanes}))
+
+
+def run_decode_rules(closed=None, *, page_tokens: Optional[int] = None,
+                     max_len: Optional[int] = None,
+                     head_dim: Optional[int] = None, dtype=None,
+                     report: Optional[Report] = None) -> Report:
+    """Decode-hot-path rules (ISSUE 14), run by the serve preflight
+    before the first request: equation-level anti-patterns in the traced
+    decode step (``DecodeEngine.trace_step_jaxpr()``) — host callbacks
+    (error: a per-token host round-trip caps tokens/s at the tunnel
+    latency) and full-vocab sampling sorts (warning) — plus the static
+    page-layout fit against the flash block plan when paging is on."""
+    report = report if report is not None else Report()
+    if closed is not None:
+        levels = list(iter_levels(closed))
+        _rule_host_sync(levels, report)
+        _rule_decode_sort(levels, report)
+    if page_tokens and max_len and head_dim:
+        from bigdl_tpu.ops.attention_kernel import kv_page_plan
+        plan = kv_page_plan(page_tokens, max_len, head_dim,
+                            dtype if dtype is not None else np.float32)
+        problems = []
+        if not plan["sublane_ok"]:
+            problems.append(f"page_tokens {page_tokens} % 8 != 0 "
+                            "(padded sublanes on every pool page)")
+        if not plan["block_aligned"]:
+            problems.append(
+                f"page_tokens {page_tokens} vs flash block_k "
+                f"{plan['block_k']}: neither divides the other — K "
+                "blocks straddle page boundaries")
+        if problems:
+            report.add(_finding(
+                "kv-page-misfit", "; ".join(problems),
+                where=f"kv_pages(page_tokens={page_tokens}, "
+                      f"max_len={max_len})",
+                hint="pick --kvPageTokens from the tuned ladder "
+                     "(tuning.kv_page_tokens: 32/64/128/256, 8-aligned "
+                     "and block-commensurate) or 'auto'",
+                detail=plan))
+    return report
 
 
 def run_jaxpr_rules(closed, report: Optional[Report] = None) -> Report:

@@ -76,6 +76,28 @@ def build_parser() -> argparse.ArgumentParser:
                         "before 429")
     p.add_argument("--seq", type=int, default=None,
                    help="override the LM sequence length / max context")
+    p.add_argument("--speculate", type=int, default=0, metavar="K",
+                   help="speculative decoding: a draft LM proposes K "
+                        "tokens per round, the target verifies them in "
+                        "one chunked dispatch (exact acceptance — greedy "
+                        "output is bit-identical to --speculate 0). "
+                        "Default draft is the target itself (self-draft); "
+                        "pass --draftDims for a smaller proposer")
+    p.add_argument("--draftDims", default=None,
+                   metavar="DMODEL,LAYERS,HEADS",
+                   help="draft-model dims for --speculate (randomly "
+                        "initialized; acceptance stays exact, only the "
+                        "accept RATE depends on draft quality)")
+    p.add_argument("--kvPageTokens", default=None, metavar="N|auto",
+                   help="paged KV cache: fixed pages of N tokens with "
+                        "per-slot page tables — kv_cache_bytes then "
+                        "tracks ALLOCATED pages, not slots x max_len. "
+                        "'auto' consults the kv_pages autotune namespace "
+                        "(falls back to 128 where it divides max_len)")
+    p.add_argument("--prefixCache", action="store_true",
+                   help="share page-aligned prompt-prefix K/V across "
+                        "requests (needs --kvPageTokens): hits copy "
+                        "resident pages and prefill only the suffix")
     p.add_argument("--classes", type=int, default=1000)
     p.add_argument("--bf16", action="store_true",
                    help="bf16 activations (vision: input cast; LM: "
@@ -126,6 +148,41 @@ def build_parser() -> argparse.ArgumentParser:
                    help="per-conv-geometry layout decision JSON "
                         "(scripts/apply_conv_probe.py --geom)")
     return p
+
+
+def _resolve_page_tokens(args, model, compute_dtype):
+    """``--kvPageTokens``: explicit int, 'auto' (tuned via the kv_pages
+    autotune namespace with a 128-where-it-divides fallback), or None
+    (dense cache)."""
+    spec = getattr(args, "kvPageTokens", None)
+    if not spec:
+        return None
+    max_len = args.seq or model.max_len
+    if str(spec).lower() == "auto":
+        import jax.numpy as jnp
+
+        from bigdl_tpu import tuning
+        head_dim = getattr(model.encoder._modules[0].mha, "head_dim",
+                           model.d_model // 4)
+        kv_heads = getattr(model.encoder._modules[0].mha, "num_kv_heads",
+                           args.numHeads)
+        pt = tuning.kv_page_tokens(max_len, kv_heads, head_dim,
+                                   compute_dtype or jnp.float32)
+        if pt is None:  # autotune off: shipped ladder default
+            for cand in (128, 64, 32, 256):
+                if cand <= max_len and max_len % cand == 0:
+                    return cand
+            return None  # ragged max_len: stay dense
+        return pt
+    try:
+        pt = int(spec)
+    except ValueError:
+        raise SystemExit(f"--kvPageTokens {spec!r}: expected an int or "
+                         "'auto'")
+    if pt < 1 or max_len % pt:
+        raise SystemExit(f"--kvPageTokens {pt} must divide the context "
+                         f"length {max_len}")
+    return pt
 
 
 def build_app(args):
@@ -193,10 +250,47 @@ def build_app(args):
                            max_queue=args.maxQueue, metrics=metrics)
     decoder = None
     if is_lm:
+        page_tokens = _resolve_page_tokens(args, model, compute_dtype)
+        if args.prefixCache and page_tokens is None:
+            raise SystemExit("--prefixCache needs --kvPageTokens (prefix "
+                             "sharing is a page copy)")
+        draft_model = draft_params = None
+        if args.speculate > 0 and args.draftDims:
+            import jax
+
+            from bigdl_tpu import models
+            from bigdl_tpu.serving import parse_draft_dims
+            dims = parse_draft_dims(args.draftDims)
+            draft_model = models.transformer_lm(
+                model.vocab, max_len=model.max_len,
+                compute_dtype=compute_dtype, **dims)
+            draft_params = draft_model.init(jax.random.PRNGKey(1))
         decoder = DecodeEngine(model, params, slots=args.slots,
                                cache_dtype=compute_dtype,
                                max_waiting=args.maxWaiting,
-                               metrics=metrics)
+                               metrics=metrics,
+                               kv_page_tokens=page_tokens,
+                               speculate=args.speculate,
+                               draft_model=draft_model,
+                               draft_params=draft_params,
+                               prefix_cache=args.prefixCache)
+        # decode-path lint pre-flight (ISSUE 14): sampling-sort /
+        # host-sync rules over the traced decode step + the page-layout
+        # fit, same strict contract as the forward's preflight
+        lint_mode = getattr(args, "lint", None)
+        if lint_mode is not None:
+            from bigdl_tpu.analysis import run_decode_rules
+            from bigdl_tpu.cli.common import run_preflight_lint
+            head_dim = getattr(model.encoder._modules[0].mha,
+                               "head_dim", model.d_model // 4)
+            report = run_decode_rules(
+                decoder.trace_step_jaxpr(), page_tokens=page_tokens,
+                max_len=decoder.max_len, head_dim=head_dim,
+                dtype=decoder.cache_dtype)
+            rc, _ = run_preflight_lint(report,
+                                       strict=(lint_mode == "strict"))
+            if rc:
+                raise SystemExit(rc)
         decoder.start()
 
     # watchdog over every worker thread: dead/wedged -> pending futures
@@ -221,6 +315,18 @@ def build_app(args):
         prov["decode_slots"] = args.slots
         prov["prompt_buckets"] = ",".join(
             str(b) for b in decoder.prompt_buckets)
+        prov["speculate"] = args.speculate
+        prov["draft_dims"] = args.draftDims or (
+            "self" if args.speculate > 0 else "none")
+        prov["kv_page_tokens"] = decoder.page_tokens or "dense"
+        prov["prefix_cache"] = int(bool(args.prefixCache))
+        if args.speculate > 0:
+            # measured, resolved per scrape: tokens emitted per target
+            # verify dispatch (the ISSUE 14 acceptance number)
+            g = metrics.gauge("spec_accepted_tokens_per_step",
+                              "tokens emitted per target verify step")
+            prov["spec_accepted_tokens_per_step"] = \
+                lambda: round(g.value, 4)
     if getattr(args, "faultPlan", None):
         prov["fault_plan"] = args.faultPlan
     metrics.set_provenance(prov)

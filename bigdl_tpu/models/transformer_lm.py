@@ -204,6 +204,23 @@ class TransformerLM(Module):
                                             pos)
         return self._logits(params, h)[:, 0, :], cache
 
+    def verify_logits(self, params, toks, cache, pos):
+        """Chunked decode: ``toks`` (b, m) int32 at absolute positions
+        ``pos``..``pos+m-1`` (pos traced) -> ((b, m, vocab) logits,
+        cache). Row i is the next-token distribution after feeding
+        toks[:, :i+1] — the single target dispatch that verifies m
+        speculative draft tokens at once, and the suffix prefill a
+        shared-prefix-cache hit runs at a page-aligned offset
+        (bigdl_tpu.serving.spec_decode / prefix_cache). Row-wise
+        bit-identical to m sequential :meth:`decode_logits` calls on
+        the dense CPU path (pinned in tests/test_spec_decode.py).
+        Caller keeps pos + m <= max_len (positional-table slice and
+        cache writes both clamp rather than fail out of range)."""
+        h = self._embed_at(params, toks, pos)
+        h, cache = self.encoder.decode_chunk(params["encoder"], h, cache,
+                                             pos)
+        return self._logits(params, h), cache
+
     def generate(self, params, prompt, max_new_tokens: int,
                  temperature: float = 0.0, top_k: Optional[int] = None,
                  rng=None):

@@ -336,6 +336,21 @@ class MultiHeadAttention(SimpleModule):
     def decode_step(self, params, x, cache, idx):
         """One-token step: x (b, 1, d), ``idx`` = tokens already cached.
         Appends this token's K/V at ``idx`` and attends over 0..idx."""
+        return self.decode_chunk(params, x, cache, idx)
+
+    def decode_chunk(self, params, x, cache, idx):
+        """m-token step: x (b, m, d) at absolute positions idx..idx+m-1.
+        Writes the chunk's K/V at those positions FIRST, then attends
+        causally within the chunk (row i sees cache 0..idx+i), so the
+        chunk is exactly m sequential decode_steps fused into one
+        dispatch — the primitive speculative verification and
+        prefix-cache suffix prefill are built on. Each query row's
+        scores/softmax/weighted-sum are row-independent, so the m=1
+        case IS decode_step (and per-row results match the sequential
+        path bit-for-bit on the dense CPU path — pinned in tests).
+        Caller must keep idx + m <= cache length: dynamic_update_slice
+        clamps out-of-range starts, which would silently shift the
+        write window."""
         q, k, v = self._qkv(params, x)
         if self.rope:
             q, k = self._rope(q, idx), self._rope(k, idx)
@@ -348,7 +363,9 @@ class MultiHeadAttention(SimpleModule):
         s = jnp.einsum("bhqd,bhkd->bhqk", q, ke.astype(q.dtype),
                        preferred_element_type=jnp.float32)
         s = s / (self.head_dim ** 0.5)
-        live = jnp.arange(ke.shape[2])[None, None, None, :] <= idx
+        m = x.shape[1]
+        rows = idx + jnp.arange(m)[None, None, :, None]
+        live = jnp.arange(ke.shape[2])[None, None, None, :] <= rows
         s = jnp.where(live, s, -1e30)
         p = jax.nn.softmax(s, axis=-1)
         o = jnp.einsum("bhqk,bhkd->bhqd", p.astype(q.dtype),
@@ -491,6 +508,11 @@ class TransformerEncoderLayer(Module):
         h, cache = self.mha.decode_step(params["mha"], h, cache, idx)
         return self._mlp(params, x + h), cache
 
+    def decode_chunk(self, params, x, cache, idx):
+        h = self.ln1.forward(params["ln1"], x)
+        h, cache = self.mha.decode_chunk(params["mha"], h, cache, idx)
+        return self._mlp(params, x + h), cache
+
 
 class TransformerEncoder(Sequential):
     """Stack of encoder layers with optional remat.
@@ -574,4 +596,14 @@ class TransformerEncoder(Sequential):
         for i, m in enumerate(self._modules):
             k = str(i)
             x, new[k] = m.decode_step(params[k], x, cache[k], idx)
+        return x, new
+
+    def decode_chunk(self, params, x, cache, idx):
+        """m-token decode: x (b, m, d) at positions idx..idx+m-1 — one
+        dispatch verifies a speculative draft chunk or prefills a
+        prefix-cache suffix (see MultiHeadAttention.decode_chunk)."""
+        new = {}
+        for i, m in enumerate(self._modules):
+            k = str(i)
+            x, new[k] = m.decode_chunk(params[k], x, cache[k], idx)
         return x, new

@@ -242,6 +242,15 @@ class MetricsRegistry:
         with self._lock:
             metrics = list(self._metrics.values())
             prov = dict(self._provenance)
+        # callable provenance values resolve at scrape time — measured
+        # facts (e.g. spec accepted-tokens/step) ride next to the static
+        # config in the same machine-scrapable line
+        for k, v in list(prov.items()):
+            if callable(v):
+                try:
+                    prov[k] = v()
+                except Exception:
+                    prov[k] = None
         lines: List[str] = []
         if prov:
             # machine-scrapable config provenance, one JSON line

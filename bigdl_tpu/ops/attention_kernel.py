@@ -37,7 +37,7 @@ from bigdl_tpu.nn import attention as _dense
 
 __all__ = ["flash_attention", "blockwise_attention",
            "online_softmax_update", "flash_block_plan",
-           "serving_prefill_buckets"]
+           "kv_page_plan", "serving_prefill_buckets"]
 
 _NEG_INF = -1e30
 
@@ -531,6 +531,35 @@ def flash_block_plan(s_q: int, s_k: int, d: int, causal: bool,
         "k_pad": (-int(s_k)) % bk,
         "clamped": (bq < _DEFAULT_BLOCK and bq < s_q)
                    or (bk < _DEFAULT_BLOCK and bk < s_k),
+    }
+
+
+def kv_page_plan(page_tokens: int, max_len: int, head_dim: int,
+                 dtype, causal: bool = True) -> dict:
+    """Static fit of a paged-KV layout (serving/kv_pages) against this
+    shape's flash block plan — the metadata the decode tpulint rule
+    (bigdl_tpu.analysis.run_decode_rules) evaluates without tracing:
+
+    * ``divides_max_len`` — False is a hard engine error (the gathered
+      view must be exactly max_len);
+    * ``sublane_ok`` — pages whose token dim is not a multiple of 8
+      break the (8, 128) tile on every pool leaf: each page then pays a
+      padded sublane, and gathers re-lay the data;
+    * ``block_aligned`` — the prefill flash kernel reads K in
+      ``block_k`` tiles; when neither divides the other, a single K
+      block straddles a page boundary in the gathered view and the
+      scatter back to pools splits every tile (misfit finding);
+    * ``block_k`` — the plan consulted, for the lint message.
+    """
+    plan = flash_block_plan(max_len, max_len, head_dim, causal, dtype)
+    bk = int(plan["block_k"])
+    pt = int(page_tokens)
+    return {
+        "page_tokens": pt,
+        "block_k": bk,
+        "divides_max_len": max_len % pt == 0,
+        "sublane_ok": pt % 8 == 0,
+        "block_aligned": (pt % bk == 0) or (bk % pt == 0),
     }
 
 

@@ -33,13 +33,22 @@ from bigdl_tpu.serving.batcher import (AdmissionError, DeadlineExceeded,
                                        MicroBatcher, WorkerDied)
 from bigdl_tpu.serving.decode import DecodeEngine, DecodeRequest
 from bigdl_tpu.serving.engine import InferenceEngine, power_of_two_buckets
+from bigdl_tpu.serving.kv_pages import (PageAllocator, PagedKvCache,
+                                        pages_needed)
 from bigdl_tpu.serving.metrics import (Counter, Gauge, Histogram,
                                        MetricsRegistry)
+from bigdl_tpu.serving.prefix_cache import PrefixCache
 from bigdl_tpu.serving.server import ServingApp, make_server, run_server
+from bigdl_tpu.serving.spec_decode import (accept_chunk, parse_draft_dims,
+                                           request_key, sample_token,
+                                           warp_logits)
 from bigdl_tpu.serving.watchdog import Watchdog
 
 __all__ = ["AdmissionError", "DeadlineExceeded", "MicroBatcher",
            "WorkerDied", "DecodeEngine", "DecodeRequest",
            "InferenceEngine", "power_of_two_buckets",
+           "PageAllocator", "PagedKvCache", "pages_needed", "PrefixCache",
+           "accept_chunk", "parse_draft_dims", "request_key",
+           "sample_token", "warp_logits",
            "Counter", "Gauge", "Histogram", "MetricsRegistry",
            "ServingApp", "make_server", "run_server", "Watchdog"]

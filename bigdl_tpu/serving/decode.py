@@ -20,13 +20,36 @@ batching):
   (``jax.vmap`` of ``model.decode_logits`` with per-slot positions), so
   requests of different lengths and arrival times share the batch. A
   finishing request frees its slot; the next waiting request prefills
-  into it while the others keep decoding. The whole-cache slot write is
-  a donated jitted update — no per-request cache reallocation.
+  into it while the others keep decoding.
+
+ISSUE 14 rebuilt the hot path around three composable optimisations:
+
+* **sampling modes** — temperature / top-k / top-p with PER-REQUEST
+  seeds (``spec_decode.warp_logits``; randomness is counter-based off
+  the seed, so outputs are deterministic and replayable). The sort-free
+  program still serves requests that only use temperature.
+* **speculative decoding** (``speculate=K``) — a draft LM proposes K
+  tokens per round, the target scores all K+1 positions in ONE chunked
+  ``verify_logits`` dispatch, and exact acceptance keeps greedy output
+  bit-identical / sampled output distribution-correct
+  (:mod:`bigdl_tpu.serving.spec_decode`). Target dispatches per emitted
+  token drop from 1 to 1/(accepted+1).
+* **paged KV** (``kv_page_tokens=N``) — the dense ``slots x max_len``
+  cache becomes pools of N-token pages with per-slot page tables
+  (:mod:`bigdl_tpu.serving.kv_pages`); short requests stop paying
+  max-length HBM (``kv_cache_bytes`` now reports ALLOCATED pages) and
+  admission reserves a request's full page budget up front so decode
+  never deadlocks mid-flight.
+* **shared-prefix cache** (``prefix_cache=True``, needs paging) —
+  prefills whose page-aligned token prefix hashes to a cached entry
+  copy resident pages and chunk-prefill only the suffix
+  (:mod:`bigdl_tpu.serving.prefix_cache`).
 
 Greedy decoding (temperature 0) is bit-exact with the offline
 full-sequence argmax decode (the acceptance contract; see
 tests/test_serving.py) because both run the same ``prefill_logits`` /
-``decode_logits`` graph per token.
+``decode_logits`` graph per token — and speculative greedy is pinned
+bit-identical to that in tests/test_spec_decode.py.
 """
 
 from __future__ import annotations
@@ -39,8 +62,11 @@ from typing import Optional, Sequence
 import numpy as np
 
 from bigdl_tpu.obs.spans import span as _obs_span
+from bigdl_tpu.serving import kv_pages as _kvp
+from bigdl_tpu.serving import spec_decode as _spec
 from bigdl_tpu.serving.batcher import (AdmissionError, DeadlineExceeded,
                                        WorkerDied, _Future)
+from bigdl_tpu.serving.prefix_cache import PrefixCache
 
 logger = logging.getLogger(__name__)
 
@@ -49,15 +75,19 @@ __all__ = ["DecodeEngine", "DecodeRequest"]
 
 class DecodeRequest:
     __slots__ = ("tokens", "max_new_tokens", "temperature", "stop_token",
-                 "future", "out", "deadline")
+                 "top_k", "top_p", "seed", "future", "out", "deadline")
 
     def __init__(self, tokens, max_new_tokens, temperature=0.0,
-                 stop_token=None, deadline=None):
+                 stop_token=None, deadline=None, top_k=0, top_p=1.0,
+                 seed=0):
         self.tokens = [int(t) for t in tokens]
         self.max_new_tokens = int(max_new_tokens)
         self.temperature = float(temperature)
         self.stop_token = stop_token
         self.deadline = deadline
+        self.top_k = int(top_k)
+        self.top_p = float(top_p)
+        self.seed = int(seed) & 0xFFFFFFFF
         self.future = _Future()
         self.out: list = []
 
@@ -65,26 +95,46 @@ class DecodeRequest:
 class DecodeEngine:
     """Continuous-batching KV-cache decoder over a fixed slot count.
 
-    ``slots`` bounds the decode batch (and the cache HBM footprint:
-    slots x layers x kv_heads x max_len x head_dim x 2). ``submit``
-    assigns a free slot (prefill) or queues up to ``max_waiting``
-    requests, rejecting beyond that (:class:`AdmissionError` -> 429).
-    ``step`` advances every active slot one token. Without a worker
-    thread the caller drives ``step`` (tests, ``generate``); ``start()``
-    launches the decode loop for the HTTP server.
+    ``slots`` bounds the decode batch (and, dense, the cache HBM
+    footprint: slots x layers x kv_heads x max_len x head_dim x 2;
+    paged, the page-table width — HBM then follows ALLOCATED pages).
+    ``submit`` assigns a free slot (prefill) or queues up to
+    ``max_waiting`` requests, rejecting beyond that
+    (:class:`AdmissionError` -> 429). ``step`` advances every active
+    slot — one token each plain, up to ``speculate+1`` each
+    speculative. Without a worker thread the caller drives ``step``
+    (tests, ``generate``); ``start()`` launches the decode loop for the
+    HTTP server.
+
+    * ``kv_page_tokens`` — page size in tokens; None keeps the dense
+      layout. Must divide ``max_len``. ``pool_pages`` overrides the
+      pool size (default = the dense footprint + ``prefix_cache``
+      headroom).
+    * ``speculate`` — draft chunk length K; 0 disables. ``draft_model``
+      / ``draft_params`` supply the proposer (default: the target
+      itself — "self-draft", 100% greedy acceptance, useful for
+      dispatch-count wins and CI determinism).
+    * ``prefix_cache`` — share page-aligned prompt-prefix K/V across
+      requests (requires paging).
     """
 
     def __init__(self, model, params, *, slots: int = 4,
                  max_len: Optional[int] = None, cache_dtype=None,
                  prompt_buckets: Optional[Sequence[int]] = None,
                  max_waiting: int = 64, metrics=None,
-                 clock=None):
+                 clock=None, kv_page_tokens: Optional[int] = None,
+                 pool_pages: Optional[int] = None, speculate: int = 0,
+                 draft_model=None, draft_params=None,
+                 prefix_cache: bool = False,
+                 prefix_cache_pages: Optional[int] = None):
         import jax
         import jax.numpy as jnp
         import time as _time
 
         if slots < 1:
             raise ValueError(f"slots must be >= 1, got {slots}")
+        if speculate < 0:
+            raise ValueError(f"speculate must be >= 0, got {speculate}")
         self.clock = clock or _time.monotonic
         self._worker_error: Optional[BaseException] = None
         self._last_beat = self.clock()
@@ -94,6 +144,7 @@ class DecodeEngine:
         self.max_len = int(max_len or model.max_len)
         self.cache_dtype = cache_dtype or model.compute_dtype or jnp.float32
         self.max_waiting = int(max_waiting)
+        self.speculate = int(speculate)
         self._jax, self._jnp = jax, jnp
 
         if prompt_buckets is None:
@@ -110,48 +161,123 @@ class DecodeEngine:
         self._work = threading.Condition(self._lock)
         self._reqs: list = [None] * self.slots
         self._waiting: collections.deque = collections.deque()
-        self._cache = model.encoder.init_cache(self.slots, self.max_len,
-                                               self.cache_dtype)
+
+        # ---- KV backend: dense slab or page pools (ISSUE 14) -------------
+        self.page_tokens = int(kv_page_tokens) if kv_page_tokens else None
+        self.paged = self.page_tokens is not None
+        if prefix_cache and not self.paged:
+            raise ValueError("prefix_cache requires kv_page_tokens "
+                             "(prefix sharing is a page copy)")
+        if self.paged:
+            extra = 0
+            if prefix_cache and pool_pages is None:
+                # headroom so a warm prefix cache never starves decode
+                extra = prefix_cache_pages or (
+                    self.max_len // self.page_tokens)
+            self._kv = _kvp.PagedKvCache(
+                model.encoder, slots=self.slots, max_len=self.max_len,
+                page_tokens=self.page_tokens, dtype=self.cache_dtype,
+                pool_pages=pool_pages, extra_pages=extra)
+            self._cache = None
+        else:
+            self._kv = None
+            self._cache = model.encoder.init_cache(
+                self.slots, self.max_len, self.cache_dtype)
+        self._pfx = (PrefixCache(self._kv, max_pages=prefix_cache_pages,
+                                 metrics=metrics)
+                     if prefix_cache else None)
+
         self._logits = jnp.zeros((self.slots, model.vocab), jnp.float32)
         self._pos = np.zeros(self.slots, np.int32)
         self._temp = np.zeros(self.slots, np.float32)
-        self._key = jax.random.PRNGKey(0)
+        self._topk = np.zeros(self.slots, np.int32)
+        self._topp = np.ones(self.slots, np.float32)
+        self._seed = np.zeros(self.slots, np.uint32)
+        self._pending = np.zeros(self.slots, np.int32)  # speculative only
         self._thread = None
         self._closed = False
 
-        if metrics is not None:
-            self._m_tokens = metrics.counter(
-                "generated_tokens_total", "decode tokens emitted")
-            self._m_steps = metrics.counter(
-                "decode_steps_total", "batched decode steps executed")
-            self._m_prefills = metrics.counter(
-                "prefills_total", "prompt prefills executed")
-            self._m_prompt_tokens = metrics.counter(
-                "prompt_tokens_total", "prompt tokens prefilled")
-            self._m_rejected = metrics.counter(
-                "decode_rejected_total",
-                "generate requests fast-rejected (waiting queue full)")
-            self._m_expired = metrics.counter(
-                "decode_expired_total",
-                "generate requests dropped on deadline expiry")
-            self._m_dead = metrics.counter(
-                "decode_dead_submit_total",
-                "generate submits fast-failed (decode worker dead)")
-            metrics.gauge("decode_worker_up",
-                          "1 while the decode loop is healthy",
-                          fn=lambda: 0.0 if self._worker_error else 1.0)
-            metrics.gauge("decode_slots_active", "occupied decode slots",
-                          fn=lambda: sum(r is not None
-                                         for r in self._reqs))
-            metrics.gauge(
-                "decode_tokens_per_second",
-                "lifetime generated_tokens_total / uptime",
-                fn=lambda: (self._m_tokens.value
-                            / max(metrics.uptime_s(), 1e-9)))
-            # KV-cache byte accounting (ISSUE 12): the resident cost of
-            # max_len x slots — the evidence base for paged KV (ROADMAP
-            # item 2: short requests pay the full max-length HBM today)
-            from bigdl_tpu.obs.memory import tree_bytes as _kv_bytes
+        # ---- draft model (speculative) -----------------------------------
+        if self.speculate > 0:
+            self.draft_model = draft_model or model
+            self.draft_params = (draft_params if draft_model is not None
+                                 else params)
+            if draft_model is not None and draft_params is None:
+                raise ValueError("draft_model without draft_params")
+            self._draft_dtype = (self.draft_model.compute_dtype
+                                 or jnp.float32)
+            self._draft_cache = self.draft_model.encoder.init_cache(
+                self.slots, self.max_len, self._draft_dtype)
+        else:
+            self.draft_model = self.draft_params = None
+            self._draft_cache = None
+
+        self._init_metrics(metrics)
+        self._build_programs()
+
+    # -------------------------------------------------------------- metrics
+    def _init_metrics(self, metrics) -> None:
+        self.metrics = metrics
+        if metrics is None:
+            self._m_tokens = self._m_steps = self._m_prefills = None
+            self._m_prompt_tokens = self._m_rejected = None
+            self._m_expired = self._m_dead = None
+            self._m_spec_prop = self._m_spec_acc = None
+            self._m_draft_steps = None
+            return
+        self._m_tokens = metrics.counter(
+            "generated_tokens_total", "decode tokens emitted")
+        self._m_steps = metrics.counter(
+            "decode_steps_total",
+            "batched TARGET-model decode/verify steps executed")
+        self._m_prefills = metrics.counter(
+            "prefills_total", "prompt prefills executed")
+        self._m_prompt_tokens = metrics.counter(
+            "prompt_tokens_total", "prompt tokens prefilled")
+        self._m_rejected = metrics.counter(
+            "decode_rejected_total",
+            "generate requests fast-rejected (waiting queue full)")
+        self._m_expired = metrics.counter(
+            "decode_expired_total",
+            "generate requests dropped on deadline expiry")
+        self._m_dead = metrics.counter(
+            "decode_dead_submit_total",
+            "generate submits fast-failed (decode worker dead)")
+        metrics.gauge("decode_worker_up",
+                      "1 while the decode loop is healthy",
+                      fn=lambda: 0.0 if self._worker_error else 1.0)
+        metrics.gauge("decode_slots_active", "occupied decode slots",
+                      fn=lambda: sum(r is not None for r in self._reqs))
+        metrics.gauge(
+            "decode_tokens_per_second",
+            "lifetime generated_tokens_total / uptime",
+            fn=lambda: (self._m_tokens.value
+                        / max(metrics.uptime_s(), 1e-9)))
+        # KV-cache byte accounting (ISSUE 12, corrected by ISSUE 14):
+        # paged mode reports ALLOCATED pages — the real resident cost —
+        # not the dense max-len bound the gauges used to assume
+        from bigdl_tpu.obs.memory import tree_bytes as _kv_bytes
+        if self.paged:
+            metrics.gauge("kv_cache_bytes",
+                          "allocated KV page bytes (all slots + prefix "
+                          "cache)",
+                          fn=lambda: self._kv.allocated_bytes())
+            metrics.gauge("kv_cache_bytes_per_slot",
+                          "allocated KV page bytes / slots",
+                          fn=lambda: (self._kv.allocated_bytes()
+                                      / max(1, self.slots)))
+            metrics.gauge("kv_pages_in_use", "KV pool pages handed out",
+                          fn=lambda: self._kv.alloc.pages_in_use)
+            metrics.gauge("kv_page_occupancy_frac",
+                          "live tokens / (pages_in_use x page_tokens)",
+                          fn=self._page_occupancy)
+            logger.info(
+                "decode KV pages: %d-token pages, pool %d pages "
+                "(%d bytes; dense bound was %d bytes)",
+                self.page_tokens, self._kv.pool_pages,
+                self._kv.pool_bytes(),
+                self.slots * self._kv.max_pages * self._kv.bytes_per_page)
+        else:
             kv_total = _kv_bytes(self._cache)
             metrics.gauge("kv_cache_bytes",
                           "resident KV cache bytes (all slots, max_len)",
@@ -163,12 +289,43 @@ class DecodeEngine:
             logger.info("decode KV cache: %d bytes (%d slots x max_len "
                         "%d, %s)", kv_total, self.slots, self.max_len,
                         self.cache_dtype)
+        if self.speculate > 0:
+            self._m_spec_prop = metrics.counter(
+                "spec_proposed_total", "draft tokens proposed")
+            self._m_spec_acc = metrics.counter(
+                "spec_accepted_total", "draft tokens accepted by verify")
+            self._m_draft_steps = metrics.counter(
+                "spec_draft_steps_total", "draft-model decode steps")
+            metrics.gauge(
+                "spec_accept_rate",
+                "accepted / proposed draft tokens",
+                fn=lambda: (self._m_spec_acc.value
+                            / max(self._m_spec_prop.value, 1)))
+            metrics.gauge(
+                "spec_accepted_tokens_per_step",
+                "tokens emitted per target verify step",
+                fn=lambda: (self._m_tokens.value
+                            / max(self._m_steps.value, 1)))
         else:
-            self._m_tokens = self._m_steps = self._m_prefills = None
-            self._m_prompt_tokens = self._m_rejected = None
-            self._m_expired = self._m_dead = None
+            self._m_spec_prop = self._m_spec_acc = None
+            self._m_draft_steps = None
 
-        # ---- compiled programs -------------------------------------------
+    def _page_occupancy(self) -> float:
+        live = int(sum(int(self._pos[i])
+                       for i, r in enumerate(self._reqs) if r is not None))
+        if self._pfx is not None:
+            live += self._pfx.cached_tokens()
+        cap = self._kv.alloc.pages_in_use * self.page_tokens
+        return live / cap if cap else 0.0
+
+    # ---------------------------------------------------- compiled programs
+    def _build_programs(self) -> None:
+        jax, jnp = self._jax, self._jnp
+        model = self.model
+        # donation keeps the big cache in place on device backends; CPU
+        # can't honor it and warns on every compile
+        self._don = jax.default_backend() != "cpu"
+
         def _prefill(params, tokens, last):
             # tokens (1, bucket) int32; last = true_len - 1 (traced)
             cache = model.encoder.init_cache(1, self.max_len,
@@ -178,9 +335,6 @@ class DecodeEngine:
             return logits[0].astype(jnp.float32), cache
 
         self._prefill_jit = jax.jit(_prefill)  # one compile per bucket
-        # donation keeps the big cache in place on device backends; CPU
-        # can't honor it and warns on every compile
-        _don = jax.default_backend() != "cpu"
 
         def _write_slot(cache_full, cache_one, slot):
             return jax.tree_util.tree_map(
@@ -188,24 +342,221 @@ class DecodeEngine:
                     f, o[0].astype(f.dtype), slot, 0),
                 cache_full, cache_one)
 
-        self._write_slot = jax.jit(_write_slot,
-                                   donate_argnums=(0,) if _don else ())
+        self._write_slot = jax.jit(
+            _write_slot, donate_argnums=(0,) if self._don else ())
+        if self.paged:
+            self._scatter_prefill = jax.jit(
+                _kvp.scatter_pages,
+                donate_argnums=(0,) if self._don else ())
+            self._copy_pages_jit = jax.jit(
+                _kvp.copy_pages,
+                donate_argnums=(0,) if self._don else ())
+        # single-vector sampler: install-time first token (speculative)
+        self._sample1_jit = jax.jit(
+            lambda lg, t, k, p, seed, pos: _spec.sample_token(
+                lg, t, k, p, _spec.request_key(seed, pos)))
+        # lazily-built program caches, keyed by shape/variant
+        self._step_programs: dict = {}
+        self._verify_programs: dict = {}
+        self._accept_programs: dict = {}
+        self._suffix_programs: dict = {}
+        self._draft_step_jit = None
 
-        def _one(params, logits, cache1, pos, temp, key):
+    def _sample_fn(self, warp: bool):
+        jax, jnp = self._jax, self._jnp
+
+        def fn(logits, pos, temp, topk, topp, seed):
+            key = _spec.request_key(seed, pos)
+            if warp:
+                return _spec.sample_token(logits, temp, topk, topp, key)
             greedy = jnp.argmax(logits).astype(jnp.int32)
             safe_t = jnp.where(temp > 0, temp, 1.0)
             sampled = jax.random.categorical(
                 key, logits / safe_t).astype(jnp.int32)
-            tok = jnp.where(temp > 0, sampled, greedy)
+            return jnp.where(temp > 0, sampled, greedy)
+
+        return fn
+
+    def _get_step(self, warp: bool):
+        """The plain per-token step. ``warp=False`` is the sort-free
+        program (greedy/temperature-only traffic); ``warp=True`` adds
+        the top-k/top-p filters. Both sample identically when the
+        filters are disabled, so program choice never changes output."""
+        key = ("paged" if self.paged else "dense", warp)
+        prog = self._step_programs.get(key)
+        if prog is not None:
+            return prog
+        jax, jnp = self._jax, self._jnp
+        model, sample = self.model, self._sample_fn(warp)
+
+        if not self.paged:
+            def _one(params, logits, cache1, pos, temp, topk, topp, seed):
+                tok = sample(logits, pos, temp, topk, topp, seed)
+                cache_b = jax.tree_util.tree_map(lambda a: a[None], cache1)
+                lg, cache_b = model.decode_logits(params, tok[None, None],
+                                                  cache_b, pos)
+                return (tok, lg[0].astype(jnp.float32),
+                        jax.tree_util.tree_map(lambda a: a[0], cache_b))
+
+            prog = jax.jit(
+                jax.vmap(_one, in_axes=(None, 0, 0, 0, 0, 0, 0, 0)),
+                donate_argnums=(1, 2) if self._don else ())
+        else:
+            pt = self.page_tokens
+
+            def _paged_step(params, logits, pools, table, pos, temp,
+                            topk, topp, seed):
+                def _one(logits, pages, pos, temp, topk, topp, seed):
+                    tok = sample(logits, pos, temp, topk, topp, seed)
+                    cache1 = _kvp.gather_cache(pools, pages)
+                    cache_b = jax.tree_util.tree_map(
+                        lambda a: a[None], cache1)
+                    lg, cache_b = model.decode_logits(
+                        params, tok[None, None], cache_b, pos)
+                    tok_kv = jax.tree_util.tree_map(
+                        lambda c: jax.lax.dynamic_slice_in_dim(
+                            c[0], pos, 1, axis=1)[:, 0, :], cache_b)
+                    return tok, lg[0].astype(jnp.float32), tok_kv
+
+                toks, lgs, tok_kv = jax.vmap(_one)(
+                    logits, table, pos, temp, topk, topp, seed)
+                page_ids = jnp.take_along_axis(
+                    table, (pos // pt)[:, None], axis=1)[:, 0]
+                pools2 = _kvp.scatter_tokens(pools, tok_kv, page_ids,
+                                             pos % pt)
+                return toks, lgs, pools2
+
+            prog = jax.jit(
+                _paged_step,
+                donate_argnums=(1, 2) if self._don else ())
+        self._step_programs[key] = prog
+        return prog
+
+    def _get_draft_step(self):
+        if self._draft_step_jit is not None:
+            return self._draft_step_jit
+        jax, jnp = self._jax, self._jnp
+        dmodel = self.draft_model
+
+        def _one(dparams, tok, cache1, pos, temp, topk, topp, seed):
             cache_b = jax.tree_util.tree_map(lambda a: a[None], cache1)
-            lg, cache_b = model.decode_logits(params, tok[None, None],
-                                              cache_b, pos)
-            return (tok, lg[0].astype(jnp.float32),
+            lg, cache_b = dmodel.decode_logits(dparams, tok[None, None],
+                                               cache_b, pos)
+            prop, q = _spec.draft_propose(lg[0].astype(jnp.float32),
+                                          temp, topk, topp, seed, pos)
+            return (prop, q,
                     jax.tree_util.tree_map(lambda a: a[0], cache_b))
 
-        self._step_jit = jax.jit(
-            jax.vmap(_one, in_axes=(None, 0, 0, 0, 0, 0)),
-            donate_argnums=(1, 2) if _don else ())
+        self._draft_step_jit = jax.jit(
+            jax.vmap(_one, in_axes=(None, 0, 0, 0, 0, 0, 0, 0)),
+            donate_argnums=(2,) if self._don else ())
+        return self._draft_step_jit
+
+    def _get_verify(self, m: int):
+        prog = self._verify_programs.get(m)
+        if prog is not None:
+            return prog
+        jax, jnp = self._jax, self._jnp
+        model = self.model
+
+        if not self.paged:
+            def _verify(params, toks, cache, pos):
+                def _one(toks1, cache1, pos):
+                    cache_b = jax.tree_util.tree_map(
+                        lambda a: a[None], cache1)
+                    lg, cache_b = model.verify_logits(
+                        params, toks1[None], cache_b, pos)
+                    return (lg[0].astype(jnp.float32),
+                            jax.tree_util.tree_map(lambda a: a[0],
+                                                   cache_b))
+
+                return jax.vmap(_one, in_axes=(0, 0, 0))(toks, cache, pos)
+
+            prog = jax.jit(_verify,
+                           donate_argnums=(2,) if self._don else ())
+        else:
+            pt = self.page_tokens
+
+            def _verify(params, toks, pools, table, pos):
+                def _one(toks1, pages, pos):
+                    cache1 = _kvp.gather_cache(pools, pages)
+                    cache_b = jax.tree_util.tree_map(
+                        lambda a: a[None], cache1)
+                    lg, cache_b = model.verify_logits(
+                        params, toks1[None], cache_b, pos)
+                    tok_kv = jax.tree_util.tree_map(
+                        lambda c: jax.lax.dynamic_slice_in_dim(
+                            c[0], pos, m, axis=1), cache_b)  # (kh, m, hd)
+                    return lg[0].astype(jnp.float32), tok_kv
+
+                lgs, tok_kv = jax.vmap(_one)(toks, table, pos)
+                abspos = pos[:, None] + jnp.arange(m)[None, :]  # (S, m)
+                page_ids = jnp.take_along_axis(table, abspos // pt,
+                                               axis=1).reshape(-1)
+                offs = (abspos % pt).reshape(-1)
+                flat = jax.tree_util.tree_map(
+                    lambda c: c.transpose(0, 2, 1, 3).reshape(
+                        (-1,) + c.shape[1:2] + c.shape[3:]), tok_kv)
+                pools2 = _kvp.scatter_tokens(pools, flat, page_ids, offs)
+                return lgs, pools2
+
+            prog = jax.jit(_verify,
+                           donate_argnums=(2,) if self._don else ())
+        self._verify_programs[m] = prog
+        return prog
+
+    def _get_accept(self, m: int):
+        prog = self._accept_programs.get(m)
+        if prog is None:
+            jax = self._jax
+            prog = jax.jit(jax.vmap(_spec.accept_chunk,
+                                    in_axes=(0, 0, 0, 0, 0, 0, 0, 0)))
+            self._accept_programs[m] = prog
+        return prog
+
+    def _get_suffix(self, mb: int):
+        """Chunked suffix prefill at a page-aligned offset — the
+        prefix-cache HIT path (paged only)."""
+        prog = self._suffix_programs.get(mb)
+        if prog is not None:
+            return prog
+        jax, jnp = self._jax, self._jnp
+        model = self.model
+
+        def _suffix(params, toks, pages, pos0, last, pools):
+            cache1 = _kvp.gather_cache(pools, pages)
+            cache_b = jax.tree_util.tree_map(lambda a: a[None], cache1)
+            lgs, cache_b = model.verify_logits(params, toks, cache_b,
+                                               pos0)
+            lg = jax.lax.dynamic_slice_in_dim(
+                lgs[0], last, 1, axis=0)[0].astype(jnp.float32)
+            pools2 = _kvp.scatter_pages(pools, cache_b, pages)
+            return lg, pools2
+
+        prog = jax.jit(_suffix, donate_argnums=(5,) if self._don else ())
+        self._suffix_programs[mb] = prog
+        return prog
+
+    def trace_step_jaxpr(self):
+        """Jaxpr of the full-sampling decode step — what the tpulint
+        decode rules inspect (``bigdl_tpu.analysis.run_decode_rules``)."""
+        jax, jnp = self._jax, self._jnp
+        S, V = self.slots, self.model.vocab
+        f32 = lambda *s: jax.ShapeDtypeStruct(s, jnp.float32)
+        i32 = lambda *s: jax.ShapeDtypeStruct(s, jnp.int32)
+        sds = lambda a: jax.tree_util.tree_map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), a)
+        args = [sds(self.params), f32(S, V)]
+        jnp_u32 = jax.ShapeDtypeStruct((S,), jnp.uint32)
+        jax_fn = self._get_step(warp=True)
+        if self.paged:
+            args += [sds(self._kv.pools),
+                     i32(S, self._kv.max_pages), i32(S), f32(S),
+                     i32(S), f32(S), jnp_u32]
+        else:
+            args += [sds(self._cache), i32(S), f32(S), i32(S), f32(S),
+                     jnp_u32]
+        return jax.make_jaxpr(jax_fn)(*args)
 
     # ------------------------------------------------------------ admission
     def prompt_bucket_for(self, n: int) -> int:
@@ -216,13 +567,16 @@ class DecodeEngine:
 
     def submit(self, tokens, max_new_tokens: int,
                temperature: float = 0.0, stop_token=None,
-               deadline: Optional[float] = None) -> _Future:
+               deadline: Optional[float] = None, top_k: int = 0,
+               top_p: float = 1.0, seed: int = 0) -> _Future:
         """Queue one generation request; the future resolves to the list
         of generated token ids. Validates the length budget, fast-rejects
         when the waiting queue is full, when the decode worker is dead
         (:class:`WorkerDied` — nothing would ever drain the queue), or
         when ``deadline`` (absolute, on the engine's clock) has already
-        passed (:class:`DeadlineExceeded`)."""
+        passed (:class:`DeadlineExceeded`). ``top_k=0`` / ``top_p=1``
+        disable those filters; ``seed`` makes sampled output
+        deterministic per request."""
         tokens = list(tokens)
         if not tokens:
             raise ValueError("empty prompt")
@@ -233,8 +587,12 @@ class DecodeEngine:
             raise ValueError(
                 f"prompt ({len(tokens)}) + max_new_tokens "
                 f"({max_new_tokens}) exceeds max_len {self.max_len}")
+        if top_k < 0:
+            raise ValueError(f"top_k must be >= 0, got {top_k}")
+        if not 0.0 < top_p <= 1.0:
+            raise ValueError(f"top_p must be in (0, 1], got {top_p}")
         req = DecodeRequest(tokens, max_new_tokens, temperature,
-                            stop_token, deadline)
+                            stop_token, deadline, top_k, top_p, seed)
         with self._lock:
             if self._closed:
                 raise RuntimeError("decode engine is closed")
@@ -251,8 +609,8 @@ class DecodeEngine:
                     self._m_expired.inc()
                 raise DeadlineExceeded("deadline expired before submit")
             slot = self._free_slot()
-            if slot is not None:
-                self._install(req, slot)
+            if slot is not None and self._install(req, slot):
+                pass
             elif len(self._waiting) >= self.max_waiting:
                 if self._m_rejected is not None:
                     self._m_rejected.inc()
@@ -269,25 +627,159 @@ class DecodeEngine:
                 return i
         return None
 
+    def _release_slot(self, slot: int) -> None:
+        self._reqs[slot] = None
+        self._pos[slot] = 0
+        if self.paged:
+            self._kv.release(slot)
+
+    def _handoff(self, slot: int) -> None:
+        """Install the next waiting request into a freed slot. A paged
+        reservation failure (pool still too full) puts the request back
+        at the queue head — FIFO order is preserved and the request is
+        retried as soon as more pages free up."""
+        while self._waiting:
+            req = self._waiting.popleft()
+            if self._install(req, slot):
+                return
+            self._waiting.appendleft(req)
+            return
+
     # -------------------------------------------------------------- prefill
-    def _install(self, req: DecodeRequest, slot: int) -> None:
-        """Prefill ``req``'s prompt into ``slot`` (lock held)."""
+    def _install(self, req: DecodeRequest, slot: int) -> bool:
+        """Prefill ``req``'s prompt into ``slot`` (lock held). False iff
+        the paged pool cannot serve the request's page reservation yet —
+        the caller keeps it queued; nothing was spent."""
         jnp = self._jnp
+        s = len(req.tokens)
+        if self.paged and not self._kv.reserve(slot,
+                                               s + req.max_new_tokens):
+            return False
+        with _obs_span("decode_prefill", prompt=s):
+            n_pfx, src_pages = (self._pfx.match(req.tokens)
+                                if self._pfx is not None else (0, []))
+            if n_pfx:
+                logits_vec = self._prefill_from_prefix(
+                    req, slot, n_pfx, src_pages)
+            else:
+                bucket = self.prompt_bucket_for(s)
+                padded = np.zeros((1, bucket), np.int32)
+                padded[0, :s] = req.tokens
+                logits_vec, cache1 = self._prefill_jit(
+                    self.params, jnp.asarray(padded), jnp.int32(s - 1))
+                if self.paged:
+                    self._kv.pools = self._scatter_prefill(
+                        self._kv.pools, cache1,
+                        jnp.asarray(self._kv.page_table[slot]))
+                else:
+                    self._cache = self._write_slot(self._cache, cache1,
+                                                   jnp.int32(slot))
+            if self._pfx is not None:
+                self._maybe_insert_prefix(req, slot)
+        self._logits = self._logits.at[slot].set(logits_vec)
+        self._pos[slot] = s
+        self._temp[slot] = req.temperature
+        self._topk[slot] = req.top_k
+        self._topp[slot] = req.top_p
+        self._seed[slot] = req.seed
+        self._reqs[slot] = req
+        if self._m_prefills is not None:
+            self._m_prefills.inc()
+            self._m_prompt_tokens.inc(s - n_pfx)
+        if self.speculate > 0:
+            self._install_draft(req, slot)
+            # speculative mode emits the first token NOW (it becomes the
+            # round's pending feed) — same sample the plain step's first
+            # iteration would draw (same key: fold_in(seed, pos=s))
+            tok0 = int(self._sample1_jit(
+                logits_vec, jnp.float32(req.temperature),
+                jnp.int32(req.top_k), jnp.float32(req.top_p),
+                jnp.uint32(req.seed), jnp.int32(s)))
+            self._pending[slot] = tok0
+            self._emit(req, slot, [tok0])
+        return True
+
+    def _prefill_from_prefix(self, req, slot: int, n_pfx: int, src_pages):
+        """Prefix-cache HIT: device-copy the entry's pages into the
+        slot, then chunk-prefill only the suffix at offset ``n_pfx`` —
+        bit-identical to the full prefill (the copied K/V came from the
+        identical graph; suffix rows compute the same per-row math)."""
+        jnp = self._jnp
+        s = len(req.tokens)
+        pt = self.page_tokens
+        dst = self._kv.page_table[slot, :n_pfx // pt]
+        with _obs_span("prefix_copy", pages=len(src_pages)):
+            self._kv.pools = self._copy_pages_jit(
+                self._kv.pools, jnp.asarray(src_pages, jnp.int32),
+                jnp.asarray(dst))
+        suffix = req.tokens[n_pfx:]
+        mb = min(self.prompt_bucket_for(len(suffix)),
+                 self.max_len - n_pfx)
+        padded = np.zeros((1, mb), np.int32)
+        padded[0, :len(suffix)] = suffix
+        logits_vec, self._kv.pools = self._get_suffix(mb)(
+            self.params, jnp.asarray(padded),
+            jnp.asarray(self._kv.page_table[slot]), jnp.int32(n_pfx),
+            jnp.int32(len(suffix) - 1), self._kv.pools)
+        return logits_vec
+
+    def _maybe_insert_prefix(self, req, slot: int) -> None:
+        ins = self._pfx.prepare_insert(req.tokens)
+        if ins is None:
+            return
+        key, dst_pages = ins
+        need = len(dst_pages)
+        src = self._kv.page_table[slot, :need]
+        jnp = self._jnp
+        self._kv.pools = self._copy_pages_jit(
+            self._kv.pools, jnp.asarray(src),
+            jnp.asarray(dst_pages, jnp.int32))
+        self._pfx.commit_insert(key, dst_pages, need * self.page_tokens)
+
+    def _install_draft(self, req, slot: int) -> None:
+        """Prefill the draft model's own (dense) cache for this slot."""
+        jax, jnp = self._jax, self._jnp
         s = len(req.tokens)
         bucket = self.prompt_bucket_for(s)
         padded = np.zeros((1, bucket), np.int32)
         padded[0, :s] = req.tokens
-        logits_vec, cache1 = self._prefill_jit(
-            self.params, jnp.asarray(padded), jnp.int32(s - 1))
-        self._cache = self._write_slot(self._cache, cache1,
-                                       jnp.int32(slot))
-        self._logits = self._logits.at[slot].set(logits_vec)
-        self._pos[slot] = s
-        self._temp[slot] = req.temperature
-        self._reqs[slot] = req
-        if self._m_prefills is not None:
-            self._m_prefills.inc()
-            self._m_prompt_tokens.inc(s)
+        if not hasattr(self, "_draft_prefill_jit"):
+            dmodel, ddtype = self.draft_model, self._draft_dtype
+
+            def _dprefill(dparams, tokens, last):
+                cache = dmodel.encoder.init_cache(1, self.max_len, ddtype)
+                _, cache = dmodel.prefill_logits(dparams, tokens, cache,
+                                                 last)
+                return cache
+
+            self._draft_prefill_jit = jax.jit(_dprefill)
+        cache1 = self._draft_prefill_jit(
+            self.draft_params, jnp.asarray(padded), jnp.int32(s - 1))
+        self._draft_cache = self._write_slot(self._draft_cache, cache1,
+                                             jnp.int32(slot))
+
+    # ------------------------------------------------------------- emission
+    def _emit(self, req, slot: int, toks) -> bool:
+        """Append generated tokens to ``req`` (respecting stop token and
+        max_new budget), resolve + hand off if finished. Returns True if
+        the request completed. Lock held."""
+        done = False
+        emitted = 0
+        for tok in toks:
+            req.out.append(int(tok))
+            emitted += 1
+            if (len(req.out) >= req.max_new_tokens
+                    or (req.stop_token is not None
+                        and int(tok) == req.stop_token)):
+                done = True
+                break
+        if self._m_tokens is not None and emitted:
+            self._m_tokens.inc(emitted)
+        if done:
+            self._release_slot(slot)
+            req.future.set_result(list(req.out))
+            self._handoff(slot)
+        return done
 
     # ------------------------------------------------------------- deadlines
     def _expire(self, now: float) -> None:
@@ -310,69 +802,152 @@ class DecodeEngine:
         for i, req in enumerate(self._reqs):
             if (req is not None and req.deadline is not None
                     and now >= req.deadline):
-                self._reqs[i] = None
+                self._release_slot(i)
                 if self._m_expired is not None:
                     self._m_expired.inc()
                 req.future.set_exception(DeadlineExceeded(
                     f"deadline expired after {len(req.out)} of "
                     f"{req.max_new_tokens} tokens"))
-                if self._waiting:
-                    self._install(self._waiting.popleft(), i)
+                self._handoff(i)
 
     # ---------------------------------------------------------------- step
     def step(self) -> int:
-        """One batched decode step: every active slot emits one token.
+        """One batched decode step: every active slot emits one token
+        (plain) or up to ``speculate+1`` tokens (speculative round).
         Returns the number of active slots advanced (0 = idle). Finished
         requests resolve their futures and hand their slot to the next
         waiting request; expired ones are dropped before compute."""
-        jax, jnp = self._jax, self._jnp
         with self._lock:
             self._last_beat = self.clock()
             self._expire(self.clock())
-            active = [i for i, r in enumerate(self._reqs) if r is not None]
+            active = [i for i, r in enumerate(self._reqs)
+                      if r is not None]
             if not active:
                 return 0
-            self._key, sub = jax.random.split(self._key)
-            keys = jax.random.split(sub, self.slots)
-            with _obs_span("decode_step", active=len(active)):
-                try:
-                    toks, self._logits, self._cache = self._step_jit(
-                        self.params, self._logits, self._cache,
-                        jnp.asarray(self._pos), jnp.asarray(self._temp),
-                        keys)
-                except Exception as e:
-                    # RESOURCE_EXHAUSTED autopsy (ISSUE 12): the KV
-                    # cache is usually the culprit — report to
-                    # --traceDir + fault log, then die as before
-                    from bigdl_tpu.obs import memory as _obs_mem
-                    _obs_mem.handle_oom(e, "decode_step")
-                    raise
-                toks_host = np.asarray(toks)
-            if self._m_steps is not None:
-                self._m_steps.inc()
-                self._m_tokens.inc(len(active))
-            for i in active:
-                req = self._reqs[i]
-                tok = int(toks_host[i])
-                req.out.append(tok)
-                self._pos[i] += 1
-                done = (len(req.out) >= req.max_new_tokens
-                        or (req.stop_token is not None
-                            and tok == req.stop_token))
-                if done:
-                    self._reqs[i] = None
-                    req.future.set_result(list(req.out))
-                    if self._waiting:
-                        self._install(self._waiting.popleft(), i)
-            return len(active)
+            if self.speculate > 0:
+                return self._step_spec(active)
+            return self._step_plain(active)
+
+    def _sampling_args(self):
+        jnp = self._jnp
+        return (jnp.asarray(self._pos), jnp.asarray(self._temp),
+                jnp.asarray(self._topk), jnp.asarray(self._topp),
+                jnp.asarray(self._seed))
+
+    def _needs_warp(self, active) -> bool:
+        return any(self._topk[i] > 0 or self._topp[i] < 1.0
+                   for i in active)
+
+    def _step_plain(self, active) -> int:
+        jnp = self._jnp
+        prog = self._get_step(self._needs_warp(active))
+        pos, temp, topk, topp, seed = self._sampling_args()
+        with _obs_span("decode_step", active=len(active)):
+            try:
+                if self.paged:
+                    toks, self._logits, self._kv.pools = prog(
+                        self.params, self._logits, self._kv.pools,
+                        jnp.asarray(self._kv.page_table), pos, temp,
+                        topk, topp, seed)
+                else:
+                    toks, self._logits, self._cache = prog(
+                        self.params, self._logits, self._cache, pos,
+                        temp, topk, topp, seed)
+            except Exception as e:
+                # RESOURCE_EXHAUSTED autopsy (ISSUE 12): the KV cache is
+                # usually the culprit — report to --traceDir + fault
+                # log, then die as before
+                from bigdl_tpu.obs import memory as _obs_mem
+                _obs_mem.handle_oom(e, "decode_step")
+                raise
+            toks_host = np.asarray(toks)
+        if self._m_steps is not None:
+            self._m_steps.inc()
+        for i in active:
+            req = self._reqs[i]
+            self._pos[i] += 1
+            self._emit(req, i, [int(toks_host[i])])
+        return len(active)
+
+    def _step_spec(self, active) -> int:
+        """One speculative round: m-1 draft proposals + the sync feed,
+        ONE chunked target verify, exact acceptance, emit 1..m tokens
+        per slot (m = speculate+1 clamped to the cache tail)."""
+        jax, jnp = self._jax, self._jnp
+        # the chunk writes K/V at pos..pos+m-1 for every active slot;
+        # clamping m keeps writes inside max_len (dynamic_update_slice
+        # would silently SHIFT an out-of-range window). pos <= max_len-2
+        # always (prompt+max_new <= max_len and the final token is never
+        # fed), so m >= 2 — at least one proposal per round.
+        m = min(self.speculate + 1,
+                self.max_len - max(int(self._pos[i]) for i in active))
+        pos, temp, topk, topp, seed = self._sampling_args()
+        feed = jnp.asarray(self._pending)
+        draft_step = self._get_draft_step()
+        props, qrows = [], []
+        with _obs_span("spec_draft", active=len(active), feeds=m):
+            for j in range(m):
+                prop_j, q_j, self._draft_cache = draft_step(
+                    self.draft_params, feed, self._draft_cache,
+                    pos + j, temp, topk, topp, seed)
+                if j < m - 1:
+                    props.append(prop_j)
+                    qrows.append(q_j)
+                    feed = prop_j
+        if self._m_draft_steps is not None:
+            self._m_draft_steps.inc(m * len(active))
+        chunk = jnp.stack([jnp.asarray(self._pending)] + props, axis=1)
+        if props:
+            pstack = jnp.stack(props, axis=1)
+            qstack = jnp.stack(qrows, axis=1)
+        else:
+            # m == 1 (a slot is one token from max_len): pure verify of
+            # the pending feed, zero proposals — accept_chunk handles
+            # the degenerate (m-1)=0 shapes
+            pstack = jnp.zeros((self.slots, 0), jnp.int32)
+            qstack = jnp.zeros((self.slots, 0, self.model.vocab),
+                               jnp.float32)
+        with _obs_span("spec_verify", active=len(active), chunk=m):
+            try:
+                if self.paged:
+                    T, self._kv.pools = self._get_verify(m)(
+                        self.params, chunk, self._kv.pools,
+                        jnp.asarray(self._kv.page_table), pos)
+                else:
+                    T, self._cache = self._get_verify(m)(
+                        self.params, chunk, self._cache, pos)
+            except Exception as e:
+                from bigdl_tpu.obs import memory as _obs_mem
+                _obs_mem.handle_oom(e, "decode_step")
+                raise
+        emitted, n_emit, n_acc = self._get_accept(m)(
+            T, qstack, pstack, temp, topk, topp, seed, pos)
+        emitted = np.asarray(emitted)
+        n_emit = np.asarray(n_emit)
+        n_acc = np.asarray(n_acc)
+        if self._m_steps is not None:
+            self._m_steps.inc()
+        if self._m_spec_prop is not None:
+            self._m_spec_prop.inc((m - 1) * len(active))
+            self._m_spec_acc.inc(int(sum(int(n_acc[i]) for i in active)))
+        for i in active:
+            req = self._reqs[i]
+            k = int(n_emit[i])
+            stream = [int(t) for t in emitted[i, :k]]
+            self._pos[i] += k
+            if not self._emit(req, i, stream):
+                self._pending[i] = stream[-1]
+        return len(active)
 
     def generate(self, tokens, max_new_tokens: int,
-                 temperature: float = 0.0, stop_token=None) -> list:
+                 temperature: float = 0.0, stop_token=None, top_k: int = 0,
+                 top_p: float = 1.0, seed: int = 0) -> list:
         """Synchronous single-request convenience: submit + drive the
         decode loop until this request resolves (other queued requests
         keep advancing alongside — continuous batching has no 'exclusive'
         mode)."""
-        fut = self.submit(tokens, max_new_tokens, temperature, stop_token)
+        fut = self.submit(tokens, max_new_tokens, temperature, stop_token,
+                          top_k=top_k, top_p=top_p, seed=seed)
         if self._thread is None:
             while not fut.done():
                 if self.step() == 0 and not fut.done():
@@ -412,7 +987,7 @@ class DecodeEngine:
             self._waiting.clear()
             for i, req in enumerate(self._reqs):
                 if req is not None:
-                    self._reqs[i] = None
+                    self._release_slot(i)
                     dead.append(req)
             self._work.notify_all()
         err = (exc if isinstance(exc, WorkerDied)
@@ -457,7 +1032,7 @@ class DecodeEngine:
             self._waiting.clear()
             for i, req in enumerate(self._reqs):
                 if req is not None:
-                    self._reqs[i] = None
+                    self._release_slot(i)
                     req.future.set_exception(
                         RuntimeError("decode engine closed mid-request"))
             self._work.notify_all()

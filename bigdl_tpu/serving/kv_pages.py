@@ -1,0 +1,222 @@
+"""Paged KV allocation for the continuous-batching decoder (ISSUE 14).
+
+The dense layout pays ``slots x max_len`` HBM per request no matter how
+short the request is — PR 11's ``kv_cache_bytes`` gauges made that the
+single biggest resident cost of a serving process. This module replaces
+it with the vLLM-style fix: each layer's K/V lives in a pool of
+fixed-size PAGES of ``page_tokens`` tokens, and every slot owns just the
+pages its token budget needs, recorded in a per-slot page table.
+
+* **Allocation is a host-side free list** (:class:`PageAllocator`): page
+  ids are plain ints, page 0 is reserved as the NULL page — unused page-
+  table entries point at it, and writes that fall past a slot's
+  reservation land in it. Its contents are garbage by design; the decode
+  live-mask guarantees garbage positions are never attended before being
+  overwritten (the same argument that makes bucketed prefill exact).
+* **Admission is reservation-based**: a slot reserves
+  ``ceil((prompt + max_new) / page_tokens)`` pages up front, so a request
+  that starts decoding can always finish — no mid-decode OOM deadlock,
+  requests that don't fit simply wait in the queue.
+* **The device side is pure functions** used inside the engine's jitted
+  steps: :func:`gather_cache` rebuilds a slot's contiguous (kv_heads,
+  max_len, head_dim) view from its pages (a transient — freed when the
+  step ends; *residency* is what pages cut), :func:`scatter_tokens`
+  writes per-token K/V back into the pools, :func:`scatter_pages`
+  repacks a whole contiguous cache into a slot's pages after prefill.
+
+``page_tokens`` must divide ``max_len`` (keeps the gathered view exactly
+max_len, so decode/verify graphs and the positional tables are shared
+bit-for-bit with the dense path) — `bigdl_tpu.tuning.kv_page_tokens`
+picks it, `bigdl_tpu.analysis` lints it against the flash block plan.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import List, Optional
+
+__all__ = ["PageAllocator", "PagedKvCache", "gather_cache",
+           "scatter_tokens", "scatter_pages", "pages_needed"]
+
+
+def pages_needed(tokens: int, page_tokens: int) -> int:
+    return -(-int(tokens) // int(page_tokens))
+
+
+# --------------------------------------------------------- device helpers
+def gather_cache(pools, pages):
+    """Rebuild a slot's contiguous cache view from its page table row.
+
+    ``pools``: pytree with leaves (pool_pages, kv_heads, page_tokens,
+    head_dim); ``pages``: (max_pages,) int32 page ids (0 = null). Returns
+    the same pytree with leaves (kv_heads, max_pages*page_tokens,
+    head_dim) — the exact shape ``model.decode_logits`` expects, so the
+    decode graph is unchanged; only where the bytes live changed.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    def g(leaf):
+        x = jnp.take(leaf, pages, axis=0)      # (mp, kh, pt, hd)
+        mp, kh, pt, hd = x.shape
+        return x.transpose(1, 0, 2, 3).reshape(kh, mp * pt, hd)
+
+    return jax.tree_util.tree_map(g, pools)
+
+
+def scatter_tokens(pools, tok_kv, page_ids, offsets):
+    """Write per-token K/V back into the pools.
+
+    ``tok_kv``: pytree with leaves (n, kv_heads, head_dim) — n writes;
+    ``page_ids``/``offsets``: (n,) int32. Slots own disjoint pages so
+    real writes never collide; junk writes all land in null page 0.
+    """
+    import jax
+
+    def s(pool, vals):
+        return pool.at[page_ids, :, offsets, :].set(
+            vals.astype(pool.dtype))
+
+    return jax.tree_util.tree_map(s, pools, tok_kv)
+
+
+def scatter_pages(pools, cache, pages):
+    """Repack a contiguous (1, kv_heads, max_pages*pt, head_dim) cache
+    into pool pages ``pages`` ((max_pages,) int32) — the post-prefill
+    write. Tail entries past the reservation are 0: those page-sized
+    chunks of pad K/V pile into the null page, harmlessly."""
+    import jax
+
+    def s(pool, c):
+        kh, length, hd = c.shape[1], c.shape[2], c.shape[3]
+        mp = pages.shape[0]
+        pt = length // mp
+        x = c[0].reshape(kh, mp, pt, hd).transpose(1, 0, 2, 3)
+        return pool.at[pages].set(x.astype(pool.dtype))
+
+    return jax.tree_util.tree_map(s, pools, cache)
+
+
+def copy_pages(pools, src, dst):
+    """Device-copy pages ``src`` -> ``dst`` ((n,) int32 each) across
+    every layer pool — the shared-prefix-cache hit/insert primitive."""
+    import jax
+    import jax.numpy as jnp
+
+    def c(pool):
+        return pool.at[dst].set(jnp.take(pool, src, axis=0))
+
+    return jax.tree_util.tree_map(c, pools)
+
+
+# ------------------------------------------------------------- allocation
+class PageAllocator:
+    """Host-side free list over page ids 1..pool_pages-1 (0 = null)."""
+
+    def __init__(self, pool_pages: int):
+        if pool_pages < 2:
+            raise ValueError(f"pool needs >= 2 pages (1 null + 1 real), "
+                             f"got {pool_pages}")
+        self.pool_pages = int(pool_pages)
+        self._free: collections.deque = collections.deque(
+            range(1, self.pool_pages))
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    @property
+    def pages_in_use(self) -> int:
+        return (self.pool_pages - 1) - len(self._free)
+
+    def alloc(self, n: int) -> Optional[List[int]]:
+        """n pages, or None if the pool can't serve them (caller queues)."""
+        if n > len(self._free):
+            return None
+        return [self._free.popleft() for _ in range(n)]
+
+    def free(self, pages) -> None:
+        for p in pages:
+            if not 1 <= p < self.pool_pages:
+                raise ValueError(f"freeing invalid page id {p}")
+            self._free.append(int(p))
+
+
+class PagedKvCache:
+    """Pools + per-slot page tables + the allocator, owned by
+    :class:`bigdl_tpu.serving.decode.DecodeEngine` when
+    ``kv_page_tokens`` is set.
+
+    ``pool_pages`` defaults to ``1 + slots * max_pages_per_slot`` — the
+    dense footprint, so default behaviour is never worse; raise ``slots``
+    or add prefix-cache headroom without growing it to see the paging
+    win, or shrink it to run more slots in fixed HBM.
+    """
+
+    def __init__(self, encoder, *, slots: int, max_len: int,
+                 page_tokens: int, dtype, pool_pages: Optional[int] = None,
+                 extra_pages: int = 0):
+        import numpy as np
+
+        page_tokens = int(page_tokens)
+        if page_tokens < 1:
+            raise ValueError(f"page_tokens must be >= 1, got {page_tokens}")
+        if max_len % page_tokens:
+            raise ValueError(
+                f"kv page_tokens ({page_tokens}) must divide max_len "
+                f"({max_len}) so the gathered view is exactly max_len")
+        self.page_tokens = page_tokens
+        self.max_len = int(max_len)
+        self.slots = int(slots)
+        self.max_pages = max_len // page_tokens
+        if pool_pages is None:
+            pool_pages = 1 + self.slots * self.max_pages + int(extra_pages)
+        self.pool_pages = int(pool_pages)
+        self.alloc = PageAllocator(self.pool_pages)
+        # pools: template one-page cache broadcast to pool_pages
+        import jax
+        import jax.numpy as jnp
+        tmpl = encoder.init_cache(1, page_tokens, dtype)
+        self.pools = jax.tree_util.tree_map(
+            lambda a: jnp.zeros((self.pool_pages,) + a.shape[1:], a.dtype),
+            tmpl)
+        self._bytes_per_page = sum(
+            int(np.prod(a.shape[1:])) * a.dtype.itemsize
+            for a in jax.tree_util.tree_leaves(self.pools))
+        # host page table mirrors what the device jits are handed
+        self.page_table = np.zeros((self.slots, self.max_pages), np.int32)
+        self.slot_pages: List[List[int]] = [[] for _ in range(self.slots)]
+
+    # ------------------------------------------------------------- slots
+    def reserve(self, slot: int, n_tokens: int) -> bool:
+        """Reserve pages covering ``n_tokens`` for ``slot``; False if the
+        pool can't serve it right now (request stays queued)."""
+        need = pages_needed(n_tokens, self.page_tokens)
+        got = self.alloc.alloc(need)
+        if got is None:
+            return False
+        self.release(slot)
+        self.slot_pages[slot] = got
+        self.page_table[slot, :] = 0
+        self.page_table[slot, :need] = got
+        return True
+
+    def release(self, slot: int) -> None:
+        if self.slot_pages[slot]:
+            self.alloc.free(self.slot_pages[slot])
+            self.slot_pages[slot] = []
+        self.page_table[slot, :] = 0
+
+    # ----------------------------------------------------------- metrics
+    @property
+    def bytes_per_page(self) -> int:
+        return self._bytes_per_page
+
+    def allocated_bytes(self) -> int:
+        """Bytes backing pages actually handed out — what
+        ``kv_cache_bytes`` reports in paged mode (vs the dense max-len
+        bound it used to report; ISSUE 14 satellite)."""
+        return self.alloc.pages_in_use * self._bytes_per_page
+
+    def pool_bytes(self) -> int:
+        return self.pool_pages * self._bytes_per_page

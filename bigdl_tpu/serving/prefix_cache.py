@@ -1,0 +1,168 @@
+"""Shared-prefix KV cache for the serving decoder (ISSUE 14).
+
+Production prompt traffic is heavily prefix-shared — the same system
+prompt, few-shot block, or conversation head fronts thousands of
+requests. Recomputing that prefill per request is pure waste: the K/V a
+causal model produces for a prefix depends on the prefix alone. With the
+KV cache already paged (:mod:`bigdl_tpu.serving.kv_pages`), reuse is a
+device-side page copy:
+
+* on a prefill MISS the engine runs the normal bucketed prefill, then
+  donates a copy of the slot's leading page-aligned pages to the cache
+  under a hash of the token prefix they encode;
+* on a HIT the engine copies the entry's pages into the new slot's page
+  table and runs a CHUNKED suffix prefill (``TransformerLM.
+  verify_logits`` at the page-aligned offset) for the remaining tokens
+  only — bit-identical to the full prefill because the copied K/V was
+  produced by the identical prefill graph and every suffix row computes
+  the same per-row math at the same positions (pinned in
+  tests/test_spec_decode.py).
+
+Entries are page-granular: a prompt of ``s`` tokens caches
+``floor(min(s - 1, aligned) / page_tokens)`` pages — at least one suffix
+token always recomputes, because the engine needs the next-token logits
+at position ``s-1`` and cached pages carry K/V, not logits. Matching
+walks aligned prefix lengths longest-first, so a hit is always the
+deepest cached ancestor. Eviction is LRU under a page budget served by
+the SAME allocator the slots use — cache pressure and decode pressure
+meet in one accounting (``kv_pages_in_use`` counts both).
+"""
+
+from __future__ import annotations
+
+import collections
+import hashlib
+from typing import List, Optional, Tuple
+
+__all__ = ["PrefixCache"]
+
+
+def _digest(tokens) -> bytes:
+    import numpy as np
+
+    return hashlib.sha1(
+        np.asarray(tokens, np.int64).tobytes()).digest()
+
+
+class _Entry:
+    __slots__ = ("pages", "n_tokens")
+
+    def __init__(self, pages: List[int], n_tokens: int):
+        self.pages = pages
+        self.n_tokens = n_tokens
+
+
+class PrefixCache:
+    """LRU page-granular prefix store over a :class:`PageAllocator`.
+
+    ``max_pages`` bounds the pages the cache may hold at once (default:
+    half the pool) — inserts that cannot fit evict LRU entries first and
+    are skipped (never block decode) if eviction cannot make room.
+    """
+
+    def __init__(self, kv, *, max_pages: Optional[int] = None,
+                 metrics=None):
+        self.kv = kv
+        self.page_tokens = kv.page_tokens
+        if max_pages is None:
+            max_pages = max(1, (kv.pool_pages - 1) // 2)
+        self.max_pages = int(max_pages)
+        self._entries: "collections.OrderedDict[bytes, _Entry]" = \
+            collections.OrderedDict()
+        self.hits = 0
+        self.misses = 0
+        self.inserts = 0
+        self.evictions = 0
+        if metrics is not None:
+            self._m_hits = metrics.counter(
+                "prefix_cache_hits_total",
+                "prefills served from the shared-prefix KV cache")
+            self._m_miss = metrics.counter(
+                "prefix_cache_misses_total",
+                "prefills with no usable cached prefix")
+        else:
+            self._m_hits = self._m_miss = None
+
+    # ------------------------------------------------------------ lookup
+    def cached_pages(self) -> int:
+        return sum(len(e.pages) for e in self._entries.values())
+
+    def cached_tokens(self) -> int:
+        return sum(e.n_tokens for e in self._entries.values())
+
+    def _usable_prefix(self, n_prompt: int) -> int:
+        """Longest cacheable prefix of an n-token prompt: page-aligned
+        and strictly shorter than the prompt (the last position must
+        recompute to produce the next-token logits)."""
+        return ((n_prompt - 1) // self.page_tokens) * self.page_tokens
+
+    def match(self, tokens) -> Tuple[int, List[int]]:
+        """Longest cached prefix of ``tokens`` -> (n_tokens, pages).
+        (0, []) on miss. Counts the hit/miss."""
+        n = self._usable_prefix(len(tokens))
+        while n >= self.page_tokens:
+            key = _digest(tokens[:n])
+            ent = self._entries.get(key)
+            if ent is not None:
+                self._entries.move_to_end(key)
+                self.hits += 1
+                if self._m_hits is not None:
+                    self._m_hits.inc()
+                return ent.n_tokens, list(ent.pages)
+            n -= self.page_tokens
+        self.misses += 1
+        if self._m_miss is not None:
+            self._m_miss.inc()
+        return 0, []
+
+    # ------------------------------------------------------------ insert
+    def insertable_prefix(self, tokens) -> int:
+        """How many leading tokens of ``tokens`` an insert would cache
+        (0 = nothing new to cache)."""
+        n = self._usable_prefix(len(tokens))
+        if n < self.page_tokens:
+            return 0
+        if _digest(tokens[:n]) in self._entries:
+            return 0
+        return n
+
+    def prepare_insert(self, tokens) -> Optional[Tuple[bytes, List[int]]]:
+        """Reserve pages for caching ``tokens``' usable prefix, evicting
+        LRU entries as needed. Returns (key, dst_pages) — the caller
+        device-copies the slot's leading pages into ``dst_pages`` then
+        calls :meth:`commit_insert` — or None when nothing should be
+        cached (too short, already cached, or no room even after
+        eviction)."""
+        n = self.insertable_prefix(tokens)
+        if n == 0:
+            return None
+        need = n // self.page_tokens
+        if need > self.max_pages:
+            return None
+        while (self.cached_pages() + need > self.max_pages
+               or self.kv.alloc.free_pages < need):
+            if not self._entries:
+                break
+            self._evict_one()
+        pages = self.kv.alloc.alloc(need)
+        if pages is None:
+            return None
+        return _digest(tokens[:n]), pages
+
+    def commit_insert(self, key: bytes, pages: List[int],
+                      n_tokens: int) -> None:
+        self._entries[key] = _Entry(pages, n_tokens)
+        self.inserts += 1
+
+    def abort_insert(self, pages: List[int]) -> None:
+        self.kv.alloc.free(pages)
+
+    # ----------------------------------------------------------- eviction
+    def _evict_one(self) -> None:
+        key, ent = self._entries.popitem(last=False)
+        self.kv.alloc.free(ent.pages)
+        self.evictions += 1
+
+    def clear(self) -> None:
+        while self._entries:
+            self._evict_one()

@@ -10,7 +10,9 @@ micro-batcher concurrency levels) exposing
   concurrent callers share bucketed forwards;
 * ``POST /generate`` — ``{"tokens": [...], "max_new_tokens": N}`` ->
   generated token ids from the continuous-batching KV-cache decoder
-  (LM models only);
+  (LM models only); optional ``temperature`` / ``top_k`` / ``top_p`` /
+  ``seed`` select and seed the sampling mode (per-request counter-based
+  randomness: the same seed replays the same output);
 * ``GET /healthz``   — LIVENESS: 200 while the process can answer HTTP
   at all (a degraded server is alive — restarting it would lose the
   still-working endpoints);
@@ -212,8 +214,16 @@ class ServingApp:
         temperature = payload.get("temperature", 0.0)
         stop = payload.get("stop_token")
         try:
+            top_k = int(payload.get("top_k", 0))
+            top_p = float(payload.get("top_p", 1.0))
+            seed = int(payload.get("seed", 0))
+        except (TypeError, ValueError):
+            return 400, {"error": "'top_k'/'seed' must be ints, 'top_p' "
+                                  "a float"}
+        try:
             fut = self.decoder.submit(tokens, max_new, temperature, stop,
-                                      deadline=self._deadline_from(payload))
+                                      deadline=self._deadline_from(payload),
+                                      top_k=top_k, top_p=top_p, seed=seed)
         except ValueError as e:
             return 400, {"error": str(e)}
         out_tokens = fut.result(self.request_timeout_s)

@@ -1,0 +1,182 @@
+"""Speculative decoding primitives for the serving decoder (ISSUE 14).
+
+A small DRAFT ``transformer_lm`` proposes K tokens with K cheap
+single-token steps; the TARGET model then scores all K (plus the bonus
+position) in ONE chunked dispatch (``TransformerLM.verify_logits``) and
+an exact acceptance rule decides how many proposals stand. Per emitted
+token the target runs ``1/(accepted+1)`` dispatches instead of 1 — the
+whole win; nothing about the output distribution changes:
+
+* **greedy** (temperature 0): proposal j is accepted iff it equals the
+  target argmax at its position, the first rejection is replaced by that
+  argmax, and a full acceptance appends the bonus argmax — token for
+  token the sequence the non-speculative greedy loop emits (acceptance
+  criterion; pinned bit-identical in tests/test_spec_decode.py);
+* **sampled**: classic speculative rejection sampling (Leviathan et al.
+  / Chen et al.): accept proposal ``d ~ q`` with prob ``min(1, p(d) /
+  q(d))``, on rejection resample from ``normalize(max(p - q, 0))``, on
+  full acceptance sample the bonus from ``p`` — the emitted tokens are
+  distributed EXACTLY as if sampled from the target alone (distribution
+  check under fixed seeds in tests).
+
+All randomness is counter-based off the per-request seed:
+``fold_in(fold_in(PRNGKey(seed), position), stream_tag)`` — replayable,
+order-independent, and disjoint between the draft-proposal, acceptance
+and residual streams. The same ``warp_logits`` implements the plain
+path's temperature/top-k/top-p (satellite: finish sampling modes), so
+speculative-off sampling uses byte-identical warping.
+
+Everything here is pure and trace-safe (top-k/top-p arrive as traced
+per-slot scalars; sentinels ``top_k=0`` / ``top_p>=1`` disable exactly —
+the keep-mask is all-True, so disabled warping is bitwise a no-op).
+"""
+
+from __future__ import annotations
+
+__all__ = ["warp_logits", "sample_token", "request_key", "draft_propose",
+           "accept_chunk", "parse_draft_dims", "STREAM_STEP",
+           "STREAM_DRAFT", "STREAM_ACCEPT", "STREAM_RESIDUAL"]
+
+# stream tags folded into per-request keys so the four consumers of
+# randomness never share a counter
+STREAM_STEP = 0        # plain-path / bonus sampling at a position
+STREAM_DRAFT = 1       # draft proposal sampling
+STREAM_ACCEPT = 2      # acceptance uniforms
+STREAM_RESIDUAL = 3    # rejection-residual resampling
+
+
+def request_key(seed, pos, stream=STREAM_STEP):
+    """Per-(request, position, stream) PRNG key. Deterministic given the
+    request seed — the satellite contract: same seed, same output."""
+    import jax
+
+    base = jax.random.PRNGKey(seed)
+    return jax.random.fold_in(jax.random.fold_in(base, pos), stream)
+
+
+def warp_logits(logits, temp, top_k, top_p):
+    """Temperature + top-k + top-p warp of a (vocab,) logit vector with
+    TRACED knobs (one compiled program serves every request mix).
+
+    ``top_k == 0`` and ``top_p >= 1`` disable their filters exactly
+    (all-True keep mask -> output is bitwise ``logits / temp``). Both
+    filters share one descending sort; thresholds replace
+    ``lax.top_k`` because k is traced. ``temp <= 0`` is passed through
+    un-scaled (greedy callers argmax raw logits anyway)."""
+    import jax
+    import jax.numpy as jnp
+
+    v = logits.shape[-1]
+    safe_t = jnp.where(temp > 0, temp, 1.0)
+    lg = logits / safe_t
+    srt = jnp.sort(lg)[::-1]                              # descending
+    # top-k: keep logits >= k-th largest (k traced; 0 -> vocab)
+    k = jnp.clip(jnp.where(top_k > 0, top_k, v), 1, v).astype(jnp.int32)
+    kth = srt[k - 1]
+    keep = lg >= kth
+    # top-p: smallest prefix of descending probs whose mass reaches p
+    prob = jax.nn.softmax(srt)
+    csum = jnp.cumsum(prob)
+    p = jnp.clip(top_p, 0.0, 1.0)
+    nucleus = (csum - prob) < p                           # head always in
+    n_keep = jnp.maximum(jnp.sum(nucleus.astype(jnp.int32)), 1)
+    pth = srt[n_keep - 1]
+    keep &= jnp.where(top_p >= 1.0, True, lg >= pth)
+    return jnp.where(keep, lg, -1e30)
+
+
+def sample_token(logits, temp, top_k, top_p, key):
+    """One token from a (vocab,) logit vector: argmax when ``temp <= 0``
+    (raw logits — the greedy contract predates warping and stays
+    bit-identical), else categorical over the warped logits."""
+    import jax
+    import jax.numpy as jnp
+
+    greedy = jnp.argmax(logits).astype(jnp.int32)
+    warped = warp_logits(logits, temp, top_k, top_p)
+    sampled = jax.random.categorical(key, warped).astype(jnp.int32)
+    return jnp.where(temp > 0, sampled, greedy)
+
+
+def draft_propose(logits, temp, top_k, top_p, seed, pos):
+    """Draft-side proposal at ``pos``: (token, q) where q is the warped
+    draft distribution the acceptance test needs. Greedy slots propose
+    the draft argmax (q unused there)."""
+    import jax
+    import jax.numpy as jnp
+
+    warped = warp_logits(logits, temp, top_k, top_p)
+    q = jax.nn.softmax(warped)
+    key = request_key(seed, pos, STREAM_DRAFT)
+    sampled = jax.random.categorical(key, warped).astype(jnp.int32)
+    greedy = jnp.argmax(logits).astype(jnp.int32)
+    return jnp.where(temp > 0, sampled, greedy), q
+
+
+def accept_chunk(target_logits, draft_q, proposals, temp, top_k, top_p,
+                 seed, pos):
+    """Exact acceptance for ONE slot's verified chunk.
+
+    ``target_logits``: (m, vocab) f32 — row j is the target's
+    distribution after the first j+1 chunk feeds (feed 0 is the pending
+    token, feeds 1..m-1 are the proposals). ``draft_q``: (m-1, vocab)
+    warped draft distributions each proposal was drawn from.
+    ``proposals``: (m-1,) int32. Returns ``(emitted, n_emit, n_accept)``
+    — ``emitted[:n_emit]`` is the token stream this round appends
+    (accepted proposals + one correction/bonus), ``n_accept`` the
+    accepted-proposal count feeding the ``spec_accept_rate`` gauge.
+    Designed for use under ``jax.vmap`` over slots."""
+    import jax
+    import jax.numpy as jnp
+
+    m = target_logits.shape[0]
+    greedy = jnp.argmax(target_logits, axis=-1).astype(jnp.int32)  # (m,)
+    warped = jax.vmap(warp_logits, in_axes=(0, None, None, None))(
+        target_logits, temp, top_k, top_p)
+    p = jax.nn.softmax(warped, axis=-1)                           # (m, v)
+    j = jnp.arange(m - 1)
+    p_d = p[j, proposals]
+    q_d = draft_q[j, proposals]
+    u = jax.random.uniform(request_key(seed, pos, STREAM_ACCEPT), (m - 1,))
+    ok_sampled = u * jnp.maximum(q_d, 1e-30) < p_d
+    ok_greedy = proposals == greedy[: m - 1]
+    ok = jnp.where(temp > 0, ok_sampled, ok_greedy)
+    # accepted prefix length: first rejection stops everything after it
+    n_accept = jnp.sum(jnp.cumprod(ok.astype(jnp.int32))).astype(jnp.int32)
+    # correction (first rejection) / bonus (full acceptance) token: the
+    # residual distribution is max(p - q, 0) renormalized; on full
+    # acceptance the "draft row" is all-zero so the residual IS p — one
+    # formula covers both
+    q_pad = jnp.concatenate(
+        [draft_q, jnp.zeros_like(draft_q[:1])], axis=0)            # (m, v)
+    p_a = p[n_accept]
+    resid = jnp.maximum(p_a - q_pad[n_accept], 0.0)
+    rs = jnp.sum(resid)
+    resid = jnp.where(rs > 0, resid / rs, p_a)
+    r_key = jax.random.fold_in(
+        request_key(seed, pos, STREAM_RESIDUAL), n_accept)
+    extra_sampled = jax.random.categorical(
+        r_key, jnp.log(jnp.maximum(resid, 1e-38))).astype(jnp.int32)
+    extra = jnp.where(temp > 0, extra_sampled, greedy[n_accept])
+    # emitted stream: proposals[:n_accept] then the correction/bonus
+    prop_pad = jnp.concatenate(
+        [proposals, jnp.zeros((1,), jnp.int32)], axis=0)           # (m,)
+    idx = jnp.arange(m)
+    emitted = jnp.where(idx < n_accept, prop_pad,
+                        jnp.where(idx == n_accept, extra, 0))
+    return emitted, n_accept + 1, n_accept
+
+
+def parse_draft_dims(spec: str):
+    """``--draftDims d_model,num_layers,num_heads`` -> dict of
+    transformer_lm kwargs for the draft model."""
+    parts = [int(x) for x in str(spec).split(",")]
+    if len(parts) != 3:
+        raise ValueError(
+            f"--draftDims wants d_model,num_layers,num_heads; got {spec!r}")
+    d_model, num_layers, num_heads = parts
+    if d_model % num_heads:
+        raise ValueError(f"draft d_model {d_model} must be divisible by "
+                         f"num_heads {num_heads}")
+    return {"d_model": d_model, "num_layers": num_layers,
+            "num_heads": num_heads}

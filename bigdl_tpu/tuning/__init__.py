@@ -11,6 +11,7 @@ from bigdl_tpu.tuning.autotune import (MODES, annotation, bn_row_block,
                                        dry_run, fba_row_block, flash_blocks,
                                        get_cache, get_mode,
                                        grad_bucket_bytes,
+                                       kv_page_tokens,
                                        install_conv_layouts,
                                        make_key, put_geom_decisions,
                                        reset, reset_decisions,
@@ -20,7 +21,7 @@ from bigdl_tpu.tuning.cache import (CACHE_VERSION, AutotuneCache, cache_dir,
 
 __all__ = ["MODES", "set_mode", "get_mode", "dry_run", "make_key",
            "flash_blocks", "bn_row_block", "fba_row_block",
-           "grad_bucket_bytes",
+           "grad_bucket_bytes", "kv_page_tokens",
            "install_conv_layouts", "conv_geom_key", "conv_geom_layout",
            "put_geom_decisions",
            "annotation", "reset", "reset_decisions", "get_cache",

@@ -36,7 +36,7 @@ from bigdl_tpu.tuning.cache import AutotuneCache
 
 __all__ = ["MODES", "set_mode", "get_mode", "dry_run", "make_key",
            "flash_blocks", "bn_row_block", "fba_row_block",
-           "grad_bucket_bytes",
+           "grad_bucket_bytes", "kv_page_tokens",
            "install_conv_layouts", "conv_geom_layout", "conv_geom_key",
            "peek_geom_layout", "put_geom_decisions",
            "annotation", "reset", "reset_decisions", "get_cache"]
@@ -59,6 +59,12 @@ BN_ROW_BLOCKS = (128, 256, 512, 1024, 2048)
 # default: small enough to keep several reduces in flight behind the
 # backward, large enough to amortize per-collective launch latency
 GRAD_BUCKET_BYTES = tuple(m * 2 ** 20 for m in (1, 2, 4, 8, 16))
+
+# KV page sizes swept for the paged decode cache (ISSUE 14): small pages
+# cut allocation waste on short requests, large pages cut the gather's
+# index fan-out and keep the (8, 128) sublane tiling dense — 128 is the
+# shipped default where it divides max_len
+KV_PAGE_TOKENS = (32, 64, 128, 256)
 
 CONV_VARIANTS = ("plain", "inner", "s2d")
 
@@ -298,6 +304,34 @@ def grad_bucket_bytes(param_bytes: int, n_devices: int,
 
     config, _ = _resolve(key, default, _measure)
     return int(config["bucket_bytes"])
+
+
+def kv_page_tokens(max_len: int, kv_heads: int, head_dim: int,
+                   dtype) -> Optional[int]:
+    """Tuned KV page size in tokens for the paged decode cache
+    (``kv_pages`` namespace), or None when the mode is off — the caller
+    (cli/serve ``--kvPageTokens auto``) then keeps the shipped default.
+    Keyed per (max_len, kv_heads, head_dim, dtype): the gather/scatter
+    cost a page size pays is a function of the cache geometry, not the
+    model's name. Candidates must divide max_len (the engine requires
+    it so the gathered view is exactly max_len)."""
+    if _MODE == "off":
+        return None
+    cands = [c for c in KV_PAGE_TOKENS
+             if c <= max_len and max_len % c == 0]
+    if not cands:
+        return None  # ragged max_len: the engine's explicit value owns it
+    key = make_key("kv_pages", max_len=max_len, kv_heads=kv_heads,
+                   head_dim=head_dim, dtype=_dtype_name(dtype))
+    default = {"page_tokens": 128 if 128 in cands else cands[-1]}
+
+    def _measure():
+        from bigdl_tpu.tuning.measure import measure_kv_page_tokens
+        return measure_kv_page_tokens(max_len, kv_heads, head_dim, dtype,
+                                      cands)
+
+    config, _ = _resolve(key, default, _measure)
+    return int(config["page_tokens"])
 
 
 def conv_geom_key(pass_name: str, geom: tuple) -> str:

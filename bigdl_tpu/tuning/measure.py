@@ -21,6 +21,7 @@ from typing import Dict, List, Sequence, Tuple
 __all__ = ["time_fn", "measure_flash_blocks", "measure_bn_row_block",
            "measure_fba_row_block", "measure_conv_layouts",
            "measure_conv_geom", "measure_grad_buckets",
+           "measure_kv_page_tokens",
            "CONV_PROBE_SHAPES"]
 
 _WARMUP = 1
@@ -292,3 +293,39 @@ def measure_conv_layouts(dtype) -> Tuple[dict, float]:
         decision[p] = lay
         best_total += per[lay]
     return decision, best_total
+
+
+def measure_kv_page_tokens(max_len: int, kv_heads: int, head_dim: int,
+                           dtype, candidates: Sequence[int]
+                           ) -> Tuple[dict, float]:
+    """Time one paged decode-step memory roundtrip per page-size
+    candidate: gather a slot's pages into the contiguous view the decode
+    graph reads, then scatter one token's K/V back — the two data
+    movements paging adds to every step. Small pages pay index fan-out
+    (max_len/pt gather rows), large pages pay transfer granularity; the
+    sweet spot is the chip's to declare. Returns
+    ({"page_tokens": best}, best_ms)."""
+    import jax
+    import jax.numpy as jnp
+
+    timed: List[Tuple[dict, float]] = []
+    for pt in candidates:
+        mp = max_len // pt
+        pool = jax.random.normal(
+            jax.random.PRNGKey(0),
+            (1 + mp, kv_heads, pt, head_dim)).astype(dtype)
+        pages = jnp.arange(1, mp + 1, dtype=jnp.int32)
+        tok = jnp.ones((kv_heads, head_dim), dtype)
+
+        def roundtrip(pool, pages=pages, tok=tok, mp=mp, pt=pt):
+            x = jnp.take(pool, pages, axis=0)
+            view = x.transpose(1, 0, 2, 3).reshape(
+                kv_heads, mp * pt, head_dim)
+            # fold the view back in so the gather cannot be elided
+            upd = tok + view[:, -1, :]
+            return pool.at[pages[-1], :, pt - 1, :].set(upd)
+
+        fn = jax.jit(roundtrip)
+        ms = time_fn(fn, pool)  # pool-shaped output: calls chain
+        timed.append(({"page_tokens": int(pt)}, ms))
+    return _pick(timed)

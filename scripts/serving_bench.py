@@ -219,6 +219,35 @@ def scrape_provenance(url):
     return None, page
 
 
+def scrape_value(page, name):
+    """Last sample of a counter/gauge on the exposition page (with or
+    without the bigdl_serving_ prefix), or None if absent."""
+    for line in page.splitlines():
+        parts = line.split()
+        if len(parts) == 2 and parts[0] in (name,
+                                            "bigdl_serving_" + name):
+            try:
+                return float(parts[1])
+            except ValueError:
+                return None
+    return None
+
+
+def scrape_spec_columns(page):
+    """The ISSUE 14 speculative-decoding columns: accept rate and tokens
+    emitted per target verify step (the dispatch-count win the bench
+    reports alongside tokens/s). None-valued when serving --speculate 0.
+    """
+    return {
+        "spec_accept_rate": scrape_value(page, "spec_accept_rate"),
+        "accepted_tokens_per_step": scrape_value(
+            page, "spec_accepted_tokens_per_step"),
+        "decode_steps_total": scrape_value(page, "decode_steps_total"),
+        "generated_tokens_total": scrape_value(
+            page, "generated_tokens_total"),
+    }
+
+
 def run_smoke(url, args, page_checks=True):
     """Tiny assertion pass: every endpoint answers, metrics count."""
     st, _ = _get(url + "/healthz")
@@ -242,6 +271,52 @@ def run_smoke(url, args, page_checks=True):
              if l.startswith("bigdl_serving_requests_predict_total ")]
     assert count and float(count[0].split()[-1]) >= 4, count
     print("smoke: endpoints + metrics provenance OK", flush=True)
+
+
+def run_spec_smoke(args):
+    """ISSUE 14 speculative-decoding assertion pass (CI):
+
+    spawn the same tiny LM twice — --speculate 0 and --speculate 4 —
+    fire one fixed greedy /generate prompt at each, and assert the
+    speculative tokens are BIT-IDENTICAL to the plain ones (the exact-
+    acceptance contract), that spec_accept_rate lands non-zero, and
+    that the accepted-tokens/step gauge shows >1 token per target
+    dispatch (the raw-speed win, observable without a chip as a
+    dispatch-count proxy: fewer verify steps than emitted tokens)."""
+    prompt = list(range(1, 13))
+    body = {"tokens": prompt, "max_new_tokens": 16}
+    results = {}
+    for k in (0, 4):
+        extra = list(args.serveArg) + ["--speculate", str(k)]
+        proc, url, log_lines = spawn_server(args, extra)
+        try:
+            st, out = _post(url + "/generate", body)
+            assert st == 200, f"--speculate {k} /generate -> {st}"
+            prov, page = scrape_provenance(url)
+            assert prov["speculate"] == k, prov
+            results[k] = (out["tokens"], scrape_spec_columns(page), prov)
+        finally:
+            _shutdown_clean(proc, log_lines)
+    plain, spec = results[0][0], results[4][0]
+    assert spec == plain, (
+        f"speculative greedy output diverged:\n  plain {plain}\n"
+        f"  spec  {spec}")
+    cols = results[4][1]
+    assert cols["spec_accept_rate"] and cols["spec_accept_rate"] > 0, cols
+    assert cols["accepted_tokens_per_step"] > 1.0, cols
+    assert cols["decode_steps_total"] < cols["generated_tokens_total"], \
+        cols
+    # the measured number also rides the provenance line (scrape-time
+    # resolved), next to the static --speculate config
+    prov = results[4][2]
+    assert prov["spec_accepted_tokens_per_step"] > 1.0, prov
+    record = {"bench": "serving_spec_smoke", "prompt_len": len(prompt),
+              "max_new_tokens": 16, "bit_identical": True, **cols}
+    print(json.dumps(record), flush=True)
+    print(f"spec-smoke: --speculate 4 bit-identical, accept_rate="
+          f"{cols['spec_accept_rate']:.2f}, accepted-tokens/step="
+          f"{cols['accepted_tokens_per_step']:.2f} OK", flush=True)
+    return 0
 
 
 def _shutdown_clean(proc, log_lines):
@@ -341,6 +416,11 @@ def main(argv=None):
     p.add_argument("--platform", default=None, choices=["cpu", "tpu"])
     p.add_argument("--smoke", action="store_true",
                    help="assertion pass + clean-shutdown check (CI)")
+    p.add_argument("--specSmoke", action="store_true",
+                   help="speculative-decoding assertion pass (ISSUE 14):"
+                        " --speculate 4 /generate bit-identical to "
+                        "--speculate 0, non-zero accept rate, >1 "
+                        "accepted-tokens/step (spawns its own servers)")
     p.add_argument("--chaosSmoke", action="store_true",
                    help="serving-hardening assertion pass (ISSUE 6): "
                         "deadline-expiry 504, worker-kill fast 503 + "
@@ -356,6 +436,8 @@ def main(argv=None):
     if args.chaosSmoke:
         args.endpoint, args.batch = "predict", 2
         return run_chaos_smoke(args)
+    if args.specSmoke:
+        return run_spec_smoke(args)
 
     proc = None
     if args.url:
@@ -367,8 +449,10 @@ def main(argv=None):
             run_smoke(url, args)
         else:
             res = closed_loop(url, args)
-            prov, _ = scrape_provenance(url)
+            prov, page = scrape_provenance(url)
             res["provenance"] = prov
+            if args.endpoint == "generate":
+                res["spec"] = scrape_spec_columns(page)
             print(json.dumps(res), flush=True)
     finally:
         if proc is not None:

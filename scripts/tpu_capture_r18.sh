@@ -1,0 +1,123 @@
+#!/usr/bin/env bash
+# Round-18 capture: ISSUE 14 (decode raw speed) chip evidence. The
+# exactness contracts are CPU-verified (tests/test_spec_decode.py,
+# tests/test_kv_pages.py, the tier1 spec-smoke leg) — what only hardware
+# can tell us is the actual tokens/s: (a) the spec A/B runs the SAME
+# greedy /generate workload with --speculate 0 vs 4 three times each
+# (client tokens/s + the accepted-tokens/step column in every JSON
+# line); (b) the page sweep grids --kvPageTokens over the tuned ladder
+# plus the dense layout at matched workload — gather/scatter overhead vs
+# residency is the trade the kv_pages autotune namespace prices; (c) the
+# prefix leg fires a shared-prefix prompt set cold then warm and scrapes
+# hit counters + latency quantiles. Appends to $OUT, mirrored into the
+# repo per step.
+
+set -uo pipefail
+cd "$(dirname "$0")/.."
+OUT="${OUT:-/tmp/tpu_capture_r18.log}"
+REPO_LOG="${REPO_LOG:-TPU_CAPTURE_r18.log}"
+trap 'cp -f "$OUT" "$REPO_LOG" 2>/dev/null || true' EXIT
+
+step() {
+  local name="$1" tmo="$2"; shift 2
+  echo "=== $name ($(date -u +%H:%M:%SZ))" | tee -a "$OUT"
+  timeout "$tmo" "$@" 2>&1 | tail -40 | tee -a "$OUT"
+  echo "=== end $name rc=$?" | tee -a "$OUT"
+  cp -f "$OUT" "$REPO_LOG" 2>/dev/null || true
+}
+
+# serving-bench geometry for every leg: a mid-size LM (big enough that
+# the chip, not Python, is the bottleneck) at a matched workload
+LM="--serveArg=--vocabSize --serveArg=32000 \
+    --serveArg=--dModel --serveArg=1024 \
+    --serveArg=--numLayers --serveArg=8 \
+    --serveArg=--numHeads --serveArg=16 \
+    --serveArg=--seq --serveArg=1024 \
+    --serveArg=--slots --serveArg=8"
+GEN="--model transformer_lm --endpoint generate \
+     --requests 32 --concurrency 4 --promptLen 128 --maxNewTokens 128"
+
+# 0. the decode test files + exactness smoke on the bench env first
+step "pytest_decode" 900 env JAX_PLATFORMS=cpu python -m pytest \
+  tests/test_spec_decode.py tests/test_kv_pages.py -q
+step "spec_exactness" 600 python scripts/serving_bench.py \
+  --specSmoke --model transformer_lm
+
+# 1. THE r18 leg — speculative A/B x3: same greedy workload, draft =
+#    target (self-draft ships as the default). tokens_per_second and
+#    spec.accepted_tokens_per_step in each JSON line are the story.
+for REP in 1 2 3; do
+  for K in 0 4; do
+    # shellcheck disable=SC2086
+    step "spec_ab_k${K}_rep${REP}" 1800 python scripts/serving_bench.py \
+      $GEN $LM --serveArg=--speculate --serveArg="$K" || true
+  done
+done
+
+# 2. separate small draft (4x shallower): acceptance drops below 1 but
+#    each verify amortizes K draft steps that cost ~1/8 the target's
+for REP in 1 2 3; do
+  # shellcheck disable=SC2086
+  step "spec_draft_rep${REP}" 1800 python scripts/serving_bench.py \
+    $GEN $LM --serveArg=--speculate --serveArg=4 \
+    --serveArg=--draftDims --serveArg=256,2,4 || true
+done
+
+# 3. page-size sweep at matched workload: dense baseline then the tuned
+#    ladder — the gather/scatter cost each page size pays on real HBM
+#    (feeds the kv_pages autotune default and PERF.md §21)
+# shellcheck disable=SC2086
+step "pages_dense" 1800 python scripts/serving_bench.py $GEN $LM || true
+for PT in 32 64 128 256; do
+  # shellcheck disable=SC2086
+  step "pages_pt${PT}" 1800 python scripts/serving_bench.py $GEN $LM \
+    --serveArg=--kvPageTokens --serveArg="$PT" || true
+done
+# measured (not dry) kv_pages autotune decision on the chip
+# shellcheck disable=SC2086
+step "pages_auto_measured" 1800 python scripts/serving_bench.py $GEN $LM \
+  --serveArg=--kvPageTokens --serveArg=auto \
+  --serveArg=--autotune --serveArg=measure || true
+
+# 4. shared-prefix warm/cold: same 512-token system prefix, distinct
+#    tails — cold pass populates, warm pass must show hits and a
+#    latency drop proportional to prefix/(prefix+tail) prefill work
+step "prefix_warm_cold" 1800 python - <<'EOF'
+import json, sys
+sys.path.insert(0, "scripts")
+import serving_bench as sb
+
+class A:  # minimal spawn_server surface
+    model = "transformer_lm"; ckpt = None; platform = None; smoke = False
+args = A()
+proc, url, logs = sb.spawn_server(args, [
+    "--vocabSize", "32000", "--dModel", "1024", "--numLayers", "8",
+    "--numHeads", "16", "--seq", "1024", "--slots", "8",
+    "--kvPageTokens", "128", "--prefixCache"])
+try:
+    import numpy as np, time
+    rng = np.random.RandomState(0)
+    prefix = rng.randint(1, 31000, 512).tolist()
+    def fire(tag):
+        lats = []
+        for i in range(8):
+            tail = rng.randint(1, 31000, 16).tolist()
+            t0 = time.perf_counter()
+            sb._post(url + "/generate",
+                     {"tokens": prefix + tail, "max_new_tokens": 32})
+            lats.append((time.perf_counter() - t0) * 1000)
+        _, page = sb._get(url + "/metrics")
+        print(json.dumps({
+            "leg": f"prefix_{tag}",
+            "p50_ms": sorted(lats)[len(lats) // 2],
+            "hits": sb.scrape_value(page, "prefix_cache_hits_total"),
+            "misses": sb.scrape_value(page,
+                                      "prefix_cache_misses_total")}))
+    fire("cold_then_warm")   # first request populates, rest hit
+    fire("warm")
+finally:
+    sb._shutdown_clean(proc, logs)
+EOF
+
+# 5. summarize every JSON line in this log for PERF.md §21
+step "summarize" 300 python scripts/update_perf_from_capture.py "$OUT"
