@@ -127,6 +127,35 @@ def build_parser() -> argparse.ArgumentParser:
                         "'worker_kill@infer:3' kills the batcher worker "
                         "on its 3rd flush — the watchdog/fast-fail "
                         "drill. No-op unless set")
+    p.add_argument("--reqTrace", choices=("on", "off"), default="off",
+                   help="per-request lifecycle tracing (ISSUE 15): "
+                        "request IDs minted at admission and threaded "
+                        "through batcher/engine/decoder, server-side "
+                        "TTFT/TPOT/ITL + queue/prefill/decode "
+                        "histograms, a bounded flight recorder behind "
+                        "/debug/requests + /debug/slots, and request "
+                        "spans joined onto the --obs Chrome trace. "
+                        "Off: the hot loop is byte-identical (same "
+                        "None-check contract as --obs)")
+    p.add_argument("--reqTraceCapacity", type=int, default=1024,
+                   metavar="N",
+                   help="completed-request records the flight recorder "
+                        "retains (oldest dropped and counted past it)")
+    p.add_argument("--slo", default=None, metavar="SPEC",
+                   help="server-side latency SLOs, e.g. "
+                        "'ttft=200,tpot=30' (ms; optional "
+                        "burn=FRAC,window=N): per-dimension violation "
+                        "counters, goodput, and tiered shed consults "
+                        "the SLO burn rate. Implies --reqTrace on")
+    p.add_argument("--accessLog", default=None, metavar="FILE",
+                   help="append one JSONL access-log line per "
+                        "completed request (rid, endpoint, state, "
+                        "status, ttft/tpot/queue/prefill/decode ms, "
+                        "tokens). Implies --reqTrace on")
+    p.add_argument("--logSample", type=float, default=1.0, metavar="P",
+                   help="access-log sampling probability in [0,1] — "
+                        "deterministic per request id (hash-based), so "
+                        "reruns sample the same rids")
     # custom-dims LM (matches cli/transformerlm.py checkpoints)
     p.add_argument("--vocabSize", type=int, default=None,
                    help="build a custom transformer_lm (with --dModel/"
@@ -233,6 +262,32 @@ def build_app(args):
     # process land on the SAME /metrics page the server exposes
     from bigdl_tpu.obs.metrics import set_registry
     set_registry(metrics)
+
+    # --reqTrace (ISSUE 15): the per-request lifecycle tracer. --slo and
+    # --accessLog imply it — asking for SLOs or an access log without
+    # the recorder they read from would silently do nothing.
+    reqtrace_on = (args.reqTrace == "on" or args.slo is not None
+                   or args.accessLog is not None)
+    reqtracer = None
+    if reqtrace_on:
+        from bigdl_tpu.serving import reqtrace as _reqtrace
+        slo = None
+        if args.slo is not None:
+            try:
+                slo = _reqtrace.SloPolicy.parse(args.slo)
+            except ValueError as e:
+                raise SystemExit(f"--slo {args.slo!r}: {e}")
+        access_log = None
+        if args.accessLog is not None:
+            if not 0.0 <= args.logSample <= 1.0:
+                raise SystemExit(f"--logSample {args.logSample} must be "
+                                 "in [0, 1]")
+            access_log = _reqtrace.AccessLog(args.accessLog,
+                                             sample=args.logSample)
+        reqtracer = _reqtrace.RequestTracer(
+            capacity=args.reqTraceCapacity, metrics=metrics, slo=slo,
+            access_log=access_log)
+        _reqtrace.set_request_tracer(reqtracer)
     engine = InferenceEngine(
         model, params, mod_state, buckets=_parse_buckets(args.buckets),
         compute_dtype=compute_dtype, lint=getattr(args, "lint", None),
@@ -310,7 +365,13 @@ def build_app(args):
         "max_queue": args.maxQueue,
         "deadline_ms": args.deadlineMs if args.deadlineMs else "none",
         "shed_at": args.shedAt,
+        "reqtrace": "on" if reqtracer is not None else "off",
     })
+    if reqtracer is not None:
+        prov["slo"] = args.slo if args.slo else "none"
+        if reqtracer.access_log is not None:
+            prov["access_log"] = reqtracer.access_log.path
+            prov["access_log_sample"] = args.logSample
     if decoder is not None:
         prov["decode_slots"] = args.slots
         prov["prompt_buckets"] = ",".join(

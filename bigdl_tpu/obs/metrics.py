@@ -34,7 +34,8 @@ import time
 from typing import Callable, Dict, List, Optional, Sequence
 
 __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
-           "DEFAULT_LATENCY_BUCKETS_MS", "PHASE_BUCKETS_MS",
+           "DEFAULT_LATENCY_BUCKETS_MS", "ITL_BUCKETS_MS",
+           "PHASE_BUCKETS_MS",
            "get_registry", "set_registry", "reset_registry",
            "phase_histograms", "TRAIN_PHASES"]
 
@@ -43,6 +44,15 @@ __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
 DEFAULT_LATENCY_BUCKETS_MS: tuple = (
     0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0,
     500.0, 1000.0, 2500.0, 5000.0, 10000.0, 30000.0, 60000.0)
+
+# inter-token latencies cluster tightly (a healthy decode step is a few
+# ms, TTFT a few tens); the serving-latency buckets above lose a whole
+# p50..p99 spread inside one bucket, so TTFT/TPOT/ITL histograms
+# (serving/reqtrace.py, ISSUE 15) get ~2x finer resolution below 100 ms
+ITL_BUCKETS_MS: tuple = (
+    0.25, 0.5, 1.0, 1.5, 2.5, 4.0, 6.0, 10.0, 15.0, 25.0, 40.0, 60.0,
+    100.0, 150.0, 250.0, 400.0, 600.0, 1000.0, 2500.0, 5000.0, 10000.0,
+    30000.0, 60000.0)
 
 # training step phases are faster at the bottom (a warm h2d is tens of
 # microseconds) and slower at the top (a cold compile-triggering

@@ -78,11 +78,14 @@ class Tracer:
             return tid
 
     def record(self, name: str, t0: float, t1: float, depth: int,
-               args: Optional[dict] = None) -> None:
+               args: Optional[dict] = None,
+               cat: Optional[str] = None) -> None:
         ev = {"name": name, "ts": t0, "dur": max(t1 - t0, 0.0),
               "tid": self._tid(), "depth": depth}
         if args:
             ev["args"] = args
+        if cat is not None:
+            ev["cat"] = cat
         with self._lock:
             self._ring.append(ev)
             self._recorded += 1
@@ -136,7 +139,8 @@ class Tracer:
         evs = []
         for e in self.events():
             ph = e.get("ph", "X")
-            ev = {"name": e["name"], "cat": "bigdl", "ph": ph,
+            ev = {"name": e["name"], "cat": e.get("cat", "bigdl"),
+                  "ph": ph,
                   "ts": round(e["ts"] * 1e6, 3),
                   "pid": pid, "tid": e["tid"]}
             if ph == "X":

@@ -23,6 +23,10 @@ Modules:
   registry process-global so training and resilience share it);
 * :mod:`watchdog` — dead/wedged-worker detection: pending futures fail
   fast, ``/readyz`` flips, ``/healthz`` stays live (ISSUE 6);
+* :mod:`reqtrace` — per-request lifecycle flight recorder (ISSUE 15):
+  request IDs threaded admission -> terminal state, server-side
+  TTFT/TPOT/ITL histograms, SLO goodput/burn accounting, sampled JSONL
+  access log, ``/debug/requests`` + ``/debug/slots``;
 * :mod:`server`  — stdlib ThreadingHTTPServer JSON endpoints
   (``/predict`` ``/generate`` ``/healthz`` ``/readyz`` ``/metrics``)
   with per-request deadlines (504), tiered overload shedding (429 on
@@ -38,6 +42,10 @@ from bigdl_tpu.serving.kv_pages import (PageAllocator, PagedKvCache,
 from bigdl_tpu.serving.metrics import (Counter, Gauge, Histogram,
                                        MetricsRegistry)
 from bigdl_tpu.serving.prefix_cache import PrefixCache
+from bigdl_tpu.serving.reqtrace import (AccessLog, RequestRecord,
+                                        RequestTracer, SloPolicy,
+                                        get_request_tracer, mint_rid,
+                                        sanitize_rid, set_request_tracer)
 from bigdl_tpu.serving.server import ServingApp, make_server, run_server
 from bigdl_tpu.serving.spec_decode import (accept_chunk, parse_draft_dims,
                                            request_key, sample_token,
@@ -51,4 +59,7 @@ __all__ = ["AdmissionError", "DeadlineExceeded", "MicroBatcher",
            "accept_chunk", "parse_draft_dims", "request_key",
            "sample_token", "warp_logits",
            "Counter", "Gauge", "Histogram", "MetricsRegistry",
+           "AccessLog", "RequestRecord", "RequestTracer", "SloPolicy",
+           "get_request_tracer", "mint_rid", "sanitize_rid",
+           "set_request_tracer",
            "ServingApp", "make_server", "run_server", "Watchdog"]

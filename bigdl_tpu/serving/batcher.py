@@ -43,6 +43,7 @@ and expiry semantics are testable without threads or real time.
 from __future__ import annotations
 
 import collections
+import inspect
 import threading
 import time
 from typing import Callable, Optional
@@ -51,6 +52,7 @@ import numpy as np
 
 from bigdl_tpu.obs.spans import (get_tracer as _get_tracer,
                                  span as _obs_span)
+from bigdl_tpu.serving.reqtrace import get as _get_reqtracer
 
 __all__ = ["AdmissionError", "DeadlineExceeded", "WorkerDied",
            "MicroBatcher"]
@@ -101,11 +103,12 @@ class _Future:
 
 
 class _Pending:
-    __slots__ = ("row", "future", "t_enqueue", "deadline")
+    __slots__ = ("row", "future", "t_enqueue", "deadline", "rid")
 
-    def __init__(self, row, future, t, deadline=None):
+    def __init__(self, row, future, t, deadline=None, rid=None):
         self.row, self.future, self.t_enqueue = row, future, t
         self.deadline = deadline
+        self.rid = rid
 
 
 class MicroBatcher:
@@ -141,6 +144,14 @@ class MicroBatcher:
         self._worker_error: Optional[BaseException] = None
         self._last_beat = clock()
         self._in_flush = False
+        # ISSUE 15: when the engine forward can attribute compute back
+        # to request ids (engine.predict_scores grew a ``rids`` kwarg),
+        # forward them; a plain fn gets a coarse whole-flush window
+        try:
+            self._fn_takes_rids = "rids" in inspect.signature(
+                predict_fn).parameters
+        except (TypeError, ValueError):
+            self._fn_takes_rids = False
 
         if metrics is not None:
             self._m_submitted = metrics.counter(
@@ -174,14 +185,17 @@ class MicroBatcher:
             self._thread.start()
 
     # --------------------------------------------------------------- submit
-    def submit(self, row, deadline: Optional[float] = None) -> _Future:
+    def submit(self, row, deadline: Optional[float] = None,
+               rid: Optional[str] = None) -> _Future:
         """Queue one input row; returns a future resolving to its score
         row. ``deadline`` is an absolute time on the batcher's clock —
         rows past it are dropped before compute (future raises
-        :class:`DeadlineExceeded`). Raises :class:`AdmissionError`
-        without blocking when the queue is at ``max_queue``
-        (backpressure fast-reject) and :class:`WorkerDied` when the
-        worker thread is gone (nothing would ever drain the queue)."""
+        :class:`DeadlineExceeded`). ``rid`` tags the row with its
+        request id for lifecycle tracing (ISSUE 15); None when tracing
+        is off. Raises :class:`AdmissionError` without blocking when
+        the queue is at ``max_queue`` (backpressure fast-reject) and
+        :class:`WorkerDied` when the worker thread is gone (nothing
+        would ever drain the queue)."""
         fut = _Future()
         now = self.clock()
         with self._lock:
@@ -206,10 +220,14 @@ class MicroBatcher:
                     self._m_rejected.inc()
                 raise AdmissionError(
                     f"queue at capacity ({self.max_queue} rows pending)")
-            self._pending.append(_Pending(row, fut, now, deadline))
+            self._pending.append(_Pending(row, fut, now, deadline, rid))
             if self._m_submitted is not None:
                 self._m_submitted.inc()
             self._wakeup.notify()
+        if rid is not None:
+            rt = _get_reqtracer()
+            if rt is not None:
+                rt.note_queued(rid)
         return fut
 
     @property
@@ -290,20 +308,42 @@ class MicroBatcher:
         tr = _get_tracer()
         if tr is not None:
             # queue wait is retrospective (enqueue happened on another
-            # thread): back-date one span covering the oldest row's wait
-            # so the request-path timeline reads queue_wait ->
-            # batch_assembly -> compute
+            # thread): back-date one span PER ROW so every request's
+            # wait — not just the oldest's — lands on the timeline, and
+            # the request-path reads queue_wait -> batch_assembly ->
+            # compute (per-row accounting: ISSUE 15 satellite)
             t1 = tr.clock()
-            wait = max(now - p.t_enqueue for p in batch)
-            tr.record("queue_wait", t1 - max(wait, 0.0), t1, depth=0,
-                      args={"rows": len(batch)})
+            for p in batch:
+                args = {"rows": len(batch)}
+                if p.rid is not None:
+                    args["rid"] = p.rid
+                tr.record("queue_wait",
+                          t1 - max(now - p.t_enqueue, 0.0), t1,
+                          depth=0, args=args)
+        rt = _get_reqtracer()
+        if rt is not None:
+            for p in batch:
+                if p.rid is not None:
+                    rt.note_dequeued(p.rid)
+        rids = None
+        if rt is not None and self._fn_takes_rids:
+            rids = [p.rid for p in batch]
         try:
             # queue_wait ended at drain; assembly (stack) and compute
             # (engine forward) are the next spans on the request path
             with _obs_span("batch_assembly", rows=len(batch)):
                 stacked = np.stack([np.asarray(p.row) for p in batch])
             with _obs_span("compute", rows=len(batch)):
-                scores = self.predict_fn(stacked)
+                if rids is not None:
+                    scores = self.predict_fn(stacked, rids=rids)
+                else:
+                    t0c = rt.clock() if rt is not None else 0.0
+                    scores = self.predict_fn(stacked)
+                    if rt is not None:
+                        t1c = rt.clock()
+                        for p in batch:
+                            if p.rid is not None:
+                                rt.note_compute(p.rid, t0c, t1c)
         except BaseException as e:  # resolve every waiter, never hang them
             for p in batch:
                 p.future.set_exception(e)

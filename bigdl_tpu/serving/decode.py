@@ -67,6 +67,7 @@ from bigdl_tpu.serving import spec_decode as _spec
 from bigdl_tpu.serving.batcher import (AdmissionError, DeadlineExceeded,
                                        WorkerDied, _Future)
 from bigdl_tpu.serving.prefix_cache import PrefixCache
+from bigdl_tpu.serving.reqtrace import get as _get_reqtracer
 
 logger = logging.getLogger(__name__)
 
@@ -75,11 +76,12 @@ __all__ = ["DecodeEngine", "DecodeRequest"]
 
 class DecodeRequest:
     __slots__ = ("tokens", "max_new_tokens", "temperature", "stop_token",
-                 "top_k", "top_p", "seed", "future", "out", "deadline")
+                 "top_k", "top_p", "seed", "future", "out", "deadline",
+                 "rid")
 
     def __init__(self, tokens, max_new_tokens, temperature=0.0,
                  stop_token=None, deadline=None, top_k=0, top_p=1.0,
-                 seed=0):
+                 seed=0, rid=None):
         self.tokens = [int(t) for t in tokens]
         self.max_new_tokens = int(max_new_tokens)
         self.temperature = float(temperature)
@@ -90,6 +92,7 @@ class DecodeRequest:
         self.seed = int(seed) & 0xFFFFFFFF
         self.future = _Future()
         self.out: list = []
+        self.rid = rid  # lifecycle-trace request id (ISSUE 15)
 
 
 class DecodeEngine:
@@ -568,7 +571,8 @@ class DecodeEngine:
     def submit(self, tokens, max_new_tokens: int,
                temperature: float = 0.0, stop_token=None,
                deadline: Optional[float] = None, top_k: int = 0,
-               top_p: float = 1.0, seed: int = 0) -> _Future:
+               top_p: float = 1.0, seed: int = 0,
+               rid: Optional[str] = None) -> _Future:
         """Queue one generation request; the future resolves to the list
         of generated token ids. Validates the length budget, fast-rejects
         when the waiting queue is full, when the decode worker is dead
@@ -576,7 +580,8 @@ class DecodeEngine:
         when ``deadline`` (absolute, on the engine's clock) has already
         passed (:class:`DeadlineExceeded`). ``top_k=0`` / ``top_p=1``
         disable those filters; ``seed`` makes sampled output
-        deterministic per request."""
+        deterministic per request; ``rid`` tags the request for
+        lifecycle tracing (ISSUE 15)."""
         tokens = list(tokens)
         if not tokens:
             raise ValueError("empty prompt")
@@ -592,7 +597,8 @@ class DecodeEngine:
         if not 0.0 < top_p <= 1.0:
             raise ValueError(f"top_p must be in (0, 1], got {top_p}")
         req = DecodeRequest(tokens, max_new_tokens, temperature,
-                            stop_token, deadline, top_k, top_p, seed)
+                            stop_token, deadline, top_k, top_p, seed,
+                            rid=rid)
         with self._lock:
             if self._closed:
                 raise RuntimeError("decode engine is closed")
@@ -618,6 +624,10 @@ class DecodeEngine:
                     f"decode queue at capacity ({self.max_waiting} waiting)")
             else:
                 self._waiting.append(req)
+                if rid is not None:
+                    rt = _get_reqtracer()
+                    if rt is not None:
+                        rt.note_queued(rid)
             self._work.notify()
         return req.future
 
@@ -655,6 +665,8 @@ class DecodeEngine:
         if self.paged and not self._kv.reserve(slot,
                                                s + req.max_new_tokens):
             return False
+        rt = _get_reqtracer() if req.rid is not None else None
+        t0_pf = rt.clock() if rt is not None else 0.0
         with _obs_span("decode_prefill", prompt=s):
             n_pfx, src_pages = (self._pfx.match(req.tokens)
                                 if self._pfx is not None else (0, []))
@@ -686,6 +698,12 @@ class DecodeEngine:
         if self._m_prefills is not None:
             self._m_prefills.inc()
             self._m_prompt_tokens.inc(s - n_pfx)
+        if rt is not None:
+            rt.note_prefill(
+                req.rid, t0_pf, rt.clock(), slot=slot,
+                prefix_hit_tokens=n_pfx,
+                pages=(len(self._kv.slot_pages[slot])
+                       if self.paged else None))
         if self.speculate > 0:
             self._install_draft(req, slot)
             # speculative mode emits the first token NOW (it becomes the
@@ -759,10 +777,12 @@ class DecodeEngine:
                                              jnp.int32(slot))
 
     # ------------------------------------------------------------- emission
-    def _emit(self, req, slot: int, toks) -> bool:
+    def _emit(self, req, slot: int, toks, accepted=None) -> bool:
         """Append generated tokens to ``req`` (respecting stop token and
-        max_new budget), resolve + hand off if finished. Returns True if
-        the request completed. Lock held."""
+        max_new budget), resolve + hand off if finished. ``accepted`` is
+        the speculative draft tokens the verify kept this round (None on
+        the plain path). Returns True if the request completed. Lock
+        held."""
         done = False
         emitted = 0
         for tok in toks:
@@ -775,9 +795,18 @@ class DecodeEngine:
                 break
         if self._m_tokens is not None and emitted:
             self._m_tokens.inc(emitted)
+        rt = _get_reqtracer() if req.rid is not None else None
+        if rt is not None and emitted:
+            rt.note_round(
+                req.rid, emitted, accepted=accepted,
+                pages=(len(self._kv.slot_pages[slot])
+                       if self.paged else None),
+                pos=int(self._pos[slot]))
         if done:
             self._release_slot(slot)
             req.future.set_result(list(req.out))
+            if rt is not None:
+                rt.finish(req.rid, "finished")
             self._handoff(slot)
         return done
 
@@ -787,6 +816,7 @@ class DecodeEngine:
         held): waiting-queue entries simply resolve with
         :class:`DeadlineExceeded`; active slots free up and hand off to
         the next (still-live) waiting request."""
+        rt = _get_reqtracer()
         if self._waiting:
             live = collections.deque()
             for req in self._waiting:
@@ -796,6 +826,9 @@ class DecodeEngine:
                     req.future.set_exception(DeadlineExceeded(
                         "deadline expired while waiting for a decode "
                         "slot"))
+                    if rt is not None and req.rid is not None:
+                        rt.finish(req.rid, "expired",
+                                  error="expired in decode queue")
                 else:
                     live.append(req)
             self._waiting = live
@@ -808,6 +841,10 @@ class DecodeEngine:
                 req.future.set_exception(DeadlineExceeded(
                     f"deadline expired after {len(req.out)} of "
                     f"{req.max_new_tokens} tokens"))
+                if rt is not None and req.rid is not None:
+                    rt.finish(req.rid, "expired",
+                              error=f"expired mid-decode after "
+                                    f"{len(req.out)} tokens")
                 self._handoff(i)
 
     # ---------------------------------------------------------------- step
@@ -935,7 +972,7 @@ class DecodeEngine:
             k = int(n_emit[i])
             stream = [int(t) for t in emitted[i, :k]]
             self._pos[i] += k
-            if not self._emit(req, i, stream):
+            if not self._emit(req, i, stream, accepted=int(n_acc[i])):
                 self._pending[i] = stream[-1]
         return len(active)
 
@@ -954,6 +991,46 @@ class DecodeEngine:
                     raise RuntimeError(
                         "decode engine idle with unresolved request")
         return fut.result()
+
+    # ----------------------------------------------------- debug inspection
+    def debug_snapshot(self) -> dict:
+        """The /debug/slots JSON (ISSUE 15): the slot table, waiting
+        queue depth, and — paged — the KV page-pool occupancy. Holds the
+        engine lock only to copy a few scalars."""
+        with self._lock:
+            slots = []
+            for i, req in enumerate(self._reqs):
+                if req is None:
+                    slots.append({"slot": i, "state": "free"})
+                    continue
+                slots.append({
+                    "slot": i, "state": "active",
+                    "rid": req.rid,
+                    "pos": int(self._pos[i]),
+                    "prompt_tokens": len(req.tokens),
+                    "tokens_out": len(req.out),
+                    "max_new": req.max_new_tokens,
+                    "pages": (len(self._kv.slot_pages[i])
+                              if self.paged else None)})
+            out = {"slots": slots,
+                   "slots_total": self.slots,
+                   "slots_active": sum(1 for r in self._reqs
+                                       if r is not None),
+                   "waiting": len(self._waiting),
+                   "max_waiting": self.max_waiting,
+                   "speculate": self.speculate,
+                   "worker_up": self._worker_error is None,
+                   "kv": {"paged": self.paged}}
+            if self.paged:
+                out["kv"].update({
+                    "page_tokens": self.page_tokens,
+                    "pool_pages": self._kv.pool_pages,
+                    "pages_in_use": self._kv.alloc.pages_in_use,
+                    "free_pages": self._kv.alloc.free_pages,
+                    "occupancy_frac": round(self._page_occupancy(), 4),
+                    "allocated_bytes": self._kv.allocated_bytes(),
+                    "bytes_per_page": self._kv.bytes_per_page})
+        return out
 
     # ------------------------------------------------------ watchdog surface
     def alive(self) -> bool:
@@ -992,8 +1069,11 @@ class DecodeEngine:
             self._work.notify_all()
         err = (exc if isinstance(exc, WorkerDied)
                else WorkerDied(f"decode worker died: {exc}"))
+        rt = _get_reqtracer()
         for req in dead:
             req.future.set_exception(err)
+            if rt is not None and req.rid is not None:
+                rt.finish(req.rid, "worker_dead", error=str(err))
 
     # --------------------------------------------------------------- worker
     def start(self) -> None:
@@ -1024,17 +1104,22 @@ class DecodeEngine:
         self._thread.start()
 
     def close(self, timeout: float = 10.0) -> None:
+        rt = _get_reqtracer()
         with self._lock:
             self._closed = True
             for req in list(self._waiting):
                 req.future.set_exception(
                     RuntimeError("decode engine closed"))
+                if rt is not None and req.rid is not None:
+                    rt.finish(req.rid, "closed")
             self._waiting.clear()
             for i, req in enumerate(self._reqs):
                 if req is not None:
                     self._release_slot(i)
                     req.future.set_exception(
                         RuntimeError("decode engine closed mid-request"))
+                    if rt is not None and req.rid is not None:
+                        rt.finish(req.rid, "closed")
             self._work.notify_all()
         t, self._thread = self._thread, None
         if t is not None:

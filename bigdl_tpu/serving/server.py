@@ -20,7 +20,14 @@ micro-batcher concurrency levels) exposing
   healthy and no deliberate overload shed is active — the signal a load
   balancer drains on;
 * ``GET /metrics``   — plaintext counters/histograms with the serving
-  config provenance stamped into every scrape.
+  config provenance stamped into every scrape;
+* ``GET /debug/requests`` / ``GET /debug/slots`` — the flight recorder
+  (in-flight + recent request lifecycle records; 404 with ``--reqTrace
+  off``) and the decoder slot table / KV page-pool occupancy (ISSUE 15).
+
+Every response echoes ``x-request-id`` (client-supplied id wins, else
+one is minted) so callers can join server-side lifecycle records and
+access-log lines to their own request logs.
 
 Error contract: malformed JSON/fields -> 400, admission rejection or
 overload shed (queue full / tiered degradation) -> 429 with
@@ -49,6 +56,7 @@ import numpy as np
 
 from bigdl_tpu.obs.spans import span as _obs_span
 from bigdl_tpu.resilience.faults import TransientFault, hook as _fault_hook
+from bigdl_tpu.serving import reqtrace as _reqtrace
 from bigdl_tpu.serving.batcher import (AdmissionError, DeadlineExceeded,
                                        WorkerDied)
 
@@ -129,7 +137,10 @@ class ServingApp:
     # ------------------------------------------------------------- overload
     def _shed_generate(self) -> bool:
         """Tiered degradation: past ``shed_generate_frac`` of either
-        queue's capacity, /generate sheds so /predict keeps breathing."""
+        queue's capacity — or with the SLO burn rate saturated (ISSUE
+        15: every recently finished request is missing its targets, so
+        admitting more only makes the backlog later) — /generate sheds
+        so /predict keeps breathing."""
         frac = self.shed_generate_frac
         if (self.batcher is not None
                 and self.batcher.queue_depth
@@ -138,6 +149,9 @@ class ServingApp:
         if (self.decoder is not None
                 and len(self.decoder._waiting)
                 >= frac * self.decoder.max_waiting):
+            return True
+        rt = _reqtrace.get()
+        if rt is not None and rt.slo is not None and rt.slo.should_shed():
             return True
         return False
 
@@ -164,7 +178,7 @@ class ServingApp:
         detail["status"] = "ready" if ok else "unready"
         return (200 if ok else 503), detail
 
-    def handle_predict(self, payload: dict):
+    def handle_predict(self, payload: dict, rid: Optional[str] = None):
         if self.engine is None:
             return 400, {"error": "no /predict engine for this model"}
         inputs = payload.get("inputs")
@@ -187,21 +201,22 @@ class ServingApp:
                                   "axis 0)"}
         deadline = self._deadline_from(payload)
         if self.batcher is not None:
-            futs = [self.batcher.submit(row, deadline=deadline)
+            futs = [self.batcher.submit(row, deadline=deadline, rid=rid)
                     for row in x]
             scores = np.stack([f.result(self.request_timeout_s)
                                for f in futs])
         else:
             if deadline is not None and self.clock() >= deadline:
                 raise DeadlineExceeded("deadline expired before compute")
-            scores = self.engine.predict_scores(x)
+            scores = self.engine.predict_scores(
+                x, rids=([rid] * len(x) if rid is not None else None))
         preds = np.argmax(scores, axis=-1)
         out = {"predictions": preds.tolist()}
         if payload.get("return_scores"):
             out["scores"] = np.asarray(scores, np.float64).tolist()
         return 200, out
 
-    def handle_generate(self, payload: dict):
+    def handle_generate(self, payload: dict, rid: Optional[str] = None):
         if self.decoder is None:
             return 400, {"error": "no /generate decoder for this model "
                                   "(serve a transformer_lm* model)"}
@@ -223,7 +238,8 @@ class ServingApp:
         try:
             fut = self.decoder.submit(tokens, max_new, temperature, stop,
                                       deadline=self._deadline_from(payload),
-                                      top_k=top_k, top_p=top_p, seed=seed)
+                                      top_k=top_k, top_p=top_p, seed=seed,
+                                      rid=rid)
         except ValueError as e:
             return 400, {"error": str(e)}
         out_tokens = fut.result(self.request_timeout_s)
@@ -233,52 +249,122 @@ class ServingApp:
     def handle_metrics(self) -> str:
         return self.metrics.render()
 
+    def handle_debug_requests(self):
+        """Live flight-recorder view (ISSUE 15): in-flight request
+        states + the recent completed ring. 404 while ``--reqTrace`` is
+        off — the recorder does not exist, which is itself the
+        answer."""
+        rt = _reqtrace.get()
+        if rt is None:
+            return 404, {"enabled": False,
+                         "error": "request tracing off (start with "
+                                  "--reqTrace on)"}
+        return 200, rt.snapshot()
+
+    def handle_debug_slots(self):
+        """Decoder slot table + KV page-pool occupancy + batcher queue
+        depth — works regardless of ``--reqTrace`` (it reads engine
+        state, not lifecycle records)."""
+        if self.decoder is not None:
+            out = self.decoder.debug_snapshot()
+        else:
+            out = {"slots": [], "slots_total": 0, "slots_active": 0,
+                   "waiting": 0, "kv": {"paged": False}}
+        if self.batcher is not None:
+            out["batcher"] = {
+                "queue_depth": self.batcher.queue_depth,
+                "max_queue": self.batcher.max_queue,
+                "worker_up": self.batcher.alive()}
+        return 200, out
+
     # ------------------------------------------------------------- dispatch
-    def dispatch_post(self, path: str, payload: dict):
+    def dispatch_post(self, path: str, payload: dict,
+                      rid: Optional[str] = None):
         ep = path.strip("/")
         handler = {"predict": self.handle_predict,
                    "generate": self.handle_generate}.get(ep)
         if handler is None:
             return 404, {"error": f"unknown endpoint {path}"}
+        # lifecycle record opens at admission (ISSUE 15): even a shed or
+        # rejected request leaves an autopsy trail
+        rt = _reqtrace.get()
+        if rt is not None:
+            prompt_n = max_new = None
+            if ep == "generate":
+                toks = payload.get("tokens")
+                if isinstance(toks, (list, tuple)):
+                    prompt_n = len(toks)
+                try:
+                    max_new = int(payload.get("max_new_tokens", 16))
+                except (TypeError, ValueError):
+                    max_new = None
+            rid = rt.admit(ep, rid, prompt_tokens=prompt_n,
+                           max_new=max_new)
         if ep == "generate" and self._shed_generate():
             # tiered degradation: /generate sheds first so /predict
             # keeps its admission headroom under overload
             self._m_shed.inc()
             self._m_errors.inc()
+            if rt is not None:
+                rt.finish(rid, "shed", status=429)
             return 429, {"error": "overloaded: shedding /generate "
                                   "(retry, or use /predict capacity)"}
         t0 = time.perf_counter()
         try:
             _fault_hook("request")  # no-op unless --faultPlan installed
             with _obs_span("request", endpoint=ep):
-                status, body = handler(payload)
+                status, body = handler(payload, rid=rid)
         except AdmissionError as e:
             self._m_errors.inc()
+            if rt is not None:
+                rt.finish(rid, "rejected", status=429, error=str(e))
             return 429, {"error": str(e)}
         except DeadlineExceeded as e:
             self._m_expired.inc()
             self._m_errors.inc()
+            if rt is not None:
+                rt.finish(rid, "expired", status=504, error=str(e))
             return 504, {"error": f"deadline exceeded: {e}"}
         except WorkerDied as e:
             self._m_worker_dead.inc()
             self._m_errors.inc()
+            if rt is not None:
+                rt.finish(rid, "worker_dead", status=503, error=str(e))
             return 503, {"error": str(e)}
         except TransientFault as e:
             self._m_injected.inc()
             self._m_errors.inc()
+            if rt is not None:
+                rt.finish(rid, "error", status=503,
+                          error=f"injected fault: {e}")
             return 503, {"error": f"injected fault: {e}"}
         except TimeoutError as e:
             self._m_errors.inc()
+            if rt is not None:
+                rt.finish(rid, "error", status=503, error=str(e))
             return 503, {"error": str(e)}
         except Exception as e:
             logger.exception("/%s failed", ep)
             self._m_errors.inc()
+            if rt is not None:
+                rt.finish(rid, "error", status=500,
+                          error=f"{type(e).__name__}: {e}")
             return 500, {"error": f"{type(e).__name__}: {e}"}
         if status == 200:
             self._m_requests[ep].inc()
             self._m_latency[ep].observe((time.perf_counter() - t0) * 1000.0)
+            if rt is not None:
+                # decode-path records already finished inside the
+                # engine (honest t_finish); this is a no-op there and
+                # terminalizes the predict path
+                rt.finish(rid, "finished", status=200)
         else:
             self._m_errors.inc()
+            if rt is not None:
+                rt.finish(rid,
+                          "bad_request" if status == 400 else "error",
+                          status=status,
+                          error=str(body.get("error", "")) or None)
         return status, body
 
     def close(self) -> None:
@@ -288,6 +374,9 @@ class ServingApp:
             self.batcher.close()
         if self.decoder is not None:
             self.decoder.close()
+        rt = _reqtrace.get()
+        if rt is not None:
+            rt.close()  # flush the access log
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -297,48 +386,70 @@ class _Handler(BaseHTTPRequestHandler):
     def app(self) -> ServingApp:
         return self.server.app  # type: ignore[attr-defined]
 
-    def _send_json(self, status: int, body: dict) -> None:
+    def _rid(self) -> str:
+        """The request id echoed on EVERY response (ISSUE 15): a valid
+        client-supplied ``x-request-id`` wins (so the caller can join
+        server records to its own logs), else one is minted — with or
+        without tracing enabled."""
+        return (_reqtrace.sanitize_rid(self.headers.get("x-request-id"))
+                or _reqtrace.mint_rid())
+
+    def _send_json(self, status: int, body: dict,
+                   rid: Optional[str] = None) -> None:
         data = json.dumps(body).encode()
         self.send_response(status)
         if status == 429:
             self.send_header("Retry-After", "1")
+        if rid is not None:
+            self.send_header("x-request-id", rid)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(data)))
         self.end_headers()
         self.wfile.write(data)
 
     def do_GET(self):  # noqa: N802 (stdlib naming)
+        rid = self._rid()
         if self.path == "/healthz":
-            self._send_json(*self.app.handle_healthz())
+            self._send_json(*self.app.handle_healthz(), rid=rid)
         elif self.path == "/readyz":
-            self._send_json(*self.app.handle_readyz())
+            self._send_json(*self.app.handle_readyz(), rid=rid)
+        elif self.path == "/debug/requests":
+            self._send_json(*self.app.handle_debug_requests(), rid=rid)
+        elif self.path == "/debug/slots":
+            self._send_json(*self.app.handle_debug_slots(), rid=rid)
         elif self.path == "/metrics":
             data = self.app.handle_metrics().encode()
             self.send_response(200)
+            self.send_header("x-request-id", rid)
             self.send_header("Content-Type",
                              "text/plain; version=0.0.4")
             self.send_header("Content-Length", str(len(data)))
             self.end_headers()
             self.wfile.write(data)
         else:
-            self._send_json(404, {"error": f"unknown path {self.path}"})
+            self._send_json(404, {"error": f"unknown path {self.path}"},
+                            rid=rid)
 
     def do_POST(self):  # noqa: N802
+        rid = self._rid()
         try:
             length = int(self.headers.get("Content-Length", 0))
         except ValueError:
             length = 0
         if length <= 0 or length > _MAX_BODY:
-            self._send_json(400, {"error": "missing or oversized body"})
+            self._send_json(400, {"error": "missing or oversized body"},
+                            rid=rid)
             return
         try:
             payload = json.loads(self.rfile.read(length))
             if not isinstance(payload, dict):
                 raise ValueError("body must be a JSON object")
         except (ValueError, json.JSONDecodeError) as e:
-            self._send_json(400, {"error": f"bad JSON: {e}"})
+            self._send_json(400, {"error": f"bad JSON: {e}"}, rid=rid)
             return
-        self._send_json(*self.app.dispatch_post(self.path, payload))
+        status, body = self.app.dispatch_post(self.path, payload,
+                                              rid=rid)
+        self._send_json(status, body, rid=rid)
 
     def log_message(self, fmt, *args):  # route access logs to logging
         logger.debug("%s - %s", self.address_string(), fmt % args)

@@ -18,6 +18,11 @@ PRs 2-4 extended to serving.
     # CI smoke: tiny config, asserts endpoints + metrics + clean shutdown
     python scripts/serving_bench.py --smoke --model transformer_lm \
         --platform cpu
+
+    # CI slo-smoke: ISSUE 15 per-request observability assertions
+    # (SLO goodput/burn/shed, access log, /debug/*, x-request-id)
+    python scripts/serving_bench.py --sloSmoke --model transformer_lm \
+        --platform cpu
 """
 
 from __future__ import annotations
@@ -66,6 +71,25 @@ def _post_status(url, body, timeout=120.0):
             return e.code, json.loads(e.read())
         except (ValueError, json.JSONDecodeError):
             return e.code, {}
+
+
+def _post_h(url, body, headers=None, timeout=120.0):
+    """POST returning (status, json_body, lowercased response headers)
+    — the ISSUE 15 legs assert on the ``x-request-id`` echo, and 4xx/5xx
+    return instead of raising (the shed leg asserts exact 429s)."""
+    req = urllib.request.Request(
+        url, data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return (r.status, json.loads(r.read()),
+                    {k.lower(): v for k, v in r.headers.items()})
+    except urllib.error.HTTPError as e:
+        try:
+            out = json.loads(e.read())
+        except (ValueError, json.JSONDecodeError):
+            out = {}
+        return e.code, out, {k.lower(): v for k, v in e.headers.items()}
 
 
 def _get_status(url, timeout=30.0):
@@ -233,6 +257,40 @@ def scrape_value(page, name):
     return None
 
 
+def scrape_quantile(page, name, q):
+    """One quantile sample of a registry histogram, e.g.
+    ``bigdl_serving_ttft_ms{quantile="0.5"} 12.3`` -> 12.3 (None when
+    the line is absent or the histogram is empty/NaN)."""
+    needle = f'{name}{{quantile="{q}"}}'
+    for line in page.splitlines():
+        parts = line.split()
+        if len(parts) == 2 and parts[0] in (needle,
+                                            "bigdl_serving_" + needle):
+            try:
+                v = float(parts[1])
+            except ValueError:
+                return None
+            return None if v != v else v  # NaN = empty histogram
+    return None
+
+
+def scrape_server_latency(page):
+    """The ISSUE 15 server-side request-latency columns (reqtrace
+    histograms): TTFT / TPOT / ITL p50-p99 plus the p50 decomposition
+    (queue wait, prefill, decode). All None when the server ran
+    --reqTrace off."""
+    out = {}
+    for name in ("ttft_ms", "tpot_ms", "itl_ms"):
+        out[name] = {p: scrape_quantile(page, name, q)
+                     for p, q in (("p50", "0.5"), ("p95", "0.95"),
+                                  ("p99", "0.99"))}
+    for name in ("request_queue_wait_ms", "request_prefill_ms",
+                 "request_decode_ms"):
+        out[name.replace("request_", "") + "_p50"] = \
+            scrape_quantile(page, name, "0.5")
+    return out
+
+
 def scrape_spec_columns(page):
     """The ISSUE 14 speculative-decoding columns: accept rate and tokens
     emitted per target verify step (the dispatch-count win the bench
@@ -246,6 +304,45 @@ def scrape_spec_columns(page):
         "generated_tokens_total": scrape_value(
             page, "generated_tokens_total"),
     }
+
+
+def _smoke_latency_agreement(url, args):
+    """ISSUE 15 satellite: the server-side TTFT/TPOT histograms
+    (reqtrace) must agree with what a client measures from outside.
+
+    Client-side TTFT ~ the round trip of a ``max_new_tokens=1`` generate
+    at concurrency 1 (queue wait ~0, one decode round); client-side TPOT
+    ~ the per-extra-token slope between a K-token and a 1-token request.
+    Tolerances are CPU-CI generous — this catches unit mistakes (s vs
+    ms), double counting, and misattributed phases, not microseconds."""
+    K = 17
+    prompt = list(range(1, 9))
+    one, many = [], []
+    for _ in range(6):
+        t0 = time.perf_counter()
+        _post(url + "/generate", {"tokens": prompt, "max_new_tokens": 1})
+        one.append((time.perf_counter() - t0) * 1000.0)
+        t0 = time.perf_counter()
+        _post(url + "/generate", {"tokens": prompt, "max_new_tokens": K})
+        many.append((time.perf_counter() - t0) * 1000.0)
+    one.sort()
+    many.sort()
+    ttft_c = _percentile(one, 0.50)
+    tpot_c = max((_percentile(many, 0.50) - ttft_c) / (K - 1), 0.0)
+    _, page = _get(url + "/metrics")
+    ttft_s = scrape_quantile(page, "ttft_ms", "0.5")
+    tpot_s = scrape_quantile(page, "tpot_ms", "0.5")
+    assert ttft_s is not None and ttft_s > 0, "ttft_ms histogram empty"
+    assert tpot_s is not None and tpot_s > 0, "tpot_ms histogram empty"
+    assert abs(ttft_s - ttft_c) <= max(100.0, 0.6 * max(ttft_c, ttft_s)), \
+        f"TTFT p50 disagree: server {ttft_s:.2f} ms vs client " \
+        f"{ttft_c:.2f} ms"
+    assert abs(tpot_s - tpot_c) <= max(25.0, 0.6 * max(tpot_c, tpot_s)), \
+        f"TPOT p50 disagree: server {tpot_s:.2f} ms vs client " \
+        f"{tpot_c:.2f} ms"
+    print(f"smoke: server-side p50 agrees with client-side "
+          f"(TTFT {ttft_s:.1f}~{ttft_c:.1f} ms, "
+          f"TPOT {tpot_s:.2f}~{tpot_c:.2f} ms) OK", flush=True)
 
 
 def run_smoke(url, args, page_checks=True):
@@ -271,6 +368,9 @@ def run_smoke(url, args, page_checks=True):
              if l.startswith("bigdl_serving_requests_predict_total ")]
     assert count and float(count[0].split()[-1]) >= 4, count
     print("smoke: endpoints + metrics provenance OK", flush=True)
+    if (args.model.startswith("transformer_lm")
+            and prov.get("reqtrace") == "on"):
+        _smoke_latency_agreement(url, args)
 
 
 def run_spec_smoke(args):
@@ -316,6 +416,156 @@ def run_spec_smoke(args):
     print(f"spec-smoke: --speculate 4 bit-identical, accept_rate="
           f"{cols['spec_accept_rate']:.2f}, accepted-tokens/step="
           f"{cols['accepted_tokens_per_step']:.2f} OK", flush=True)
+    return 0
+
+
+def run_slo_smoke(args):
+    """ISSUE 15 assertion pass (CI slo-smoke leg), two servers:
+
+    leg 1 — generous SLO + access log: every request meets the SLO, so
+    goodput is 1.0 and violations stay 0; the ttft/tpot histograms
+    populate; every response (with and without a client-supplied id)
+    echoes ``x-request-id``; a long generation is OBSERVED mid-decode
+    through /debug/requests and /debug/slots; after clean shutdown the
+    JSONL access log holds exactly one line per completed request;
+
+    leg 2 — unmeetable SLO: every finished request violates, so the
+    per-dim violation counters move, burn rate hits 1.0, and once the
+    burn window has MIN_BURN_SAMPLES the tiered shedder 429s /generate
+    while /predict keeps answering 200."""
+    import tempfile
+    if not args.model.startswith("transformer_lm"):
+        raise SystemExit("--sloSmoke needs --model transformer_lm "
+                         "(exercises the decode path)")
+    access = os.path.join(tempfile.mkdtemp(prefix="slo_smoke_"),
+                          "access.jsonl")
+
+    # ---- leg 1: generous SLO, everything good, in-flight visibility
+    proc, url, log_lines = spawn_server(
+        args, list(args.serveArg)
+        + ["--reqTrace", "on", "--slo", "ttft=60000,tpot=60000",
+           "--accessLog", access])
+    n_done = 0
+    try:
+        st, _, hdr = _post_h(url + "/generate",
+                             {"tokens": [1, 2, 3, 4],
+                              "max_new_tokens": 4},
+                             headers={"x-request-id": "slo-smoke-00"})
+        assert st == 200, f"/generate -> {st}"
+        assert hdr.get("x-request-id") == "slo-smoke-00", \
+            f"client request id not echoed: {hdr}"
+        n_done += 1
+        for _ in range(9):
+            st, _, hdr = _post_h(url + "/generate",
+                                 {"tokens": [5, 6, 7, 8],
+                                  "max_new_tokens": 6})
+            assert st == 200, f"/generate -> {st}"
+            assert hdr.get("x-request-id"), f"no minted rid echoed: {hdr}"
+            n_done += 1
+
+        # in-flight visibility: long generations polled mid-decode
+        fired, seen_decode, seen_slots = [0], False, False
+        def _long():
+            fired[0] += 1
+            _post_status(url + "/generate",
+                         {"tokens": [9, 10, 11, 12],
+                          "max_new_tokens": 48}, timeout=120)
+        deadline = time.time() + 60
+        while time.time() < deadline and not (seen_decode and seen_slots):
+            threads = [threading.Thread(target=_long) for _ in range(2)]
+            for t in threads:
+                t.start()
+            while any(t.is_alive() for t in threads):
+                st, txt = _get_status(url + "/debug/requests")
+                assert st == 200, f"/debug/requests -> {st}"
+                snap = json.loads(txt)
+                assert snap.get("enabled") is True, snap
+                for r in snap.get("in_flight", []):
+                    if (r.get("state") == "decode"
+                            and r.get("tokens_out", 0) > 0):
+                        seen_decode = True
+                st, txt = _get_status(url + "/debug/slots")
+                assert st == 200, f"/debug/slots -> {st}"
+                slots = json.loads(txt)
+                for k in ("slots", "slots_total", "slots_active",
+                          "waiting", "kv"):
+                    assert k in slots, f"/debug/slots missing {k}: {slots}"
+                if slots.get("slots_active", 0) >= 1:
+                    seen_slots = True
+            for t in threads:
+                t.join()
+        assert seen_decode, "/debug/requests never showed a request " \
+                            "mid-decode (state=decode, tokens_out>0)"
+        assert seen_slots, "/debug/slots never showed an active slot"
+        n_done += fired[0]
+
+        _, page = _get(url + "/metrics")
+        for name in ("ttft_ms", "tpot_ms", "itl_ms"):
+            q = scrape_quantile(page, name, "0.5")
+            assert q is not None and q > 0, \
+                f"{name} histogram not populated"
+        total = scrape_value(page, "slo_requests_total")
+        good = scrape_value(page, "slo_good_total")
+        viol = scrape_value(page, "slo_violations_total")
+        assert total == n_done, (total, n_done)
+        assert good == total and viol == 0, (good, viol, total)
+        assert scrape_value(page, "slo_goodput_frac") == 1.0
+        assert scrape_value(page, "requests_state_finished_total") \
+            == n_done
+        print(f"slo-smoke leg 1: {n_done} requests all good, goodput "
+              f"1.0, mid-decode visible via /debug/* OK", flush=True)
+    finally:
+        _shutdown_clean(proc, log_lines)
+
+    with open(access) as f:
+        recs = [json.loads(l) for l in f if l.strip()]
+    assert len(recs) == n_done, \
+        f"access log has {len(recs)} lines, expected {n_done}"
+    rids = [r["rid"] for r in recs]
+    assert len(set(rids)) == len(rids), "duplicate rids in access log"
+    assert "slo-smoke-00" in rids, rids
+    for r in recs:
+        for k in ("rid", "endpoint", "state", "status", "ttft_ms",
+                  "tpot_ms", "queue_wait_ms", "prefill_ms", "decode_ms",
+                  "total_ms", "tokens_out"):
+            assert k in r, f"access-log line missing {k}: {r}"
+        assert r["state"] == "finished" and r["status"] == 200, r
+    print(f"slo-smoke: access log {len(recs)}/{n_done} lines, "
+          f"unique rids OK", flush=True)
+
+    # ---- leg 2: unmeetable SLO -> violations, burn, tiered shed
+    proc, url, log_lines = spawn_server(
+        args, list(args.serveArg)
+        + ["--slo", "ttft=0.001,tpot=0.001,burn=0.5,window=16"])
+    try:
+        statuses = []
+        for _ in range(14):
+            st, _, hdr = _post_h(url + "/generate",
+                                 {"tokens": [1, 2, 3],
+                                  "max_new_tokens": 4})
+            assert hdr.get("x-request-id"), hdr
+            statuses.append(st)
+        # burn gate: no shedding below MIN_BURN_SAMPLES finished requests
+        assert all(s == 200 for s in statuses[:8]), statuses
+        assert 429 in statuses, \
+            f"SLO burn never tripped the shedder: {statuses}"
+        assert statuses[-1] == 429, statuses
+        args.endpoint, args.batch = "predict", 1
+        st, _, _ = _post_h(url + "/predict", make_payload(args))
+        assert st == 200, f"/predict under SLO shed -> {st} (tiered " \
+                          "shed must spare predict)"
+        _, page = _get(url + "/metrics")
+        assert scrape_value(page, "slo_violations_total") >= 8
+        assert scrape_value(page, "slo_ttft_violations_total") >= 8
+        assert scrape_value(page, "slo_burn_rate") == 1.0
+        assert scrape_value(page, "requests_state_shed_total") >= 1
+        st, txt = _get_status(url + "/debug/requests")
+        assert st == 200 and json.loads(txt)["slo"]["shedding"] is True
+        print(f"slo-smoke leg 2: {statuses.count(429)} shed by SLO "
+              f"burn, predict spared OK", flush=True)
+    finally:
+        _shutdown_clean(proc, log_lines)
+    print("slo-smoke: all ISSUE 15 assertions OK", flush=True)
     return 0
 
 
@@ -421,6 +671,15 @@ def main(argv=None):
                         " --speculate 4 /generate bit-identical to "
                         "--speculate 0, non-zero accept rate, >1 "
                         "accepted-tokens/step (spawns its own servers)")
+    p.add_argument("--sloSmoke", action="store_true",
+                   help="per-request observability assertion pass "
+                        "(ISSUE 15): TTFT/TPOT histograms populate, "
+                        "goodput/violation counters move, SLO burn "
+                        "trips the tiered shedder (generate 429s, "
+                        "predict spared), one access-log line per "
+                        "request, x-request-id echoed, /debug/requests "
+                        "shows requests mid-decode (spawns its own "
+                        "servers)")
     p.add_argument("--chaosSmoke", action="store_true",
                    help="serving-hardening assertion pass (ISSUE 6): "
                         "deadline-expiry 504, worker-kill fast 503 + "
@@ -438,12 +697,20 @@ def main(argv=None):
         return run_chaos_smoke(args)
     if args.specSmoke:
         return run_spec_smoke(args)
+    if args.sloSmoke:
+        return run_slo_smoke(args)
 
     proc = None
     if args.url:
         url = args.url.rstrip("/")
     else:
-        proc, url, log_lines = spawn_server(args, args.serveArg)
+        extra = list(args.serveArg)
+        # --smoke also asserts server-vs-client TTFT/TPOT agreement
+        # (ISSUE 15 satellite), which needs the lifecycle tracer on the
+        # spawned server; an explicit --serveArg=--reqTrace wins
+        if args.smoke and "--reqTrace" not in extra:
+            extra += ["--reqTrace", "on"]
+        proc, url, log_lines = spawn_server(args, extra)
     try:
         if args.smoke:
             run_smoke(url, args)
@@ -453,6 +720,9 @@ def main(argv=None):
             res["provenance"] = prov
             if args.endpoint == "generate":
                 res["spec"] = scrape_spec_columns(page)
+                # server-side request-latency columns next to the
+                # client-side quantiles (None when --reqTrace off)
+                res["server_latency_ms"] = scrape_server_latency(page)
             print(json.dumps(res), flush=True)
     finally:
         if proc is not None:

@@ -1,0 +1,82 @@
+#!/usr/bin/env bash
+# Round-19 capture: ISSUE 15 (per-request observability) chip evidence.
+# The correctness contracts are CPU-verified (tests/test_reqtrace.py,
+# the tier1 slo-smoke leg) — what only hardware can tell us is the
+# OVERHEAD: (a) --reqTrace off vs on A/B x3 on the r18 spec leg (same
+# greedy workload; acceptance is tokens/s and client p50 inside the
+# rep-to-rep noise band, while the on-legs' JSON lines also carry the
+# server-side ttft/tpot quantiles next to the client's); (b) the same
+# A/B over the full r18 stack (speculate + paged KV + prefix cache) —
+# the round-log bookkeeping must stay invisible under the fastest
+# decode path; (c) an SLO burn drill with targets set from the off-leg
+# p50s, tight enough that overload sheds instead of queueing. Appends
+# to $OUT, mirrored into the repo per step.
+
+set -uo pipefail
+cd "$(dirname "$0")/.."
+OUT="${OUT:-/tmp/tpu_capture_r19.log}"
+REPO_LOG="${REPO_LOG:-TPU_CAPTURE_r19.log}"
+trap 'cp -f "$OUT" "$REPO_LOG" 2>/dev/null || true' EXIT
+
+step() {
+  local name="$1" tmo="$2"; shift 2
+  echo "=== $name ($(date -u +%H:%M:%SZ))" | tee -a "$OUT"
+  timeout "$tmo" "$@" 2>&1 | tail -40 | tee -a "$OUT"
+  echo "=== end $name rc=$?" | tee -a "$OUT"
+  cp -f "$OUT" "$REPO_LOG" 2>/dev/null || true
+}
+
+# identical serving geometry + workload to tpu_capture_r18.sh so the
+# r19 overhead numbers read directly against the r18 slots
+LM="--serveArg=--vocabSize --serveArg=32000 \
+    --serveArg=--dModel --serveArg=1024 \
+    --serveArg=--numLayers --serveArg=8 \
+    --serveArg=--numHeads --serveArg=16 \
+    --serveArg=--seq --serveArg=1024 \
+    --serveArg=--slots --serveArg=8"
+GEN="--model transformer_lm --endpoint generate \
+     --requests 32 --concurrency 4 --promptLen 128 --maxNewTokens 128"
+SPEC="--serveArg=--speculate --serveArg=4"
+PAGED="--serveArg=--kvPageTokens --serveArg=128 --serveArg=--prefixCache"
+
+# 0. the reqtrace test file + the full CPU assertion pass on this env
+step "pytest_reqtrace" 900 env JAX_PLATFORMS=cpu python -m pytest \
+  tests/test_reqtrace.py -q
+step "slo_smoke" 900 python scripts/serving_bench.py \
+  --sloSmoke --model transformer_lm
+
+# 1. THE r19 leg — tracing overhead A/B x3 on the r18 spec config:
+#    same greedy workload with --reqTrace off vs on. tokens_per_second
+#    and latency_ms.p50 must match within noise; the on-legs' JSON
+#    lines add server_latency_ms (ttft/tpot p50-p99) for PERF.md §22.
+for REP in 1 2 3; do
+  for RT in off on; do
+    # shellcheck disable=SC2086
+    step "reqtrace_${RT}_rep${REP}" 1800 python scripts/serving_bench.py \
+      $GEN $LM $SPEC --serveArg=--reqTrace --serveArg="$RT" || true
+  done
+done
+
+# 2. the full r18 stack traced: speculate + paged KV + prefix cache
+#    with --reqTrace on — per-round bookkeeping (accepted tokens, pages
+#    held) must not tax the fastest decode path
+for REP in 1 2 3; do
+  # shellcheck disable=SC2086
+  step "reqtrace_full_rep${REP}" 1800 python scripts/serving_bench.py \
+    $GEN $LM $SPEC $PAGED --serveArg=--reqTrace --serveArg=on || true
+done
+
+# 3. SLO burn drill: targets tight enough that the c8 overload misses
+#    them — goodput, per-dim violation counters, and the tiered shed
+#    (generate 429s, predict spared) under real chip latencies. The
+#    access log prices itself at full sampling.
+# shellcheck disable=SC2086
+step "slo_burn" 1800 python scripts/serving_bench.py $GEN $LM \
+  --concurrency 8 \
+  --serveArg=--slo --serveArg=ttft=250,tpot=20,burn=0.75,window=32 \
+  --serveArg=--accessLog --serveArg=/tmp/r19_access.jsonl || true
+step "slo_burn_accesslog" 60 bash -c \
+  'wc -l /tmp/r19_access.jsonl && tail -3 /tmp/r19_access.jsonl'
+
+# 4. summarize every JSON line in this log for PERF.md §22
+step "summarize" 300 python scripts/update_perf_from_capture.py "$OUT"
