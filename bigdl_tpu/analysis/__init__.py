@@ -41,13 +41,15 @@ from bigdl_tpu.analysis.rules import (CATALOG, assert_blocks_tileable,
                                       check_block_tiling, min_sublane,
                                       run_comm_rules, run_decode_rules,
                                       run_jaxpr_rules,
-                                      run_memory_rules, run_module_rules)
+                                      run_memory_rules, run_module_rules,
+                                      run_serving_tp_rules)
 
 __all__ = ["Finding", "Report", "SEVERITIES", "CATALOG",
            "check_block_tiling", "check_block_padding",
            "assert_blocks_tileable", "min_sublane",
            "run_jaxpr_rules", "run_module_rules", "run_comm_rules",
            "run_memory_rules", "run_decode_rules",
+           "run_serving_tp_rules",
            "lint_fn", "trace_train_step", "lint_perf_model",
            "preflight_optimizer"]
 

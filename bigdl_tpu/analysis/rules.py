@@ -47,11 +47,12 @@ from bigdl_tpu.analysis.report import Finding, Report
 
 __all__ = ["CATALOG", "run_jaxpr_rules", "run_module_rules",
            "run_comm_rules", "run_memory_rules", "run_decode_rules",
+           "run_serving_tp_rules",
            "check_block_tiling", "check_block_padding",
            "assert_blocks_tileable", "min_sublane",
            "UPCAST_MIN_BYTES", "DONATE_MIN_BYTES", "VMEM_BUDGET_BYTES",
            "COMM_F32_MIN_BYTES", "COMM_MAX_COLLECTIVES",
-           "HBM_WARN_FRAC"]
+           "HBM_WARN_FRAC", "SERVING_TP_MIN_BYTES"]
 
 # rule id -> (family, severity, one-line catalog description)
 CATALOG: Dict[str, Tuple[str, str, str]] = {
@@ -166,6 +167,12 @@ CATALOG: Dict[str, Tuple[str, str, str]] = {
         "every pool page pads its tile, and when neither the flash "
         "block_k nor the page divides the other, K blocks straddle "
         "page boundaries in the gathered view (kv_page_plan)"),
+    "serving-unsharded-matmul": (
+        "serving", "error",
+        "tp-strategy serving graph carries a >=1 MiB matmul weight with "
+        "fully-replicated placement — every chip runs the full matmul "
+        "and tp buys nothing for it (a megatron_specs divisibility gate "
+        "fell back to replication)"),
 }
 
 UPCAST_MIN_BYTES = 2 * 1024 * 1024    # ignore small/scalar converts
@@ -176,6 +183,7 @@ COMM_F32_MIN_BYTES = 1 * 1024 * 1024  # grad wire worth compressing
 COMM_MAX_COLLECTIVES = 16             # per-leaf-reduce smell threshold
 HBM_WARN_FRAC = 0.85                  # plan/HBM ratio that earns hbm-tight
 DECODE_SORT_MIN_LANES = 16384         # vocab size where the warp sort bites
+SERVING_TP_MIN_BYTES = 1 * 1024 * 1024  # matmul weight worth sharding
 
 _SUBLANE = {4: 8, 2: 16, 1: 32}
 
@@ -563,6 +571,49 @@ def run_decode_rules(closed=None, *, page_tokens: Optional[int] = None,
                      "(tuning.kv_page_tokens: 32/64/128/256, 8-aligned "
                      "and block-commensurate) or 'auto'",
                 detail=plan))
+    return report
+
+
+def run_serving_tp_rules(params, n_shard: int,
+                         report: Optional[Report] = None) -> Report:
+    """Tensor-parallel serving placement rules (ISSUE 16), run by the
+    serve preflight when ``--strategy tp:K`` (K > 1) is active, over the
+    PLACED param tree (leaves are committed ``jax.Array``s carrying
+    their sharding). Like :func:`run_comm_rules`, this reads placement
+    rather than the jaxpr: jit-SPMD traces carry no sharding eqns, but
+    the committed weights ARE the serving graph's matmul operands — a
+    >=1 MiB weight matrix left fully replicated under tp means every
+    chip runs that matmul whole (a ``megatron_specs`` divisibility gate
+    fell back), which is exactly the perf bug worth refusing to serve.
+    """
+    report = report if report is not None else Report()
+    if n_shard <= 1:
+        return report
+    import jax
+
+    flat, _ = jax.tree_util.tree_flatten_with_path(params)
+    for path, leaf in flat:
+        shape = tuple(getattr(leaf, "shape", ()))
+        if len(shape) < 2:
+            continue  # biases/scales never feed the MXU contraction
+        nbytes = int(np.prod(shape)) * leaf.dtype.itemsize
+        if nbytes < SERVING_TP_MIN_BYTES:
+            continue
+        sharding = getattr(leaf, "sharding", None)
+        if sharding is None or not sharding.is_fully_replicated:
+            continue
+        where = jax.tree_util.keystr(path)
+        report.add(_finding(
+            "serving-unsharded-matmul",
+            f"{where}: {nbytes / 2**20:.1f} MiB weight {shape} is "
+            f"fully replicated under tp={n_shard} — each chip runs "
+            "this matmul whole",
+            where=where,
+            hint="shard dims the Megatron pairing can split (d_model / "
+                 "heads divisible by K), or drop --strategy tp for "
+                 "this model",
+            detail={"bytes": nbytes, "shape": list(shape),
+                    "tp": int(n_shard)}))
     return report
 
 

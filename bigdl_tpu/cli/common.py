@@ -674,6 +674,77 @@ def strategy_mesh_axes(name: str, n_devices: int, k: Optional[int] = None
     raise SystemExit(f"unknown strategy {name!r}")
 
 
+# the serving --strategy surface (ISSUE 16): two orthogonal axes behind
+# one front door — ``tp[:K]`` shards the model over K chips, ``dp[:N]``
+# runs N independent engine replicas, ``dp:N+tp:K`` composes them (N
+# replicas, each tensor-parallel over K chips). Unlike the training
+# grammar above there is no implicit data axis: serving devices are
+# partitioned, not meshed globally.
+SERVING_STRATEGY_CHOICES = ("dp", "tp")
+
+
+def parse_serving_strategy(spec: Optional[str], n_devices: int):
+    """``"tp[:K] | dp[:N] | dp:N+tp:K"`` -> ``(replicas, tp_k)``.
+
+    Defaults when the axis size is omitted: ``tp`` -> all visible
+    devices on the model axis, ``dp`` -> one single-device replica per
+    visible device. Validates ``replicas * tp_k <= n_devices`` with the
+    XLA_FLAGS recipe in the error (the clean-CLI-validation contract).
+    ``None``/empty spec -> ``(1, 1)`` (the single-chip path)."""
+    n = int(n_devices)
+    if not spec:
+        return 1, 1
+    replicas: Optional[int] = None
+    tp_k: Optional[int] = None
+    seen_dp = seen_tp = False
+    for part in str(spec).split("+"):
+        name, _, k = part.strip().partition(":")
+        if name not in SERVING_STRATEGY_CHOICES:
+            raise SystemExit(
+                f"serve --strategy {spec!r}: unknown axis {name!r}; the "
+                f"serving grammar is tp[:K], dp[:N], or dp:N+tp:K")
+        try:
+            kk = int(k) if k else None
+        except ValueError:
+            raise SystemExit(
+                f"serve --strategy {spec!r}: axis size in {part!r} must "
+                "be an integer")
+        if kk is not None and kk < 1:
+            raise SystemExit(
+                f"serve --strategy {spec!r}: axis size in {part!r} must "
+                "be >= 1")
+        if name == "dp":
+            if seen_dp:
+                raise SystemExit(
+                    f"serve --strategy {spec!r}: dp given twice")
+            seen_dp, replicas = True, kk
+        else:
+            if seen_tp:
+                raise SystemExit(
+                    f"serve --strategy {spec!r}: tp given twice")
+            seen_tp, tp_k = True, kk
+    # resolve omitted axis sizes: a lone axis claims every visible
+    # device; in the composed form the omitted one takes what the
+    # explicit one leaves over
+    if seen_tp and tp_k is None:
+        tp_k = max(n // (replicas or 1), 1) if seen_dp else max(n, 1)
+    if seen_dp and replicas is None:
+        replicas = max(n // (tp_k or 1), 1) if seen_tp else max(n, 1)
+    replicas, tp_k = replicas or 1, tp_k or 1
+    if replicas * tp_k > n:
+        need = replicas * tp_k
+        shape = (f"{replicas} replicas x {tp_k}-way tp"
+                 if seen_dp and seen_tp else
+                 f"{tp_k}-way tp" if seen_tp else
+                 f"one device per replica x {replicas} replicas")
+        raise SystemExit(
+            f"serve --strategy {spec!r} needs {need} devices ({shape}) "
+            f"but only {n} are visible; on CPU export XLA_FLAGS="
+            f"--xla_force_host_platform_device_count={need} to fake "
+            "them")
+    return replicas, tp_k
+
+
 # the --gradCompress surface (ISSUE 10): the wire dtypes of the
 # compressed gradient all-reduce, optionally error-compensated (must
 # mirror parallel/grad_comm.COMPRESS_MODES — asserted in tests, not

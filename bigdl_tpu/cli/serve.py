@@ -56,6 +56,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("-p", "--port", type=int, default=8000,
                    help="0 = ephemeral (the chosen port is printed)")
+    p.add_argument("--strategy", default=None, metavar="SPEC",
+                   help="multi-chip serving (ISSUE 16): 'tp[:K]' shards "
+                        "the model over K chips (Megatron layout, "
+                        "bit-identical greedy output), 'dp[:N]' runs N "
+                        "independent engine replicas behind one front "
+                        "door (least-loaded routing, per-replica "
+                        "/metrics labels), 'dp:N+tp:K' composes them. "
+                        "Omitted sizes take all visible devices. "
+                        "Default: single-device, exactly as before")
     p.add_argument("--buckets", default="1,2,4,8,16,32",
                    help="batch-size buckets the engine pre-compiles; "
                         "requests pad up to the nearest (bounded compile "
@@ -244,9 +253,30 @@ def build_app(args):
     if is_lm and compute_dtype is not None:
         model.compute_dtype = compute_dtype  # post-embedding cast
 
+    # --strategy (ISSUE 16): tp shards each engine over K chips, dp
+    # runs N independent replicas on disjoint device groups; composed,
+    # each replica is a K-chip tp engine
+    strategy = getattr(args, "strategy", None)
+    n_replicas, tp_k, groups, mesh0 = 1, 1, None, None
+    if strategy:
+        import jax
+
+        from bigdl_tpu.serving import replica_device_groups, serving_mesh
+        n_replicas, tp_k = common.parse_serving_strategy(
+            strategy, len(jax.devices()))
+        groups = replica_device_groups(n_replicas, tp_k)
+        mesh0 = serving_mesh(groups[0])
+
     if args.checkpoint:
-        from bigdl_tpu.utils.orbax_ckpt import restore_for_inference
-        params, mod_state = restore_for_inference(args.checkpoint)
+        if mesh0 is not None:
+            # any training topology -> this serving topology (PR 10's
+            # resharded restore; engines re-place per replica/mesh)
+            from bigdl_tpu.serving import restore_for_serving
+            params, mod_state = restore_for_serving(args.checkpoint,
+                                                    mesh0)
+        else:
+            from bigdl_tpu.utils.orbax_ckpt import restore_for_inference
+            params, mod_state = restore_for_inference(args.checkpoint)
     elif args.randomInit:
         import jax
         params, mod_state = model.init(jax.random.PRNGKey(0)), None
@@ -288,28 +318,15 @@ def build_app(args):
             capacity=args.reqTraceCapacity, metrics=metrics, slo=slo,
             access_log=access_log)
         _reqtrace.set_request_tracer(reqtracer)
-    engine = InferenceEngine(
-        model, params, mod_state, buckets=_parse_buckets(args.buckets),
-        compute_dtype=compute_dtype, lint=getattr(args, "lint", None),
-        metrics=metrics)
     in_dtype = np.int32 if is_lm else np.float32
-
-    # lint pre-flight over the exact serving graph BEFORE first compile
-    # (strict refuses to serve, same contract as the perf/training CLIs)
-    rc = engine.preflight_lint(in_shape, in_dtype)
-    if rc:
-        raise SystemExit(rc)
-
-    batcher = MicroBatcher(engine.predict_scores, max_batch=args.maxBatch,
-                           max_wait_ms=args.maxWaitMs,
-                           max_queue=args.maxQueue, metrics=metrics)
-    decoder = None
+    lint_mode = getattr(args, "lint", None)
+    page_tokens = None
+    draft_model = draft_params = None
     if is_lm:
         page_tokens = _resolve_page_tokens(args, model, compute_dtype)
         if args.prefixCache and page_tokens is None:
             raise SystemExit("--prefixCache needs --kvPageTokens (prefix "
                              "sharing is a page copy)")
-        draft_model = draft_params = None
         if args.speculate > 0 and args.draftDims:
             import jax
 
@@ -320,42 +337,93 @@ def build_app(args):
                 model.vocab, max_len=model.max_len,
                 compute_dtype=compute_dtype, **dims)
             draft_params = draft_model.init(jax.random.PRNGKey(1))
-        decoder = DecodeEngine(model, params, slots=args.slots,
-                               cache_dtype=compute_dtype,
-                               max_waiting=args.maxWaiting,
-                               metrics=metrics,
-                               kv_page_tokens=page_tokens,
-                               speculate=args.speculate,
-                               draft_model=draft_model,
-                               draft_params=draft_params,
-                               prefix_cache=args.prefixCache)
-        # decode-path lint pre-flight (ISSUE 14): sampling-sort /
-        # host-sync rules over the traced decode step + the page-layout
-        # fit, same strict contract as the forward's preflight
-        lint_mode = getattr(args, "lint", None)
-        if lint_mode is not None:
-            from bigdl_tpu.analysis import run_decode_rules
-            from bigdl_tpu.cli.common import run_preflight_lint
-            head_dim = getattr(model.encoder._modules[0].mha,
-                               "head_dim", model.d_model // 4)
-            report = run_decode_rules(
-                decoder.trace_step_jaxpr(), page_tokens=page_tokens,
-                max_len=decoder.max_len, head_dim=head_dim,
-                dtype=decoder.cache_dtype)
-            rc, _ = run_preflight_lint(report,
-                                       strict=(lint_mode == "strict"))
+
+    def _build_stack(mesh, m, first):
+        """One replica's full serving stack. ``m`` is its metrics view
+        (labelled per replica under dp); pre-flight lints run for the
+        FIRST stack only — replicas compile the identical graph."""
+        engine = InferenceEngine(
+            model, params, mod_state,
+            buckets=_parse_buckets(args.buckets),
+            compute_dtype=compute_dtype, lint=lint_mode,
+            metrics=m, mesh=mesh)
+        if first:
+            # lint pre-flight over the exact serving graph BEFORE first
+            # compile (strict refuses to serve, same contract as the
+            # perf/training CLIs)
+            rc = engine.preflight_lint(in_shape, in_dtype)
             if rc:
                 raise SystemExit(rc)
-        decoder.start()
+            if lint_mode is not None and tp_k > 1:
+                # tp placement rule (ISSUE 16): a big matmul weight the
+                # Megatron pairing left replicated defeats the strategy
+                from bigdl_tpu.analysis import run_serving_tp_rules
+                report = run_serving_tp_rules(engine.params, tp_k)
+                rc, _ = common.run_preflight_lint(
+                    report, strict=(lint_mode == "strict"))
+                if rc:
+                    raise SystemExit(rc)
+        batcher = MicroBatcher(engine.predict_scores,
+                               max_batch=args.maxBatch,
+                               max_wait_ms=args.maxWaitMs,
+                               max_queue=args.maxQueue, metrics=m)
+        decoder = None
+        if is_lm:
+            decoder = DecodeEngine(model, params, slots=args.slots,
+                                   cache_dtype=compute_dtype,
+                                   max_waiting=args.maxWaiting,
+                                   metrics=m,
+                                   kv_page_tokens=page_tokens,
+                                   speculate=args.speculate,
+                                   draft_model=draft_model,
+                                   draft_params=draft_params,
+                                   prefix_cache=args.prefixCache,
+                                   mesh=mesh)
+            # decode-path lint pre-flight (ISSUE 14): sampling-sort /
+            # host-sync rules over the traced decode step + the
+            # page-layout fit, same strict contract as the forward's
+            if first and lint_mode is not None:
+                from bigdl_tpu.analysis import run_decode_rules
+                head_dim = getattr(model.encoder._modules[0].mha,
+                                   "head_dim", model.d_model // 4)
+                report = run_decode_rules(
+                    decoder.trace_step_jaxpr(), page_tokens=page_tokens,
+                    max_len=decoder.max_len, head_dim=head_dim,
+                    dtype=decoder.cache_dtype)
+                rc, _ = common.run_preflight_lint(
+                    report, strict=(lint_mode == "strict"))
+                if rc:
+                    raise SystemExit(rc)
+            decoder.start()
+        # watchdog over every worker thread: dead/wedged -> pending
+        # futures fail fast, /readyz flips 503, /healthz stays (ISSUE 6)
+        watchdog = Watchdog(stall_timeout_s=args.watchdogStallS,
+                            metrics=m)
+        watchdog.watch("batcher", batcher)
+        if decoder is not None:
+            watchdog.watch("decoder", decoder)
+        watchdog.start()
+        return engine, batcher, decoder, watchdog
 
-    # watchdog over every worker thread: dead/wedged -> pending futures
-    # fail fast, /readyz flips 503, /healthz stays live (ISSUE 6)
-    watchdog = Watchdog(stall_timeout_s=args.watchdogStallS,
-                        metrics=metrics)
-    watchdog.watch("batcher", batcher)
-    if decoder is not None:
-        watchdog.watch("decoder", decoder)
-    watchdog.start()
+    replica_set = None
+    if n_replicas > 1:
+        from bigdl_tpu.serving import Replica, ReplicaSet, serving_mesh
+        reps = []
+        for r in range(n_replicas):
+            mesh_r = serving_mesh(groups[r])
+            m = metrics.labelled(replica=str(r))
+            eng_r, bat_r, dec_r, wd_r = _build_stack(mesh_r, m,
+                                                     first=(r == 0))
+            reps.append(Replica(r, devices=groups[r], mesh=mesh_r,
+                                engine=eng_r, batcher=bat_r,
+                                decoder=dec_r, watchdog=wd_r,
+                                metrics=m))
+        replica_set = ReplicaSet(reps, metrics=metrics)
+        engine, batcher = reps[0].engine, None
+        decoder, watchdog = reps[0].decoder, None
+    else:
+        engine, batcher, decoder, watchdog = _build_stack(
+            mesh0, metrics, first=True)
 
     prov = engine.provenance()
     prov.update({
@@ -367,6 +435,14 @@ def build_app(args):
         "shed_at": args.shedAt,
         "reqtrace": "on" if reqtracer is not None else "off",
     })
+    if strategy:
+        import jax
+        # multi-chip topology provenance (ISSUE 16): every /metrics
+        # scrape and bench record names the serving shape it measured
+        prov["strategy"] = strategy
+        prov["serving_replicas"] = n_replicas
+        prov["serving_tp"] = tp_k
+        prov["n_devices"] = len(jax.devices())
     if reqtracer is not None:
         prov["slo"] = args.slo if args.slo else "none"
         if reqtracer.access_log is not None:
@@ -383,21 +459,32 @@ def build_app(args):
         prov["prefix_cache"] = int(bool(args.prefixCache))
         if args.speculate > 0:
             # measured, resolved per scrape: tokens emitted per target
-            # verify dispatch (the ISSUE 14 acceptance number)
+            # verify dispatch (the ISSUE 14 acceptance number; replica
+            # 0's labelled series under dp)
             g = metrics.gauge("spec_accepted_tokens_per_step",
-                              "tokens emitted per target verify step")
+                              "tokens emitted per target verify step",
+                              labels=({"replica": "0"}
+                                      if replica_set is not None
+                                      else None))
             prov["spec_accepted_tokens_per_step"] = \
                 lambda: round(g.value, 4)
     if getattr(args, "faultPlan", None):
         prov["fault_plan"] = args.faultPlan
     metrics.set_provenance(prov)
 
-    app = ServingApp(name=name, metrics=metrics, engine=engine,
-                     batcher=batcher, decoder=decoder,
-                     request_timeout_s=args.timeout,
-                     default_deadline_ms=args.deadlineMs,
-                     shed_generate_frac=args.shedAt,
-                     watchdog=watchdog)
+    if replica_set is not None:
+        app = ServingApp(name=name, metrics=metrics,
+                         replicas=replica_set,
+                         request_timeout_s=args.timeout,
+                         default_deadline_ms=args.deadlineMs,
+                         shed_generate_frac=args.shedAt)
+    else:
+        app = ServingApp(name=name, metrics=metrics, engine=engine,
+                         batcher=batcher, decoder=decoder,
+                         request_timeout_s=args.timeout,
+                         default_deadline_ms=args.deadlineMs,
+                         shed_generate_frac=args.shedAt,
+                         watchdog=watchdog)
     return app, engine, in_shape, in_dtype
 
 
@@ -410,9 +497,14 @@ def main(argv=None):
 
     app, engine, in_shape, in_dtype = build_app(args)
     if not args.no_warmup:
+        engines = ([r.engine for r in app.replicas.replicas]
+                   if app.replicas is not None else [engine])
         print(f"warmup: compiling buckets {engine.buckets} at "
-              f"{tuple(in_shape)} {in_dtype.__name__}", flush=True)
-        engine.warmup(in_shape, in_dtype)
+              f"{tuple(in_shape)} {in_dtype.__name__}"
+              + (f" x{len(engines)} replicas" if len(engines) > 1
+                 else ""), flush=True)
+        for e in engines:
+            e.warmup(in_shape, in_dtype)
     return run_server(app, args.host, args.port)
 
 

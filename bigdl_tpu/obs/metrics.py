@@ -34,6 +34,7 @@ import time
 from typing import Callable, Dict, List, Optional, Sequence
 
 __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
+           "LabelledRegistry",
            "DEFAULT_LATENCY_BUCKETS_MS", "ITL_BUCKETS_MS",
            "PHASE_BUCKETS_MS",
            "get_registry", "set_registry", "reset_registry",
@@ -69,8 +70,10 @@ TRAIN_PHASES = ("data_wait", "h2d", "dispatch", "device", "ckpt")
 class Counter:
     """Monotone counter; ``inc`` is one lock + one add."""
 
-    def __init__(self, name: str, help: str = ""):
+    def __init__(self, name: str, help: str = "",
+                 labels: Optional[Dict[str, str]] = None):
         self.name, self.help = name, help
+        self.labels = dict(labels) if labels else None
         self._lock = threading.Lock()
         self._value = 0.0
 
@@ -89,8 +92,10 @@ class Gauge:
     a ``fn`` sampled at render time (queue depth, occupancy)."""
 
     def __init__(self, name: str, help: str = "",
-                 fn: Optional[Callable[[], float]] = None):
+                 fn: Optional[Callable[[], float]] = None,
+                 labels: Optional[Dict[str, str]] = None):
         self.name, self.help = name, help
+        self.labels = dict(labels) if labels else None
         self._fn = fn
         self._lock = threading.Lock()
         self._value = 0.0
@@ -120,8 +125,10 @@ class Histogram:
     answer instead of infinity)."""
 
     def __init__(self, name: str, help: str = "",
-                 bounds: Sequence[float] = DEFAULT_LATENCY_BUCKETS_MS):
+                 bounds: Sequence[float] = DEFAULT_LATENCY_BUCKETS_MS,
+                 labels: Optional[Dict[str, str]] = None):
         self.name, self.help = name, help
+        self.labels = dict(labels) if labels else None
         self.bounds: List[float] = sorted(float(b) for b in bounds)
         if not self.bounds:
             raise ValueError("histogram needs at least one bucket bound")
@@ -206,33 +213,49 @@ class MetricsRegistry:
         self._clock = clock  # injectable: uptime-derived gauges (tokens/s)
         self._t0 = clock()   # become deterministic under test
 
-    def _register(self, name, factory):
+    def _register(self, name, labels, factory):
+        # unlabelled instruments keep their bare name as the key, so every
+        # pre-label consumer (tests poking ``reg._metrics["..."]``, scrape
+        # parsers) sees an unchanged map; labelled series append the
+        # rendered label set so one name can carry many series
+        key = name if not labels else f"{name}{{{_labels_str(labels)}}}"
         with self._lock:
-            m = self._metrics.get(name)
+            m = self._metrics.get(key)
             if m is None:
-                m = self._metrics[name] = factory()
+                m = self._metrics[key] = factory()
             return m
 
-    def counter(self, name: str, help: str = "") -> Counter:
-        m = self._register(name, lambda: Counter(name, help))
+    def counter(self, name: str, help: str = "",
+                labels: Optional[Dict[str, str]] = None) -> Counter:
+        m = self._register(name, labels, lambda: Counter(name, help, labels))
         if not isinstance(m, Counter):
             raise TypeError(f"{name} already registered as {type(m).__name__}")
         return m
 
     def gauge(self, name: str, help: str = "",
-              fn: Optional[Callable[[], float]] = None) -> Gauge:
-        m = self._register(name, lambda: Gauge(name, help, fn))
+              fn: Optional[Callable[[], float]] = None,
+              labels: Optional[Dict[str, str]] = None) -> Gauge:
+        m = self._register(name, labels,
+                           lambda: Gauge(name, help, fn, labels))
         if not isinstance(m, Gauge):
             raise TypeError(f"{name} already registered as {type(m).__name__}")
         return m
 
     def histogram(self, name: str, help: str = "",
-                  bounds: Sequence[float] = DEFAULT_LATENCY_BUCKETS_MS
-                  ) -> Histogram:
-        m = self._register(name, lambda: Histogram(name, help, bounds))
+                  bounds: Sequence[float] = DEFAULT_LATENCY_BUCKETS_MS,
+                  labels: Optional[Dict[str, str]] = None) -> Histogram:
+        m = self._register(name, labels,
+                           lambda: Histogram(name, help, bounds, labels))
         if not isinstance(m, Histogram):
             raise TypeError(f"{name} already registered as {type(m).__name__}")
         return m
+
+    def labelled(self, **labels: str) -> "LabelledRegistry":
+        """A view of this registry that stamps ``labels`` onto every
+        instrument created through it — how each dp engine replica keeps
+        calling the plain counter/gauge/histogram API while its series
+        land as ``...{replica="0"}`` on the shared scrape page."""
+        return LabelledRegistry(self, labels)
 
     def set_provenance(self, prov: dict) -> None:
         with self._lock:
@@ -273,32 +296,94 @@ class MetricsRegistry:
         lines.append(f"# HELP {ns}_uptime_seconds process uptime")
         lines.append(f"# TYPE {ns}_uptime_seconds gauge")
         lines.append(f"{ns}_uptime_seconds {self.uptime_s():.3f}")
+        # series of one name emit contiguously (unlabelled aggregate
+        # first, then labelled replicas) with HELP/TYPE stated once
+        metrics.sort(key=lambda m: (m.name, _labels_str(m.labels)))
+        seen: set = set()
         for m in metrics:
             full = f"{ns}_{m.name}"
-            if m.help:
-                lines.append(f"# HELP {full} {m.help}")
+            base = m.labels or {}
+            sfx = f"{{{_labels_str(base)}}}" if base else ""
+            if m.name not in seen:
+                seen.add(m.name)
+                if m.help:
+                    lines.append(f"# HELP {full} {m.help}")
+                kind = ("counter" if isinstance(m, Counter) else
+                        "gauge" if isinstance(m, Gauge) else "histogram")
+                lines.append(f"# TYPE {full} {kind}")
             if isinstance(m, Counter):
-                lines.append(f"# TYPE {full} counter")
-                lines.append(f"{full} {_fmt(m.value)}")
+                lines.append(f"{full}{sfx} {_fmt(m.value)}")
             elif isinstance(m, Gauge):
-                lines.append(f"# TYPE {full} gauge")
-                lines.append(f"{full} {_fmt(m.value)}")
+                lines.append(f"{full}{sfx} {_fmt(m.value)}")
             elif isinstance(m, Histogram):
                 snap = m.snapshot()
-                lines.append(f"# TYPE {full} histogram")
                 cum = 0
                 for b, c in zip(m.bounds, snap["counts"]):
                     cum += c
-                    lines.append(f'{full}_bucket{{le="{_fmt(b)}"}} {cum}')
+                    lines.append(
+                        f"{full}_bucket{{"
+                        f'{_labels_str(base, le=_fmt(b))}}} {cum}')
                 lines.append(
-                    f'{full}_bucket{{le="+Inf"}} {snap["count"]}')
-                lines.append(f"{full}_sum {_fmt(snap['sum'])}")
-                lines.append(f"{full}_count {snap['count']}")
+                    f'{full}_bucket{{{_labels_str(base, le="+Inf")}}} '
+                    f'{snap["count"]}')
+                lines.append(f"{full}_sum{sfx} {_fmt(snap['sum'])}")
+                lines.append(f"{full}_count{sfx} {snap['count']}")
                 for q in self.QUANTILES:
                     lines.append(
-                        f'{full}{{quantile="{q}"}} '
+                        f"{full}{{{_labels_str(base, quantile=str(q))}}} "
                         f"{_fmt(m.quantile(q, snap))}")
         return "\n".join(lines) + "\n"
+
+
+class LabelledRegistry:
+    """Label-stamping view over a :class:`MetricsRegistry`.
+
+    Forwards the whole instrument-factory surface with a fixed label set
+    merged in, so a component built against the plain registry API
+    (engine, batcher, decoder) can be instantiated per dp replica without
+    knowing it is one of N. Views nest: ``labelled()`` on a view merges
+    label sets (inner wins on collision)."""
+
+    def __init__(self, registry: MetricsRegistry, labels: Dict[str, str]):
+        self._registry = registry
+        self.labels = {str(k): str(v) for k, v in labels.items()}
+
+    @property
+    def namespace(self) -> str:
+        return self._registry.namespace
+
+    def counter(self, name: str, help: str = "",
+                labels: Optional[Dict[str, str]] = None) -> Counter:
+        return self._registry.counter(name, help,
+                                      labels={**self.labels, **(labels or {})})
+
+    def gauge(self, name: str, help: str = "",
+              fn: Optional[Callable[[], float]] = None,
+              labels: Optional[Dict[str, str]] = None) -> Gauge:
+        return self._registry.gauge(name, help, fn,
+                                    labels={**self.labels, **(labels or {})})
+
+    def histogram(self, name: str, help: str = "",
+                  bounds: Sequence[float] = DEFAULT_LATENCY_BUCKETS_MS,
+                  labels: Optional[Dict[str, str]] = None) -> Histogram:
+        return self._registry.histogram(
+            name, help, bounds, labels={**self.labels, **(labels or {})})
+
+    def labelled(self, **labels: str) -> "LabelledRegistry":
+        return LabelledRegistry(self._registry, {**self.labels, **labels})
+
+    def uptime_s(self) -> float:
+        return self._registry.uptime_s()
+
+    def set_provenance(self, prov: dict) -> None:
+        self._registry.set_provenance(prov)
+
+    @property
+    def provenance(self) -> dict:
+        return self._registry.provenance
+
+    def render(self) -> str:
+        return self._registry.render()
 
 
 def _fmt(v) -> str:
@@ -313,6 +398,15 @@ def _fmt(v) -> str:
 def _label_escape(v) -> str:
     return str(v).replace("\\", "\\\\").replace('"', '\\"').replace(
         "\n", "\\n")
+
+
+def _labels_str(labels: Optional[Dict[str, str]], **extra: str) -> str:
+    """Sorted ``k="v"`` label rendering; ``extra`` pairs (``le``,
+    ``quantile``) merge after the instrument's own labels."""
+    merged = dict(labels or {})
+    merged.update(extra)
+    return ",".join(
+        f'{k}="{_label_escape(v)}"' for k, v in sorted(merged.items()))
 
 
 # --------------------------------------------------------------- global

@@ -30,7 +30,13 @@ Modules:
 * :mod:`server`  — stdlib ThreadingHTTPServer JSON endpoints
   (``/predict`` ``/generate`` ``/healthz`` ``/readyz`` ``/metrics``)
   with per-request deadlines (504), tiered overload shedding (429 on
-  ``/generate`` first), wired to the ``bigdl-tpu serve`` CLI.
+  ``/generate`` first), wired to the ``bigdl-tpu serve`` CLI;
+* :mod:`sharding` — tensor-parallel placement for serving (ISSUE 16):
+  reuses the training Megatron specs for params, shards KV on the
+  kv_heads dim, restores checkpoints onto any serving mesh;
+* :mod:`replicas` — data-parallel engine replicas behind one front
+  door (ISSUE 16): least-loaded deterministic routing, fleet-level
+  readiness/shedding, per-replica labelled metrics + fleet aggregates.
 """
 
 from bigdl_tpu.serving.batcher import (AdmissionError, DeadlineExceeded,
@@ -42,11 +48,15 @@ from bigdl_tpu.serving.kv_pages import (PageAllocator, PagedKvCache,
 from bigdl_tpu.serving.metrics import (Counter, Gauge, Histogram,
                                        MetricsRegistry)
 from bigdl_tpu.serving.prefix_cache import PrefixCache
+from bigdl_tpu.serving.replicas import Replica, ReplicaSet
 from bigdl_tpu.serving.reqtrace import (AccessLog, RequestRecord,
                                         RequestTracer, SloPolicy,
                                         get_request_tracer, mint_rid,
                                         sanitize_rid, set_request_tracer)
 from bigdl_tpu.serving.server import ServingApp, make_server, run_server
+from bigdl_tpu.serving.sharding import (ServingSharding,
+                                        replica_device_groups,
+                                        restore_for_serving, serving_mesh)
 from bigdl_tpu.serving.spec_decode import (accept_chunk, parse_draft_dims,
                                            request_key, sample_token,
                                            warp_logits)
@@ -62,4 +72,7 @@ __all__ = ["AdmissionError", "DeadlineExceeded", "MicroBatcher",
            "AccessLog", "RequestRecord", "RequestTracer", "SloPolicy",
            "get_request_tracer", "mint_rid", "sanitize_rid",
            "set_request_tracer",
-           "ServingApp", "make_server", "run_server", "Watchdog"]
+           "ServingApp", "make_server", "run_server", "Watchdog",
+           "Replica", "ReplicaSet", "ServingSharding",
+           "replica_device_groups", "restore_for_serving",
+           "serving_mesh"]

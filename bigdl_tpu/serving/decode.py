@@ -129,7 +129,8 @@ class DecodeEngine:
                  pool_pages: Optional[int] = None, speculate: int = 0,
                  draft_model=None, draft_params=None,
                  prefix_cache: bool = False,
-                 prefix_cache_pages: Optional[int] = None):
+                 prefix_cache_pages: Optional[int] = None,
+                 mesh=None, model_axis: str = "model"):
         import jax
         import jax.numpy as jnp
         import time as _time
@@ -142,6 +143,17 @@ class DecodeEngine:
         self._worker_error: Optional[BaseException] = None
         self._last_beat = self.clock()
         self.model = model
+        # ---- tp placement (ISSUE 16): params go to the mesh under the
+        # Megatron layout, KV leaves split on the kv_heads dim, logits /
+        # host scalars stay replicated. mesh=None keeps the single-chip
+        # path byte-for-byte (a 1-device mesh = a pinned dp replica).
+        self.mesh = mesh
+        if mesh is not None:
+            from bigdl_tpu.serving.sharding import ServingSharding
+            self._shard = ServingSharding(mesh, axis=model_axis)
+            params = self._shard.place_params(model, params)
+        else:
+            self._shard = None
         self.params = params
         self.slots = int(slots)
         self.max_len = int(max_len or model.max_len)
@@ -180,17 +192,24 @@ class DecodeEngine:
             self._kv = _kvp.PagedKvCache(
                 model.encoder, slots=self.slots, max_len=self.max_len,
                 page_tokens=self.page_tokens, dtype=self.cache_dtype,
-                pool_pages=pool_pages, extra_pages=extra)
+                pool_pages=pool_pages, extra_pages=extra,
+                sharding=(self._shard.kv_sharding
+                          if self._shard is not None else None))
             self._cache = None
         else:
             self._kv = None
             self._cache = model.encoder.init_cache(
                 self.slots, self.max_len, self.cache_dtype)
+            if self._shard is not None:
+                self._cache = self._shard.place_kv(self._cache)
         self._pfx = (PrefixCache(self._kv, max_pages=prefix_cache_pages,
                                  metrics=metrics)
                      if prefix_cache else None)
 
         self._logits = jnp.zeros((self.slots, model.vocab), jnp.float32)
+        if self._shard is not None:
+            self._logits = jax.device_put(self._logits,
+                                          self._shard.replicated)
         self._pos = np.zeros(self.slots, np.int32)
         self._temp = np.zeros(self.slots, np.float32)
         self._topk = np.zeros(self.slots, np.int32)
@@ -211,6 +230,14 @@ class DecodeEngine:
                                  or jnp.float32)
             self._draft_cache = self.draft_model.encoder.init_cache(
                 self.slots, self.max_len, self._draft_dtype)
+            if self._shard is not None:
+                # a distinct draft model gets its own Megatron layout
+                # (the self-draft default already shares the placed
+                # target params)
+                if draft_model is not None:
+                    self.draft_params = self._shard.place_params(
+                        self.draft_model, self.draft_params)
+                self._draft_cache = self._shard.place_kv(self._draft_cache)
         else:
             self.draft_model = self.draft_params = None
             self._draft_cache = None
@@ -313,6 +340,25 @@ class DecodeEngine:
             self._m_spec_prop = self._m_spec_acc = None
             self._m_draft_steps = None
 
+    def kv_bytes(self) -> int:
+        """Resident KV bytes — allocated pages when paged, the dense
+        slab otherwise. Per-replica truth; the dp fleet aggregate sums
+        this across replicas (ISSUE 16 satellite)."""
+        if self.paged:
+            return self._kv.allocated_bytes()
+        from bigdl_tpu.obs.memory import tree_bytes
+        return tree_bytes(self._cache)
+
+    def kv_pages_in_use(self) -> int:
+        return self._kv.alloc.pages_in_use if self.paged else 0
+
+    def queue_load(self) -> int:
+        """Routing signal for dp replica selection: active slots plus
+        waiting requests (approximate read — no lock; routing only needs
+        a consistent ordering, not an exact census)."""
+        return (sum(r is not None for r in self._reqs)
+                + len(self._waiting))
+
     def _page_occupancy(self) -> float:
         live = int(sum(int(self._pos[i])
                        for i, r in enumerate(self._reqs) if r is not None))
@@ -329,6 +375,27 @@ class DecodeEngine:
         # can't honor it and warns on every compile
         self._don = jax.default_backend() != "cpu"
 
+        # tp (ISSUE 16): precompute the sharding pytrees pinned as
+        # out_shardings on every program whose output feeds persistent
+        # state (_logits / _cache / pools / draft cache) — the layout is
+        # decided once here, never re-derived per compile, so sharded
+        # state cannot ping-pong between layouts across the lazily-keyed
+        # program caches
+        shard = self._shard
+        if shard is not None:
+            cache1_abs = jax.eval_shape(
+                lambda: model.encoder.init_cache(1, self.max_len,
+                                                 self.cache_dtype))
+            self._cache1_sh = shard.kv_shardings(cache1_abs)
+            self._state_sh = (self._kv.pool_shardings if self.paged
+                              else shard.kv_shardings(self._cache))
+            self._repl_sh = shard.replicated
+            self._draft_sh = (shard.kv_shardings(self._draft_cache)
+                              if self._draft_cache is not None else None)
+        else:
+            self._cache1_sh = self._state_sh = self._repl_sh = None
+            self._draft_sh = None
+
         def _prefill(params, tokens, last):
             # tokens (1, bucket) int32; last = true_len - 1 (traced)
             cache = model.encoder.init_cache(1, self.max_len,
@@ -337,7 +404,8 @@ class DecodeEngine:
                                                  last)
             return logits[0].astype(jnp.float32), cache
 
-        self._prefill_jit = jax.jit(_prefill)  # one compile per bucket
+        self._prefill_jit = jax.jit(  # one compile per bucket
+            _prefill, **self._pin(self._repl_sh, self._cache1_sh))
 
         def _write_slot(cache_full, cache_one, slot):
             return jax.tree_util.tree_map(
@@ -350,10 +418,12 @@ class DecodeEngine:
         if self.paged:
             self._scatter_prefill = jax.jit(
                 _kvp.scatter_pages,
-                donate_argnums=(0,) if self._don else ())
+                donate_argnums=(0,) if self._don else (),
+                **self._pin(self._state_sh))
             self._copy_pages_jit = jax.jit(
                 _kvp.copy_pages,
-                donate_argnums=(0,) if self._don else ())
+                donate_argnums=(0,) if self._don else (),
+                **self._pin(self._state_sh))
         # single-vector sampler: install-time first token (speculative)
         self._sample1_jit = jax.jit(
             lambda lg, t, k, p, seed, pos: _spec.sample_token(
@@ -364,6 +434,16 @@ class DecodeEngine:
         self._accept_programs: dict = {}
         self._suffix_programs: dict = {}
         self._draft_step_jit = None
+
+    def _pin(self, *out_sh):
+        """``out_shardings=`` kwarg for a jit whose outputs must land in
+        the tp layout (``{}`` when unsharded — the single-chip programs
+        are untouched). Positional order mirrors the program's outputs;
+        a single entry pins a single-output program."""
+        if self._shard is None:
+            return {}
+        return {"out_shardings": (out_sh if len(out_sh) > 1
+                                  else out_sh[0])}
 
     def _sample_fn(self, warp: bool):
         jax, jnp = self._jax, self._jnp
@@ -403,7 +483,9 @@ class DecodeEngine:
 
             prog = jax.jit(
                 jax.vmap(_one, in_axes=(None, 0, 0, 0, 0, 0, 0, 0)),
-                donate_argnums=(1, 2) if self._don else ())
+                donate_argnums=(1, 2) if self._don else (),
+                **self._pin(self._repl_sh, self._repl_sh,
+                            self._state_sh))
         else:
             pt = self.page_tokens
 
@@ -431,7 +513,9 @@ class DecodeEngine:
 
             prog = jax.jit(
                 _paged_step,
-                donate_argnums=(1, 2) if self._don else ())
+                donate_argnums=(1, 2) if self._don else (),
+                **self._pin(self._repl_sh, self._repl_sh,
+                            self._state_sh))
         self._step_programs[key] = prog
         return prog
 
@@ -452,7 +536,8 @@ class DecodeEngine:
 
         self._draft_step_jit = jax.jit(
             jax.vmap(_one, in_axes=(None, 0, 0, 0, 0, 0, 0, 0)),
-            donate_argnums=(2,) if self._don else ())
+            donate_argnums=(2,) if self._don else (),
+            **self._pin(self._repl_sh, self._repl_sh, self._draft_sh))
         return self._draft_step_jit
 
     def _get_verify(self, m: int):
@@ -476,7 +561,8 @@ class DecodeEngine:
                 return jax.vmap(_one, in_axes=(0, 0, 0))(toks, cache, pos)
 
             prog = jax.jit(_verify,
-                           donate_argnums=(2,) if self._don else ())
+                           donate_argnums=(2,) if self._don else (),
+                           **self._pin(self._repl_sh, self._state_sh))
         else:
             pt = self.page_tokens
 
@@ -504,7 +590,8 @@ class DecodeEngine:
                 return lgs, pools2
 
             prog = jax.jit(_verify,
-                           donate_argnums=(2,) if self._don else ())
+                           donate_argnums=(2,) if self._don else (),
+                           **self._pin(self._repl_sh, self._state_sh))
         self._verify_programs[m] = prog
         return prog
 
@@ -536,7 +623,8 @@ class DecodeEngine:
             pools2 = _kvp.scatter_pages(pools, cache_b, pages)
             return lg, pools2
 
-        prog = jax.jit(_suffix, donate_argnums=(5,) if self._don else ())
+        prog = jax.jit(_suffix, donate_argnums=(5,) if self._don else (),
+                       **self._pin(self._repl_sh, self._state_sh))
         self._suffix_programs[mb] = prog
         return prog
 
@@ -770,7 +858,13 @@ class DecodeEngine:
                                                  last)
                 return cache
 
-            self._draft_prefill_jit = jax.jit(_dprefill)
+            pin = {}
+            if self._shard is not None:
+                dcache1_abs = jax.eval_shape(
+                    lambda: dmodel.encoder.init_cache(1, self.max_len,
+                                                      ddtype))
+                pin = self._pin(self._shard.kv_shardings(dcache1_abs))
+            self._draft_prefill_jit = jax.jit(_dprefill, **pin)
         cache1 = self._draft_prefill_jit(
             self.draft_params, jnp.asarray(padded), jnp.int32(s - 1))
         self._draft_cache = self._write_slot(self._draft_cache, cache1,
@@ -1020,6 +1114,7 @@ class DecodeEngine:
                    "max_waiting": self.max_waiting,
                    "speculate": self.speculate,
                    "worker_up": self._worker_error is None,
+                   "tp": self._shard.n_shard if self._shard else 1,
                    "kv": {"paged": self.paged}}
             if self.paged:
                 out["kv"].update({

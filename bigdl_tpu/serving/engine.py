@@ -72,10 +72,26 @@ class InferenceEngine:
     def __init__(self, module, params, mod_state=None, *,
                  buckets: Sequence[int] = (1, 2, 4, 8, 16, 32),
                  compute_dtype=None, donate_inputs: bool = True,
-                 lint: Optional[str] = None, metrics=None):
+                 lint: Optional[str] = None, metrics=None,
+                 mesh=None, model_axis: str = "model"):
         import jax
 
         self.module = module
+        # tp placement (ISSUE 16): params committed to the mesh under
+        # the training-side Megatron layout; GSPMD partitions the
+        # bucketed forwards from there. A 1-device mesh just pins the
+        # engine to a dp replica's chip; mesh=None is the single-chip
+        # path unchanged.
+        self.mesh = mesh
+        if mesh is not None:
+            from bigdl_tpu.serving.sharding import ServingSharding
+            self._shard = ServingSharding(mesh, axis=model_axis)
+            params = self._shard.place_params(module, params)
+            if mod_state is not None:
+                mod_state = jax.device_put(mod_state,
+                                           self._shard.replicated)
+        else:
+            self._shard = None
         self.params = params
         self.mod_state = (mod_state if mod_state is not None
                           else module.init_state())
@@ -122,10 +138,20 @@ class InferenceEngine:
 
     # -------------------------------------------------------- construction
     @classmethod
-    def from_checkpoint(cls, module, path: str, **kw) -> "InferenceEngine":
+    def from_checkpoint(cls, module, path: str, mesh=None,
+                        **kw) -> "InferenceEngine":
         """Restore an inference-only view of a training checkpoint
         (params + mod_state, no optimizer state — single-blob model.<n>
-        or sharded orbax; clean SystemExit on missing/corrupt)."""
+        or sharded orbax; clean SystemExit on missing/corrupt).
+
+        With ``mesh`` (ISSUE 16) the blob loads through PR 10's
+        ``restore_resharded`` — checkpoints written under ANY training
+        topology place onto ANY serving topology, manifest-validated —
+        and the engine re-shards params to the serving tp layout."""
+        if mesh is not None:
+            from bigdl_tpu.serving.sharding import restore_for_serving
+            params, mod_state = restore_for_serving(path, mesh)
+            return cls(module, params, mod_state, mesh=mesh, **kw)
         from bigdl_tpu.utils.orbax_ckpt import restore_for_inference
         params, mod_state = restore_for_inference(path)
         return cls(module, params, mod_state, **kw)
@@ -299,6 +325,7 @@ class InferenceEngine:
                                           geom_policy_if_any)
         out = {
             "buckets": ",".join(str(b) for b in self.buckets),
+            **(self._shard.describe() if self._shard is not None else {}),
             "compute_dtype": (np.dtype(self.compute_dtype).name
                               if self.compute_dtype is not None
                               else "float32"),

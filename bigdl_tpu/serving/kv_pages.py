@@ -28,6 +28,17 @@ pages its token budget needs, recorded in a per-slot page table.
 max_len, so decode/verify graphs and the positional tables are shared
 bit-for-bit with the dense path) — `bigdl_tpu.tuning.kv_page_tokens`
 picks it, `bigdl_tpu.analysis` lints it against the flash block plan.
+
+Tensor parallel (ISSUE 16): every device helper below indexes pools only
+on the PAGE dim (axis 0) and writes whole head rows, so a pool sharded
+on its kv_heads dim (axis 1 — the layout GSPMD propagates from
+column-split wk/wv) passes through gather/scatter/copy without a
+resharding collective. ``PagedKvCache(sharding=...)`` commits the pools
+to that layout at construction and keeps the matching sharding pytree
+(``pool_shardings``) for engines to pin as ``out_shardings``; page
+tables and the :class:`PageAllocator` free list stay host-side and
+replicated — allocation is a host decision, only where the KV bytes
+live changes.
 """
 
 from __future__ import annotations
@@ -36,7 +47,8 @@ import collections
 from typing import List, Optional
 
 __all__ = ["PageAllocator", "PagedKvCache", "gather_cache",
-           "scatter_tokens", "scatter_pages", "pages_needed"]
+           "scatter_tokens", "scatter_pages", "copy_pages",
+           "pages_needed"]
 
 
 def pages_needed(tokens: int, page_tokens: int) -> int:
@@ -155,7 +167,7 @@ class PagedKvCache:
 
     def __init__(self, encoder, *, slots: int, max_len: int,
                  page_tokens: int, dtype, pool_pages: Optional[int] = None,
-                 extra_pages: int = 0):
+                 extra_pages: int = 0, sharding=None):
         import numpy as np
 
         page_tokens = int(page_tokens)
@@ -180,6 +192,16 @@ class PagedKvCache:
         self.pools = jax.tree_util.tree_map(
             lambda a: jnp.zeros((self.pool_pages,) + a.shape[1:], a.dtype),
             tmpl)
+        # tp (ISSUE 16): commit the pools to the caller's layout (a
+        # per-leaf callable, e.g. ServingSharding.kv_sharding — kv_heads
+        # dim split over the model axis) and keep the sharding pytree so
+        # the engine pins it on every pool-returning program
+        if sharding is not None:
+            self.pool_shardings = jax.tree_util.tree_map(
+                lambda a: sharding(a), self.pools)
+            self.pools = jax.device_put(self.pools, self.pool_shardings)
+        else:
+            self.pool_shardings = None
         self._bytes_per_page = sum(
             int(np.prod(a.shape[1:])) * a.dtype.itemsize
             for a in jax.tree_util.tree_leaves(self.pools))

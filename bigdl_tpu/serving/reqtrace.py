@@ -125,7 +125,8 @@ class RequestRecord:
                  "t_first_token", "t_last_token", "t_finish",
                  "prompt_tokens", "max_new", "tokens_out",
                  "rounds", "round_count", "accepted_total",
-                 "prefix_hit_tokens", "pages_held", "slot", "error")
+                 "prefix_hit_tokens", "pages_held", "slot", "replica",
+                 "error")
 
     def __init__(self, rid: str, endpoint: str, t_admit: float,
                  max_rounds: int = 64):
@@ -152,6 +153,7 @@ class RequestRecord:
         self.prefix_hit_tokens = 0
         self.pages_held: Optional[int] = None
         self.slot: Optional[int] = None
+        self.replica: Optional[int] = None
         self.error: Optional[str] = None
 
     # ------------------------------------------------- derived latencies
@@ -213,6 +215,8 @@ class RequestRecord:
              "decode_ms": self.decode_ms(),
              "ttft_ms": self.ttft_ms(), "tpot_ms": self.tpot_ms(),
              "total_ms": self.total_ms()}
+        if self.replica is not None:
+            d["replica"] = self.replica
         if self.error:
             d["error"] = self.error
         if now is not None and self.t_finish is None:
@@ -498,6 +502,14 @@ class RequestTracer:
         if rid is None:
             return None
         return self._live.get(rid)
+
+    def note_replica(self, rid: Optional[str], replica: int) -> None:
+        """dp routing decision (ISSUE 16): which engine replica serves
+        this request — stamped by the router before submit."""
+        with self._lock:
+            rec = self._rec(rid)
+            if rec is not None:
+                rec.replica = int(replica)
 
     def note_queued(self, rid: Optional[str]) -> None:
         """Request entered a queue (batcher pending / decode waiting).

@@ -79,19 +79,32 @@ class ServingApp:
     overload tier: when the predict queue or the decode waiting queue
     passes that fraction of its capacity, ``/generate`` sheds with 429
     while ``/predict`` keeps admitting. ``watchdog`` supplies the
-    readiness verdict for ``/readyz``."""
+    readiness verdict for ``/readyz``.
+
+    dp mode (ISSUE 16): pass ``replicas`` (a
+    :class:`bigdl_tpu.serving.replicas.ReplicaSet`) INSTEAD of
+    engine/batcher/decoder/watchdog — every request routes to the
+    least-loaded live replica, ``/readyz`` aggregates per-replica
+    health (200 while >= 1 lives), and shedding goes fleet-level (only
+    when every live replica is saturated)."""
 
     def __init__(self, *, name: str, metrics, engine=None, batcher=None,
                  decoder=None, request_timeout_s: float = 120.0,
                  default_deadline_ms: Optional[float] = None,
                  shed_generate_frac: float = 0.75,
-                 watchdog=None, clock=time.monotonic):
+                 watchdog=None, replicas=None, clock=time.monotonic):
+        if replicas is not None and (engine is not None
+                                     or batcher is not None
+                                     or decoder is not None):
+            raise ValueError("pass either replicas= or "
+                             "engine/batcher/decoder, not both")
         self.name = name
         self.metrics = metrics
         self.engine = engine
         self.batcher = batcher
         self.decoder = decoder
         self.watchdog = watchdog
+        self.replicas = replicas
         self.clock = clock
         self.request_timeout_s = float(request_timeout_s)
         self.default_deadline_ms = (float(default_deadline_ms)
@@ -142,6 +155,9 @@ class ServingApp:
         admitting more only makes the backlog later) — /generate sheds
         so /predict keeps breathing."""
         frac = self.shed_generate_frac
+        if self.replicas is not None:
+            if self.replicas.shed_generate(frac):
+                return True
         if (self.batcher is not None
                 and self.batcher.queue_depth
                 >= frac * self.batcher.max_queue):
@@ -163,6 +179,15 @@ class ServingApp:
         return 200, {"status": "ok", "model": self.name}
 
     def handle_readyz(self):
+        if self.replicas is not None:
+            # fleet readiness: 200 while >= 1 replica can serve (dead
+            # replicas are routed around); detail names every verdict
+            ok, detail = self.replicas.ready_detail()
+            detail["model"] = self.name
+            if self._shed_generate():
+                detail["shedding"] = "generate"
+            detail["status"] = "ready" if ok else "unready"
+            return (200 if ok else 503), detail
         detail = {"model": self.name}
         ok = True
         if self.watchdog is not None and not self.watchdog.ready():
@@ -178,8 +203,23 @@ class ServingApp:
         detail["status"] = "ready" if ok else "unready"
         return (200 if ok else 503), detail
 
+    def _route(self, endpoint: str, rid: Optional[str]):
+        """dp routing (ISSUE 16): pick the least-loaded live replica
+        (raises WorkerDied -> 503 when none live) and stamp the choice
+        into the request's lifecycle record; single-replica mode returns
+        the app's own components unchanged."""
+        if self.replicas is None:
+            return self.engine, self.batcher, self.decoder
+        rep = (self.replicas.pick_predict() if endpoint == "predict"
+               else self.replicas.pick_generate())
+        rt = _reqtrace.get()
+        if rt is not None:
+            rt.note_replica(rid, rep.index)
+        return rep.engine, rep.batcher, rep.decoder
+
     def handle_predict(self, payload: dict, rid: Optional[str] = None):
-        if self.engine is None:
+        engine, batcher, _ = self._route("predict", rid)
+        if engine is None:
             return 400, {"error": "no /predict engine for this model"}
         inputs = payload.get("inputs")
         if inputs is None:
@@ -200,15 +240,15 @@ class ServingApp:
             return 400, {"error": "inputs must be a batch (rows on "
                                   "axis 0)"}
         deadline = self._deadline_from(payload)
-        if self.batcher is not None:
-            futs = [self.batcher.submit(row, deadline=deadline, rid=rid)
+        if batcher is not None:
+            futs = [batcher.submit(row, deadline=deadline, rid=rid)
                     for row in x]
             scores = np.stack([f.result(self.request_timeout_s)
                                for f in futs])
         else:
             if deadline is not None and self.clock() >= deadline:
                 raise DeadlineExceeded("deadline expired before compute")
-            scores = self.engine.predict_scores(
+            scores = engine.predict_scores(
                 x, rids=([rid] * len(x) if rid is not None else None))
         preds = np.argmax(scores, axis=-1)
         out = {"predictions": preds.tolist()}
@@ -217,7 +257,8 @@ class ServingApp:
         return 200, out
 
     def handle_generate(self, payload: dict, rid: Optional[str] = None):
-        if self.decoder is None:
+        _, _, decoder = self._route("generate", rid)
+        if decoder is None:
             return 400, {"error": "no /generate decoder for this model "
                                   "(serve a transformer_lm* model)"}
         tokens = payload.get("tokens")
@@ -236,10 +277,10 @@ class ServingApp:
             return 400, {"error": "'top_k'/'seed' must be ints, 'top_p' "
                                   "a float"}
         try:
-            fut = self.decoder.submit(tokens, max_new, temperature, stop,
-                                      deadline=self._deadline_from(payload),
-                                      top_k=top_k, top_p=top_p, seed=seed,
-                                      rid=rid)
+            fut = decoder.submit(tokens, max_new, temperature, stop,
+                                 deadline=self._deadline_from(payload),
+                                 top_k=top_k, top_p=top_p, seed=seed,
+                                 rid=rid)
         except ValueError as e:
             return 400, {"error": str(e)}
         out_tokens = fut.result(self.request_timeout_s)
@@ -264,7 +305,10 @@ class ServingApp:
     def handle_debug_slots(self):
         """Decoder slot table + KV page-pool occupancy + batcher queue
         depth — works regardless of ``--reqTrace`` (it reads engine
-        state, not lifecycle records)."""
+        state, not lifecycle records). dp mode returns one snapshot per
+        replica."""
+        if self.replicas is not None:
+            return 200, self.replicas.debug_snapshot()
         if self.decoder is not None:
             out = self.decoder.debug_snapshot()
         else:
@@ -374,6 +418,8 @@ class ServingApp:
             self.batcher.close()
         if self.decoder is not None:
             self.decoder.close()
+        if self.replicas is not None:
+            self.replicas.close()
         rt = _reqtrace.get()
         if rt is not None:
             rt.close()  # flush the access log

@@ -1,0 +1,199 @@
+"""Tensor-parallel placement for the serving stack (ISSUE 16).
+
+Training already shards this exact model over a ``model`` mesh axis
+(``parallel/tensor_parallel.py`` — Megatron column/row pairing); serving
+reuses the SAME spec builder so a checkpoint trained under any topology
+decodes under any other. What serving adds is the KV side: the cache
+(dense slab or page pools) carries one leaf per layer shaped
+``(slots|pages, kv_heads, tokens, head_dim)``, and the natural
+tensor-parallel layout splits the **kv_heads** dim — exactly the
+sharding GSPMD propagates out of column-split wk/wv, so gather/scatter
+page ops never introduce a resharding collective.
+
+Division of labour:
+
+* :class:`ServingSharding` — one replica's mesh + the placement rules:
+  params via ``megatron_specs``, KV leaves on the head dim (replicated
+  when ``kv_heads % tp`` != 0 — correct over clever), scalars/logits
+  replicated. Engines pin these as ``out_shardings`` on every program
+  whose output feeds persistent state, so the layout is decided here
+  once instead of re-derived per compile.
+* :func:`replica_device_groups` — partitions the visible devices into N
+  disjoint K-chip groups for dp replicas (replica r owns devices
+  ``[r*K, (r+1)*K)``; deterministic, so routing and traces are
+  reproducible).
+* :func:`restore_for_serving` — checkpoint -> mesh placement using PR
+  10's ``restore_resharded`` for blob checkpoints (any training topology
+  loads into any serving topology), with the same clean-SystemExit
+  contract as ``restore_for_inference``.
+
+Host-side structures (page tables, the :class:`PageAllocator` free
+list, slot bookkeeping) are **not** sharded — the ISSUE 16 contract:
+allocation stays a host decision, only where the KV bytes live changes.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import List, Optional, Sequence
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["ServingSharding", "serving_mesh", "replica_device_groups",
+           "restore_for_serving"]
+
+
+def replica_device_groups(n_replicas: int, tp_k: int = 1,
+                          devices: Optional[Sequence] = None) -> List[list]:
+    """Split the visible devices into ``n_replicas`` disjoint groups of
+    ``tp_k`` chips each (contiguous slices of ``jax.devices()`` order —
+    on a real slice that keeps each replica's tp ring on neighbouring
+    chips). Leftover devices stay idle by design: capacity comes from
+    adding replicas, not from ragged groups."""
+    import jax
+
+    devices = list(devices if devices is not None else jax.devices())
+    need = int(n_replicas) * int(tp_k)
+    if need > len(devices):
+        raise ValueError(
+            f"{n_replicas} replicas x {tp_k}-way tp needs {need} devices, "
+            f"have {len(devices)}")
+    return [devices[r * tp_k:(r + 1) * tp_k] for r in range(n_replicas)]
+
+
+def serving_mesh(devices: Sequence, axis: str = "model"):
+    """A 1-D mesh over one replica's devices, all on the model axis
+    (serving has no data axis inside a replica — the batch dim is slots,
+    which stays replicated so host sampling sees full logits)."""
+    import numpy as np
+    from jax.sharding import Mesh
+
+    arr = np.asarray(list(devices), dtype=object).reshape(len(devices))
+    return Mesh(arr, (axis,))
+
+
+class ServingSharding:
+    """Placement rules for one tensor-parallel serving replica.
+
+    ``n_shard == 1`` (a dp replica's single chip, or no strategy) makes
+    every spec ``P()`` — placement then just pins work to the replica's
+    device(s), and the compiled programs are the single-chip ones.
+    """
+
+    def __init__(self, mesh, axis: str = "model"):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        if axis not in mesh.axis_names:
+            raise ValueError(
+                f"mesh {dict(mesh.shape)} has no {axis!r} axis")
+        self.mesh = mesh
+        self.axis = axis
+        self.n_shard = int(mesh.shape[axis])
+        self._P = P
+        self.replicated = NamedSharding(mesh, P())
+
+    # ------------------------------------------------------------- params
+    def param_specs(self, module, params):
+        """PartitionSpec pytree for ``params`` — the training-side
+        Megatron layout (column/row pairing, head-divisibility gates)
+        whenever tp > 1, fully replicated otherwise."""
+        import jax
+
+        if self.n_shard <= 1:
+            return jax.tree_util.tree_map(lambda _: self._P(), params)
+        from bigdl_tpu.parallel.tensor_parallel import megatron_specs
+        return megatron_specs(module, params, self.axis, self.n_shard)
+
+    def place_params(self, module, params):
+        """Commit ``params`` to the mesh under the Megatron layout."""
+        import jax
+        from jax.sharding import NamedSharding
+
+        specs = self.param_specs(module, params)
+        return jax.tree_util.tree_map(
+            lambda p, s: jax.device_put(p, NamedSharding(self.mesh, s)),
+            params, specs)
+
+    # ----------------------------------------------------------------- kv
+    def kv_spec(self, leaf):
+        """KV leaves are ``(slots|pages, kv_heads, tokens, head_dim)``;
+        split the head dim when tp divides it, else replicate (GQA with
+        kv_heads < tp would otherwise need head-splitting math the
+        decode graph doesn't have)."""
+        shape = tuple(getattr(leaf, "shape", ()))
+        if (self.n_shard > 1 and len(shape) == 4
+                and shape[1] % self.n_shard == 0):
+            return self._P(None, self.axis, None, None)
+        return self._P()
+
+    def kv_sharding(self, leaf):
+        from jax.sharding import NamedSharding
+        return NamedSharding(self.mesh, self.kv_spec(leaf))
+
+    def kv_shardings(self, cache):
+        """Sharding pytree for a cache/pool pytree — what the engines
+        pin as ``out_shardings`` on prefill/step/verify/scatter programs
+        so the layout never ping-pongs between compiles."""
+        import jax
+        return jax.tree_util.tree_map(self.kv_sharding, cache)
+
+    def place_kv(self, cache):
+        import jax
+        return jax.device_put(cache, self.kv_shardings(cache))
+
+    # ---------------------------------------------------------- provenance
+    def describe(self) -> dict:
+        return {"tp": self.n_shard,
+                "mesh": ",".join(f"{k}:{v}"
+                                 for k, v in dict(self.mesh.shape).items()),
+                "mesh_devices": int(self.mesh.size)}
+
+
+def restore_for_serving(path: str, mesh) -> tuple:
+    """``(params, mod_state)`` from a training checkpoint, placed
+    replicated onto ``mesh`` (the engine re-shards params to the
+    Megatron layout at construction — placement, not a data transform,
+    because blobs hold logical host arrays).
+
+    Resolution mirrors ``restore_for_inference`` (directory -> newest
+    ``model.<n>``; clean SystemExit on missing/corrupt); single-blob
+    checkpoints go through PR 10's :func:`restore_resharded` so the
+    manifest shape validation runs, orbax snapshot dirs restore to host
+    first and are then committed to the mesh."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from bigdl_tpu.utils.file import (ChecksumError, exists, isdir,
+                                      latest_checkpoint, restore_resharded)
+
+    if not exists(path):
+        raise SystemExit(f"checkpoint {path}: does not exist")
+    target = path
+    if isdir(path):
+        newest = latest_checkpoint(path, "model.")
+        if newest is not None:
+            target = newest
+    if isdir(target):
+        # orbax snapshot: restore to host, then commit replicated
+        from bigdl_tpu.utils.orbax_ckpt import restore_for_inference
+        params, mod_state = restore_for_inference(target)
+        repl = NamedSharding(mesh, P())
+        place = lambda t: jax.tree_util.tree_map(
+            lambda x: jax.device_put(x, repl), t)
+        return place(params), (place(mod_state)
+                               if mod_state is not None else None)
+    try:
+        tree = restore_resharded(target, mesh, zero1=False)
+    except SystemExit:
+        raise
+    except ChecksumError as e:
+        raise SystemExit(f"checkpoint {target}: {e}")
+    except Exception as e:
+        raise SystemExit(
+            f"checkpoint {target}: failed to load "
+            f"({type(e).__name__}: {e})")
+    if not isinstance(tree, dict) or "params" not in tree:
+        raise SystemExit(
+            f"checkpoint {target}: not a model checkpoint (no 'params' "
+            f"entry — did you point at a state.<n> optimizer blob?)")
+    return tree["params"], tree.get("mod_state")

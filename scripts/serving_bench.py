@@ -23,6 +23,16 @@ PRs 2-4 extended to serving.
     # (SLO goodput/burn/shed, access log, /debug/*, x-request-id)
     python scripts/serving_bench.py --sloSmoke --model transformer_lm \
         --platform cpu
+
+    # CI serving-tp-smoke: ISSUE 16 multi-chip assertions (tp:2
+    # bit-identity vs single chip, dp:2 replica-labelled metrics)
+    python scripts/serving_bench.py --tpSmoke --model transformer_lm \
+        --platform cpu
+
+    # dp QPS scaling sweep (the ISSUE 16 perf headline; on chips add
+    # --assertScaling 0.8)
+    python scripts/serving_bench.py --dpSweep 1,2,4 \
+        --model transformer_lm --endpoint generate
 """
 
 from __future__ import annotations
@@ -127,8 +137,19 @@ def spawn_server(args, extra):
                                                     or not args.ckpt):
         cmd += _SMOKE_LM
     cmd += extra
+    env = None
+    if "--strategy" in cmd:
+        # multi-chip strategies need devices to place replicas/shards on;
+        # on the CPU host platform that means virtual devices (the same
+        # trick tests/conftest.py uses). No-op on real accelerators.
+        env = dict(os.environ)
+        if "xla_force_host_platform_device_count" not in \
+                env.get("XLA_FLAGS", ""):
+            env["XLA_FLAGS"] = (
+                env.get("XLA_FLAGS", "")
+                + " --xla_force_host_platform_device_count=8").strip()
     proc = subprocess.Popen(cmd, cwd=REPO, stdout=subprocess.PIPE,
-                            stderr=subprocess.STDOUT, text=True)
+                            stderr=subprocess.STDOUT, text=True, env=env)
     lines, port = [], None
     port_re = re.compile(r"serving .+ on http://[^:]+:(\d+)")
     ready = threading.Event()
@@ -569,6 +590,189 @@ def run_slo_smoke(args):
     return 0
 
 
+def scrape_labelled(page, name, label="replica"):
+    """All samples of a replica-labelled gauge/counter on the exposition
+    page, keyed by label value — e.g. ``decode_worker_up{replica="1"} 1``
+    -> {"1": 1.0}. Tolerates the bigdl_serving_ namespace prefix."""
+    pat = re.compile(r'^(?:bigdl_serving_)?%s\{%s="([^"]+)"\} (\S+)$'
+                     % (re.escape(name), re.escape(label)))
+    out = {}
+    for line in page.splitlines():
+        m = pat.match(line)
+        if m:
+            try:
+                out[m.group(1)] = float(m.group(2))
+            except ValueError:
+                pass
+    return out
+
+
+def run_tp_smoke(args):
+    """ISSUE 16 multi-chip serving assertion pass (CI serving-tp-smoke):
+
+    leg 1 — tensor parallel: the same tiny LM is served single-chip and
+    --strategy tp:2 (virtual devices), both with speculative decoding,
+    paged KV, and the prefix cache ON; a fixed greedy prompt, an exact
+    repeat of it (prefix-cache page-copy hit), and a second prompt
+    sharing its prefix must all come back BIT-IDENTICAL across the two
+    topologies — sharding must never change which token argmax wins;
+
+    leg 2 — data parallel: --strategy dp:2 brings two full engine
+    stacks up behind one port; /readyz counts both live, /metrics
+    carries per-replica labelled worker gauges AND the unlabelled fleet
+    aggregates, routed requests come back replica-stamped in
+    /debug/requests, and one SIGTERM takes the whole fleet down rc=0."""
+    shared = list(range(1, 17))  # one full page at --kvPageTokens 16
+    bodies = [
+        {"tokens": shared + [21, 22], "max_new_tokens": 12},
+        {"tokens": shared + [21, 22], "max_new_tokens": 12},  # prefix hit
+        {"tokens": shared + [33, 34, 35], "max_new_tokens": 12},
+    ]
+    tp_extra = ["--kvPageTokens", "16", "--prefixCache",
+                "--speculate", "3"]
+    results = {}
+    for strat in (None, "tp:2"):
+        extra = list(args.serveArg) + tp_extra
+        if strat:
+            extra += ["--strategy", strat]
+        proc, url, log_lines = spawn_server(args, extra)
+        try:
+            outs = []
+            for body in bodies:
+                st, out = _post(url + "/generate", body)
+                assert st == 200, f"{strat or 'single'} /generate -> {st}"
+                outs.append(out["tokens"])
+            prov, page = scrape_provenance(url)
+            results[strat] = (outs, prov)
+        finally:
+            _shutdown_clean(proc, log_lines)
+    single, tp = results[None][0], results["tp:2"][0]
+    for i, (a, b) in enumerate(zip(single, tp)):
+        assert a == b, (f"tp:2 output diverged from single-chip on "
+                        f"prompt {i}:\n  single {a}\n  tp:2   {b}")
+    prov = results["tp:2"][1]
+    assert prov.get("strategy") == "tp:2", prov
+    assert prov.get("serving_tp") == 2, prov
+    assert prov.get("serving_replicas") == 1, prov
+    assert prov.get("n_devices", 0) >= 2, prov
+    print(f"tp-smoke: tp:2 bit-identical to single-chip on "
+          f"{len(bodies)} prompts (spec+paged+prefix-cache on) OK",
+          flush=True)
+
+    # ---- leg 2: dp:2 — fleet readiness, labelled + aggregate metrics
+    proc, url, log_lines = spawn_server(
+        args, list(args.serveArg)
+        + ["--strategy", "dp:2", "--reqTrace", "on"])
+    try:
+        st, txt = _get(url + "/readyz")
+        assert st == 200, f"/readyz -> {st}"
+        ready = json.loads(txt)
+        assert ready.get("replicas") == 2, ready
+        assert ready.get("replicas_live") == 2, ready
+        errs = [0]
+
+        def _fire():
+            st, _ = _post_status(url + "/generate",
+                                 {"tokens": [1, 2, 3, 4, 5],
+                                  "max_new_tokens": 6}, timeout=120)
+            if st != 200:
+                errs[0] += 1
+        threads = [threading.Thread(target=_fire) for _ in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert errs[0] == 0, f"{errs[0]}/6 dp:2 generates failed"
+        prov, page = scrape_provenance(url)
+        assert prov.get("strategy") == "dp:2", prov
+        assert prov.get("serving_replicas") == 2, prov
+        up = scrape_labelled(page, "decode_worker_up")
+        assert up.get("0") == 1.0 and up.get("1") == 1.0, \
+            f"per-replica decode_worker_up gauges missing/down: {up}"
+        assert scrape_value(page, "replicas") == 2, "no fleet gauge"
+        assert scrape_value(page, "replicas_live") == 2, page[:200]
+        for agg in ("kv_cache_bytes", "kv_pages_in_use",
+                    "fleet_generated_tokens_total"):
+            assert scrape_value(page, agg) is not None, \
+                f"aggregate {agg} gauge missing"
+        per_rep_tokens = scrape_labelled(page, "generated_tokens_total")
+        assert sum(per_rep_tokens.values()) >= 6, per_rep_tokens
+        st, txt = _get(url + "/debug/requests")
+        assert st == 200, st
+        recent = json.loads(txt).get("recent", [])
+        stamped = [r for r in recent if "replica" in r]
+        assert stamped, f"no replica-stamped records: {recent}"
+        assert all(r["replica"] in (0, 1) for r in stamped), stamped
+        print(f"tp-smoke: dp:2 fleet live, labelled+aggregate metrics, "
+              f"{len(stamped)} replica-stamped records OK", flush=True)
+    finally:
+        _shutdown_clean(proc, log_lines)
+    record = {"bench": "serving_tp_smoke", "bit_identical": True,
+              "tp": 2, "dp_replicas": 2, "prompts": len(bodies)}
+    print(json.dumps(record), flush=True)
+    print("tp-smoke: all ISSUE 16 multi-chip assertions OK", flush=True)
+    return 0
+
+
+def run_dp_sweep(args):
+    """dp QPS scaling sweep (the ISSUE 16 perf headline): run the same
+    closed-loop /generate load against ``--strategy dp:N`` for each N
+    in --dpSweep and report aggregate client-side QPS against the
+    linear ideal (N x the per-replica rate of the first point). Each
+    record carries the server's provenance and the per-replica
+    generated-token split so the routing spread is visible.
+
+    --assertScaling F turns the floor into a hard assertion
+    (aggregate QPS >= F x linear at every N). Use that on real chips;
+    virtual CPU devices share the same host cores, so CPU CI reports
+    the curve without asserting it."""
+    counts = [int(x) for x in args.dpSweep.split(",") if x]
+    assert counts, "--dpSweep needs at least one replica count"
+    args.endpoint = "generate"
+    base_conc = args.concurrency
+    records = []
+    for n in counts:
+        extra = list(args.serveArg) + ["--strategy", f"dp:{n}"]
+        proc, url, log_lines = spawn_server(args, extra)
+        # keep every replica busy: concurrency scales with the fleet
+        args.concurrency = max(base_conc, 4 * n)
+        try:
+            res = closed_loop(url, args)
+            assert res["errors"] == 0, f"dp:{n} bench errors: {res}"
+            prov, page = scrape_provenance(url)
+            assert prov.get("serving_replicas") == n, prov
+            rec = {"bench": "serving_dp_sweep", "replicas": n,
+                   "qps": res["rps"],
+                   "tokens_per_second": res["tokens_per_second"],
+                   "concurrency": args.concurrency,
+                   "requests": args.requests,
+                   "latency_ms": res["latency_ms"],
+                   "per_replica_tokens": scrape_labelled(
+                       page, "generated_tokens_total"),
+                   "provenance": prov}
+        finally:
+            args.concurrency = base_conc
+            _shutdown_clean(proc, log_lines)
+        records.append(rec)
+        print(json.dumps(rec), flush=True)
+    per_replica0 = records[0]["qps"] / records[0]["replicas"]
+    summary = {"bench": "serving_dp_sweep_summary",
+               "counts": counts,
+               "qps": [r["qps"] for r in records],
+               "scaling_vs_linear": [
+                   round(r["qps"] / (per_replica0 * r["replicas"]), 3)
+                   for r in records]}
+    print(json.dumps(summary), flush=True)
+    if args.assertScaling is not None:
+        floor = args.assertScaling
+        for n, frac in zip(counts, summary["scaling_vs_linear"]):
+            assert frac >= floor, \
+                (f"dp:{n} aggregate QPS is {frac:.2f}x linear, below "
+                 f"the {floor}x floor")
+        print(f"dp-sweep: all points >= {floor}x linear OK", flush=True)
+    return 0
+
+
 def _shutdown_clean(proc, log_lines):
     proc.send_signal(signal.SIGTERM)
     try:
@@ -685,6 +889,28 @@ def main(argv=None):
                         "deadline-expiry 504, worker-kill fast 503 + "
                         "watchdog readiness flip (spawns its own "
                         "servers)")
+    p.add_argument("--tpSmoke", action="store_true",
+                   help="multi-chip serving assertion pass (ISSUE 16): "
+                        "--strategy tp:2 /generate bit-identical to "
+                        "single-chip (speculate + paged KV + prefix "
+                        "cache on), dp:2 fleet readiness + per-replica "
+                        "labelled metrics + aggregates + replica-"
+                        "stamped traces (spawns its own servers on "
+                        "virtual devices)")
+    p.add_argument("--dpSweep", default=None, metavar="N,N,...",
+                   help="QPS scaling sweep over --strategy dp:N replica"
+                        " counts, e.g. 1,2,4 (ISSUE 16 perf headline); "
+                        "emits one record per point + a summary with "
+                        "scaling_vs_linear")
+    p.add_argument("--assertScaling", type=float, default=None,
+                   metavar="FRAC",
+                   help="with --dpSweep: assert aggregate QPS >= FRAC x"
+                        " linear at every point (use on real chips; "
+                        "CPU replicas share host cores)")
+    p.add_argument("--strategy", default=None, metavar="SPEC",
+                   help="forwarded to the spawned serve CLI: tp[:K], "
+                        "dp[:N], or dp:N+tp:K (ISSUE 16); spawns with "
+                        "virtual devices on the CPU platform")
     p.add_argument("--serveArg", action="append", default=[],
                    metavar="ARG",
                    help="extra flag forwarded to the spawned serve CLI "
@@ -699,12 +925,18 @@ def main(argv=None):
         return run_spec_smoke(args)
     if args.sloSmoke:
         return run_slo_smoke(args)
+    if args.tpSmoke:
+        return run_tp_smoke(args)
+    if args.dpSweep:
+        return run_dp_sweep(args)
 
     proc = None
     if args.url:
         url = args.url.rstrip("/")
     else:
         extra = list(args.serveArg)
+        if args.strategy:
+            extra += ["--strategy", args.strategy]
         # --smoke also asserts server-vs-client TTFT/TPOT agreement
         # (ISSUE 15 satellite), which needs the lifecycle tracer on the
         # spawned server; an explicit --serveArg=--reqTrace wins

@@ -1,0 +1,80 @@
+#!/usr/bin/env bash
+# Round-20 capture: ISSUE 16 (multi-chip serving) chip evidence.
+# The correctness contracts are CPU-verified on virtual devices
+# (tests/test_serving_tp.py, the tier1 serving-tp-smoke job) — what
+# only hardware can tell us is the WIN: (a) tp A/B — single-chip vs
+# --strategy tp:K per-token latency on one stream (tp spends chips on
+# latency: the row-split psum must cost less than the per-chip matmul
+# time it saves); (b) the dp sweep — aggregate QPS over dp:1,2,4 with
+# the ≥0.8x-linear acceptance floor ASSERTED (replicas share nothing
+# on real chips, so the floor is enforceable here and only here);
+# (c) the composed dp+tp leg. Appends to $OUT, mirrored into the repo
+# per step.
+
+set -uo pipefail
+cd "$(dirname "$0")/.."
+OUT="${OUT:-/tmp/tpu_capture_r20.log}"
+REPO_LOG="${REPO_LOG:-TPU_CAPTURE_r20.log}"
+trap 'cp -f "$OUT" "$REPO_LOG" 2>/dev/null || true' EXIT
+
+step() {
+  local name="$1" tmo="$2"; shift 2
+  echo "=== $name ($(date -u +%H:%M:%SZ))" | tee -a "$OUT"
+  timeout "$tmo" "$@" 2>&1 | tail -40 | tee -a "$OUT"
+  echo "=== end $name rc=$?" | tee -a "$OUT"
+  cp -f "$OUT" "$REPO_LOG" 2>/dev/null || true
+}
+
+# identical serving geometry + workload to tpu_capture_r18/r19.sh so
+# the r20 topology numbers read directly against those slots
+LM="--serveArg=--vocabSize --serveArg=32000 \
+    --serveArg=--dModel --serveArg=1024 \
+    --serveArg=--numLayers --serveArg=8 \
+    --serveArg=--numHeads --serveArg=16 \
+    --serveArg=--seq --serveArg=1024 \
+    --serveArg=--slots --serveArg=8"
+GEN="--model transformer_lm --endpoint generate \
+     --requests 32 --promptLen 128 --maxNewTokens 128"
+TPK="${TPK:-4}"   # tp width for the A/B; set to the slice's chip count
+
+# 0. the multi-chip test file + the full assertion pass on this env
+step "pytest_serving_tp" 900 env JAX_PLATFORMS=cpu python -m pytest \
+  tests/test_serving_tp.py -q
+step "tp_smoke" 900 python scripts/serving_bench.py \
+  --tpSmoke --model transformer_lm
+
+# 1. tp A/B x3 — one stream (c1), per-token latency. The tp:K legs'
+#    JSON lines carry the strategy provenance; acceptance for PERF.md
+#    §23 is tokens_per_second up (or p50 down) vs single-chip on the
+#    SAME workload, with greedy output already bit-identity-checked by
+#    the smoke above.
+for REP in 1 2 3; do
+  # shellcheck disable=SC2086
+  step "tp_single_rep${REP}" 1800 python scripts/serving_bench.py \
+    $GEN $LM --concurrency 1 || true
+  # shellcheck disable=SC2086
+  step "tp_tp${TPK}_rep${REP}" 1800 python scripts/serving_bench.py \
+    $GEN $LM --concurrency 1 --strategy "tp:${TPK}" || true
+done
+
+# 2. THE r20 leg — dp aggregate-QPS sweep with the acceptance floor
+#    asserted: dp:N must land ≥0.8x linear in N (concurrency scales
+#    4xN inside the sweep so every replica stays fed). Per-replica
+#    generated-token splits ride each record — the routing spread is
+#    part of the evidence.
+# shellcheck disable=SC2086
+step "dp_sweep" 3600 python scripts/serving_bench.py $GEN $LM \
+  --dpSweep 1,2,4 --assertScaling 0.8 || true
+
+# 3. composed dp:2+tp:2 (4 chips): replicated tensor-parallel engines
+#    behind one port — the full --smoke pass through the serving tp
+#    lint gate, then a measured leg for the §23 composed slot.
+step "dp_tp_smoke" 1800 python scripts/serving_bench.py \
+  --smoke --model transformer_lm --strategy dp:2+tp:2 \
+  --serveArg=--lint --serveArg=on || true
+# shellcheck disable=SC2086
+step "dp_tp_bench" 1800 python scripts/serving_bench.py $GEN $LM \
+  --concurrency 8 --strategy dp:2+tp:2 || true
+
+# 4. summarize every JSON line in this log for PERF.md §23
+step "summarize" 300 python scripts/update_perf_from_capture.py "$OUT"

@@ -1,0 +1,415 @@
+"""Multi-chip serving tests (ISSUE 16), all on the suite's 8 virtual
+CPU devices: tensor-parallel decode bit-identity vs single-device
+(greedy, speculative, paged + prefix-cache, and sampled paths — the
+acceptance contract), sharded page-pool gather/scatter roundtrip,
+strategy-spec parsing, deterministic dp replica routing, fleet-level
+/readyz with a dead replica, tp checkpoint restore through
+``InferenceEngine.from_checkpoint(mesh=...)``, the
+``serving-unsharded-matmul`` lint rule, and an end-to-end dp:2 HTTP
+smoke with per-replica labelled metrics."""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import jax
+import numpy as np
+import pytest
+
+from bigdl_tpu import models
+from bigdl_tpu.serving import (DecodeEngine, InferenceEngine,
+                               MetricsRegistry, Replica, ReplicaSet,
+                               ServingSharding, WorkerDied,
+                               replica_device_groups, serving_mesh)
+
+
+@pytest.fixture(scope="module")
+def tiny_lm():
+    m = models.transformer_lm(50, d_model=32, num_layers=2, num_heads=2,
+                              max_len=64)
+    return m, m.init(jax.random.PRNGKey(1))
+
+
+def _offline_greedy(model, params, prompt, n):
+    seq = [int(t) for t in prompt]
+    out = []
+    for _ in range(n):
+        logp, _ = model.apply(params, model.init_state(),
+                              np.asarray([seq], np.int32))
+        tok = int(np.argmax(np.asarray(logp)[0, -1]))
+        out.append(tok)
+        seq.append(tok)
+    return out
+
+
+# ------------------------------------------------------ strategy parsing
+def test_parse_serving_strategy():
+    from bigdl_tpu.cli.common import parse_serving_strategy as p
+    assert p("tp", 8) == (1, 8)
+    assert p("tp:2", 8) == (1, 2)
+    assert p("dp", 8) == (8, 1)
+    assert p("dp:4", 8) == (4, 1)
+    assert p("dp:2+tp:4", 8) == (2, 4)
+    assert p("tp:4+dp:2", 8) == (2, 4)
+    assert p("dp+tp:2", 8) == (4, 2)  # dp takes the remainder
+    assert p("dp:2+tp", 8) == (2, 4)  # tp takes the remainder
+    for bad in ("pp:2", "tp:0", "tp:x", "tp:2+tp:2", "dp:4+tp:4"):
+        with pytest.raises(SystemExit):
+            p(bad, 8)
+
+
+def test_replica_device_groups_disjoint():
+    groups = replica_device_groups(2, 2)
+    assert [len(g) for g in groups] == [2, 2]
+    flat = [d for g in groups for d in g]
+    assert len(set(flat)) == 4  # disjoint
+    assert flat == jax.devices()[:4]  # contiguous, deterministic
+    with pytest.raises(ValueError, match="needs 16 devices"):
+        replica_device_groups(8, 2)
+
+
+# ----------------------------------------------------- tp sharding rules
+def test_serving_sharding_specs(tiny_lm):
+    model, params = tiny_lm
+    sh = ServingSharding(serving_mesh(jax.devices()[:2]))
+    assert sh.n_shard == 2
+    placed = sh.place_params(model, params)
+    # at least one big weight actually sharded over the model axis
+    shardings = [l.sharding for l in jax.tree_util.tree_leaves(placed)]
+    assert any(not s.is_fully_replicated for s in shardings)
+    # KV leaves: head dim (axis 1) split when divisible, else replicated
+    from jax.sharding import PartitionSpec as P
+    cache = model.encoder.init_cache(4, 64, None)
+    leaf = jax.tree_util.tree_leaves(cache)[0]
+    assert sh.kv_spec(leaf) == P(None, "model", None, None)
+    odd = np.zeros((4, 3, 64, 16), np.float32)  # 3 heads % 2 != 0
+    assert sh.kv_spec(odd) == P()
+
+
+def test_sharded_page_pool_roundtrip(tiny_lm):
+    """gather/scatter/copy on kv_heads-sharded pools match the
+    unsharded pools bit-for-bit — the device helpers index only the
+    page dim, so the sharding passes through."""
+    from bigdl_tpu.serving.kv_pages import (PagedKvCache, copy_pages,
+                                            gather_cache, scatter_pages)
+    model, params = tiny_lm
+    sh = ServingSharding(serving_mesh(jax.devices()[:2]))
+    kvs = [PagedKvCache(model.encoder, slots=2, max_len=64,
+                        page_tokens=16, dtype=None, sharding=s)
+           for s in (None, sh.kv_sharding)]
+    assert kvs[0].pool_shardings is None
+    assert kvs[1].pool_shardings is not None
+    rng = np.random.RandomState(0)
+    cache = jax.tree_util.tree_map(
+        lambda a: rng.randn(1, *a.shape[1:-2], 64,
+                            a.shape[-1]).astype(np.float32),
+        model.encoder.init_cache(1, 64, None))
+    outs = []
+    for kv in kvs:
+        assert kv.reserve(0, 64)
+        pages = np.asarray(kv.page_table[0], np.int32)
+        pools = scatter_pages(kv.pools, cache, pages)
+        pools = copy_pages(pools, pages[:2], pages[2:4])
+        got = gather_cache(pools, pages)
+        outs.append([np.asarray(l)
+                     for l in jax.tree_util.tree_leaves(got)])
+    for a, b in zip(*outs):
+        assert np.array_equal(a, b)
+
+
+# --------------------------------------------------- tp decode identity
+def _decode_tokens(model, params, prompts, mesh=None, **kw):
+    eng = DecodeEngine(model, params, slots=2, mesh=mesh, **kw)
+    try:
+        return [eng.generate(p, 8, *a) for p, a in prompts]
+    finally:
+        eng.close()
+
+
+def test_tp_greedy_bit_identical(tiny_lm):
+    model, params = tiny_lm
+    prompts = [([3, 1, 4, 1, 5], ()), ([9, 2, 6], ())]
+    ref = _decode_tokens(model, params, prompts)
+    assert ref[0] == _offline_greedy(model, params, [3, 1, 4, 1, 5], 8)
+    for k in (2, 4):
+        mesh = serving_mesh(jax.devices()[:k])
+        assert _decode_tokens(model, params, prompts, mesh=mesh) == ref
+
+
+def test_tp_spec_paged_prefix_bit_identical(tiny_lm):
+    """The hard path: paged KV + speculative verify + prefix-cache hit,
+    tp:2 vs single-device — bit-identical including the page copies."""
+    model, params = tiny_lm
+    kw = dict(kv_page_tokens=16, speculate=3, prefix_cache=True)
+    shared = [3, 1, 4, 1, 5, 9, 2, 6, 5, 3, 5, 8, 9, 7, 9, 3]  # 1 page
+    prompts = [(shared + [2, 3], ()),
+               (shared + [7, 1], ()),  # prefix-cache hit
+               ([8, 6, 7], (1.5, None, 5, 0.9, 11))]  # sampled, seeded
+    ref = _decode_tokens(model, params, prompts, **kw)
+    mesh = serving_mesh(jax.devices()[:2])
+    assert _decode_tokens(model, params, prompts, mesh=mesh, **kw) == ref
+
+
+def test_tp_from_checkpoint_restore(tmp_path, tiny_lm):
+    """Satellite: from_checkpoint(mesh=...) restores a training blob
+    through restore_resharded and serves tp-sharded, matching the
+    host-restored engine's scores exactly."""
+    from bigdl_tpu.utils.file import save_pytree
+    model, params = tiny_lm
+    ck = tmp_path / "ckpt"
+    ck.mkdir()
+    save_pytree({"params": params, "mod_state": model.init_state(),
+                 "driver": {"epoch": 1, "iteration": 7}},
+                str(ck / "model.7"))
+    mesh = serving_mesh(jax.devices()[:2])
+    eng = InferenceEngine.from_checkpoint(model, str(ck), mesh=mesh,
+                                          buckets=(2,))
+    ref = InferenceEngine.from_checkpoint(model, str(ck), buckets=(2,))
+    # params actually landed tp-sharded
+    assert any(not l.sharding.is_fully_replicated
+               for l in jax.tree_util.tree_leaves(eng.params))
+    x = np.asarray([[3, 1, 4, 1], [9, 2, 6, 5]], np.int32)
+    got, want = eng.predict_scores(x), ref.predict_scores(x)
+    # row-split matmuls reorder the reduction: logits agree to float
+    # tolerance, the served TOKENS (argmax) exactly
+    assert np.allclose(got, want, rtol=1e-5, atol=1e-6)
+    assert np.array_equal(np.argmax(got, -1), np.argmax(want, -1))
+    with pytest.raises(SystemExit, match="does not exist"):
+        InferenceEngine.from_checkpoint(model, str(tmp_path / "no"),
+                                        mesh=mesh)
+
+
+# ------------------------------------------------------- tp lint rule
+def test_serving_unsharded_matmul_rule():
+    from bigdl_tpu.analysis import run_serving_tp_rules
+    sh = ServingSharding(serving_mesh(jax.devices()[:2]))
+    # 3 heads: the mha divisibility gate replicates the >=1 MiB
+    # attention weights (768x768 f32 = 2.25 MiB) under tp:2 -> fire
+    bad = models.transformer_lm(512, d_model=768, num_layers=1,
+                                num_heads=3, max_len=32)
+    placed = sh.place_params(bad, bad.init(jax.random.PRNGKey(0)))
+    rep = run_serving_tp_rules(placed, 2)
+    hits = [f for f in rep.findings
+            if f.rule == "serving-unsharded-matmul"]
+    assert hits and all(f.severity == "error" for f in hits)
+    assert any("mha" in f.where for f in hits)
+    # divisible heads: everything big shards, the rule stays quiet
+    ok = models.transformer_lm(512, d_model=768, num_layers=1,
+                               num_heads=4, max_len=32)
+    placed = sh.place_params(ok, ok.init(jax.random.PRNGKey(0)))
+    assert not [f for f in run_serving_tp_rules(placed, 2).findings
+                if f.rule == "serving-unsharded-matmul"]
+    # tp=1 is not a tp strategy: no findings at all
+    assert not run_serving_tp_rules(placed, 1).findings
+
+
+# ------------------------------------------------------------ dp routing
+class _FakeBatcher:
+    def __init__(self, depth=0, up=True, max_queue=8):
+        self.queue_depth = depth
+        self.max_queue = max_queue
+        self.up = up
+
+    def alive(self):
+        return self.up
+
+    def close(self):
+        pass
+
+
+class _FakeDecoder:
+    _m_tokens = None
+
+    def __init__(self, load=0, waiting=0, up=True, max_waiting=8,
+                 kv=100, pages=3):
+        self.load = load
+        self._waiting = [None] * waiting
+        self.max_waiting = max_waiting
+        self.up = up
+        self._kv, self._pages = kv, pages
+
+    def queue_load(self):
+        return self.load
+
+    def alive(self):
+        return self.up
+
+    def kv_bytes(self):
+        return self._kv
+
+    def kv_pages_in_use(self):
+        return self._pages
+
+    def debug_snapshot(self):
+        return {"slots": [], "waiting": len(self._waiting)}
+
+    def close(self):
+        pass
+
+
+def _fake_set(n=3, metrics=None):
+    reps = [Replica(i, batcher=_FakeBatcher(), decoder=_FakeDecoder())
+            for i in range(n)]
+    return ReplicaSet(reps, metrics=metrics), reps
+
+
+def test_replica_routing_deterministic():
+    rs, reps = _fake_set()
+    # all idle: lowest index wins the tie, every time
+    assert [rs.pick_generate().index for _ in range(3)] == [0, 0, 0]
+    assert rs.pick_predict().index == 0
+    # least-load wins
+    reps[0].decoder.load = 5
+    reps[1].decoder.load = 2
+    reps[2].decoder.load = 5
+    assert rs.pick_generate().index == 1
+    reps[0].batcher.queue_depth = 4
+    assert rs.pick_predict().index == 1
+    # dead replicas are skipped even at the least load
+    reps[1].decoder.up = False
+    assert rs.pick_generate().index == 0  # 0 and 2 tie at 5 -> lowest
+    reps[0].decoder.load = 7
+    assert rs.pick_generate().index == 2
+    # all dead -> WorkerDied (the 503 contract)
+    for r in reps:
+        r.decoder.up = False
+    with pytest.raises(WorkerDied, match="all engine replicas"):
+        rs.pick_generate()
+
+
+def test_replica_fleet_readyz_and_shed():
+    rs, reps = _fake_set()
+    ok, detail = rs.ready_detail()
+    assert ok and detail["replicas_live"] == 3
+    reps[1].batcher.up = False  # one dead replica: fleet stays ready
+    ok, detail = rs.ready_detail()
+    assert ok
+    assert detail["replicas_live"] == 2
+    assert detail["replicas_dead"] == [1]
+    assert detail["replica_states"][1]["dead"] == ["batcher"]
+    # shed only when EVERY live replica is saturated
+    reps[0].decoder._waiting = [None] * 8
+    assert not rs.shed_generate(0.75)  # replica 2 still has room
+    reps[2].batcher.queue_depth = 8
+    assert rs.shed_generate(0.75)
+    # dead fleet: routing 503s, shedding stays out of the way
+    reps[0].batcher.up = reps[2].batcher.up = False
+    ok, _ = rs.ready_detail()
+    assert not ok
+    assert not rs.shed_generate(0.75)
+
+
+def test_replica_aggregate_gauges():
+    reg = MetricsRegistry()
+    rs, reps = _fake_set(2, metrics=reg)
+    assert reg._metrics["replicas"].value == 2
+    assert reg._metrics["replicas_live"].value == 2
+    assert reg._metrics["kv_cache_bytes"].value == 200
+    assert reg._metrics["kv_pages_in_use"].value == 6
+    reps[0].decoder.up = False
+    assert reg._metrics["replicas_live"].value == 1
+
+
+def test_labelled_metrics_render():
+    reg = MetricsRegistry()
+    v0 = reg.labelled(replica="0")
+    v1 = reg.labelled(replica="1")
+    v0.counter("generated_tokens_total", "t").inc(3)
+    v1.counter("generated_tokens_total", "t").inc(4)
+    reg.gauge("kv_cache_bytes", "agg", fn=lambda: 7)
+    page = reg.render()
+    ns = reg.namespace
+    assert f'{ns}_generated_tokens_total{{replica="0"}} 3' in page
+    assert f'{ns}_generated_tokens_total{{replica="1"}} 4' in page
+    assert f"{ns}_kv_cache_bytes 7" in page
+    # HELP/TYPE emitted once per name, not per labelled series
+    assert page.count("# TYPE " + ns + "_generated_tokens_total") == 1
+
+
+# ------------------------------------------------- dp HTTP end-to-end
+def _post(port, path, body, timeout=60):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}", data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _get(port, path, timeout=30):
+    try:
+        with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}",
+                                    timeout=timeout) as r:
+            return r.status, r.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+def test_serve_dp2_http_end_to_end(tiny_lm):
+    """dp:2 behind one front door: /generate matches the offline
+    oracle, /metrics carries replica-labelled series plus fleet
+    aggregates, /readyz reports both replicas, and killing one replica
+    keeps the fleet ready (200) while killing both flips it 503."""
+    from bigdl_tpu.cli import common, serve as serve_cli
+    from bigdl_tpu.serving import make_server
+
+    model, params = tiny_lm
+    args = serve_cli.build_parser().parse_args(
+        ["transformer_lm", "--randomInit", "--vocabSize", "50",
+         "--dModel", "32", "--numLayers", "2", "--numHeads", "2",
+         "--seq", "64", "--slots", "2", "--buckets", "1,2",
+         "--maxWaitMs", "2", "--strategy", "dp:2", "--reqTrace", "on"])
+    common.apply_platform(args)
+    app, eng, in_shape, in_dtype = serve_cli.build_app(args)
+    # same init seed as build_app's --randomInit path
+    oracle_params = model.init(jax.random.PRNGKey(0))
+    srv = make_server(app, "127.0.0.1", 0)
+    port = srv.server_address[1]
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        prompt = [3, 1, 4, 1, 5]
+        st, out = _post(port, "/generate",
+                        {"tokens": prompt, "max_new_tokens": 6})
+        assert st == 200
+        assert out["tokens"] == _offline_greedy(model, oracle_params,
+                                                prompt, 6)
+        st, body = _get(port, "/readyz")
+        ready = json.loads(body)
+        assert st == 200 and ready["replicas_live"] == 2
+        st, page = _get(port, "/metrics")
+        ns = app.metrics.namespace
+        assert f'{ns}_decode_worker_up{{replica="0"}} 1' in page
+        assert f'{ns}_decode_worker_up{{replica="1"}} 1' in page
+        assert f"{ns}_replicas 2" in page
+        assert "strategy=\"dp:2\"" in page
+        assert "serving_replicas=\"2\"" in page
+        # routed request stamped its serving replica into the trace
+        st, body = _get(port, "/debug/requests")
+        recent = json.loads(body)["recent"]
+        assert any(r.get("replica") in (0, 1) for r in recent)
+        # one replica dead: routed around, fleet stays ready
+        app.replicas.replicas[0].decoder.declare_dead(
+            RuntimeError("drill: replica 0 decode loop declared dead"))
+        st, body = _get(port, "/readyz")
+        assert st == 200
+        ready = json.loads(body)
+        assert ready["replicas_live"] == 1
+        assert ready["replicas_dead"] == [0]
+        st, out = _post(port, "/generate",
+                        {"tokens": prompt, "max_new_tokens": 4})
+        assert st == 200  # replica 1 served it
+        # both dead: fleet unready, generate 503s fast
+        app.replicas.replicas[1].decoder.declare_dead(
+            RuntimeError("drill: replica 1 decode loop declared dead"))
+        st, body = _get(port, "/readyz")
+        assert st == 503
+        st, out = _post(port, "/generate",
+                        {"tokens": prompt, "max_new_tokens": 4})
+        assert st == 503
+    finally:
+        srv.shutdown()
+        srv.server_close()
+        app.close()
