@@ -167,6 +167,13 @@ CATALOG: Dict[str, Tuple[str, str, str]] = {
         "every pool page pads its tile, and when neither the flash "
         "block_k nor the page divides the other, K blocks straddle "
         "page boundaries in the gathered view (kv_page_plan)"),
+    "quant-dequant-upcast": (
+        "dtype", "error",
+        "a dequantized int8/fp8 weight is re-materialized as f32 "
+        "feeding a matmul whose other operand was upcast from bf16 — "
+        "the dequant epilogue defeats the 8-bit storage AND drags the "
+        "activation to f32; dequantize into the activation dtype "
+        "instead (quant._QView does)"),
     "serving-unsharded-matmul": (
         "serving", "error",
         "tp-strategy serving graph carries a >=1 MiB matmul weight with "
@@ -512,6 +519,78 @@ def _rule_host_sync(levels, report: Report) -> None:
                          "under a debug flag)"))
 
 
+# prims a dequant chain routes through between the convert and the
+# matmul: the scale multiply/add, layout moves, and the converts
+# themselves — anything else breaks the chain (it's no longer "the
+# dequantized weight", it's a computed tensor)
+_DEQUANT_PASSTHRU = ("convert_element_type", "mul", "add", "transpose",
+                     "reshape", "broadcast_in_dim")
+_QUANT_SRC_DTYPES = ("int8", "float8_e4m3fn", "float8_e5m2")
+
+
+def _convert_sources(var, produced_by, max_depth: int = 8) -> set:
+    """Source dtype names of every convert_element_type on ``var``'s
+    producer chain, walking back through :data:`_DEQUANT_PASSTHRU`
+    prims only (bounded depth — dequant epilogues are shallow)."""
+    out: set = set()
+    stack = [(var, 0)]
+    seen: set = set()
+    while stack:
+        v, d = stack.pop()
+        if d > max_depth or id(v) in seen:
+            continue
+        seen.add(id(v))
+        eqn = produced_by.get(id(v))
+        if eqn is None or eqn.primitive.name not in _DEQUANT_PASSTHRU:
+            continue
+        if eqn.primitive.name == "convert_element_type":
+            out.add(_dtype_name(eqn.invars[0].aval))
+        for iv in eqn.invars:
+            if getattr(iv, "count", None) is not None:  # Var, not Literal
+                stack.append((iv, d + 1))
+    return out
+
+
+def _rule_quant_dequant_upcast(levels, report: Report) -> None:
+    """ISSUE 17: a dot_general where one operand traces back to an
+    int8/fp8 -> wide convert (the dequant) AND the other to a bf16 ->
+    f32 convert means the epilogue was folded in f32 — the matmul runs
+    at 2x the activation width for no accuracy reason. The quant module
+    dequantizes into the ACTIVATION dtype, which never hits this."""
+    hits = []
+    for lv in levels:
+        produced_by = {}
+        for eqn in lv.jaxpr.eqns:
+            for ov in eqn.outvars:
+                produced_by[id(ov)] = eqn
+        for i, eqn in enumerate(lv.jaxpr.eqns):
+            if eqn.primitive.name != "dot_general":
+                continue
+            if len(eqn.invars) < 2:
+                continue
+            srcs = [_convert_sources(v, produced_by)
+                    for v in eqn.invars[:2]]
+            for a, b in ((0, 1), (1, 0)):
+                if (any(s in _QUANT_SRC_DTYPES for s in srcs[a])
+                        and "bfloat16" in srcs[b]
+                        and _dtype_name(eqn.invars[b].aval)
+                        == "float32"):
+                    hits.append(lv.where(i, eqn))
+                    break
+    if hits:
+        report.add(_finding(
+            "quant-dequant-upcast",
+            f"{len(hits)} matmul(s) pair a f32-rematerialized "
+            "dequantized weight with a bf16-upcast activation — the "
+            "contraction runs at f32 width, defeating both the 8-bit "
+            "storage and the bf16 compute dtype",
+            where="; ".join(hits[:4]) + ("…" if len(hits) > 4 else ""),
+            hint="dequantize into the activation dtype "
+                 "(w.astype(x.dtype), the serving/quant.py epilogue) "
+                 "or take the native int8 dot_general path",
+            detail={"count": len(hits), "sites": hits[:16]}))
+
+
 def _rule_decode_sort(levels, report: Report) -> None:
     for lv in levels:
         for i, eqn in enumerate(lv.jaxpr.eqns):
@@ -555,7 +634,8 @@ def run_decode_rules(closed=None, *, page_tokens: Optional[int] = None,
                             dtype if dtype is not None else np.float32)
         problems = []
         if not plan["sublane_ok"]:
-            problems.append(f"page_tokens {page_tokens} % 8 != 0 "
+            problems.append(f"page_tokens {page_tokens} % "
+                            f"{plan.get('sublane', 8)} != 0 "
                             "(padded sublanes on every pool page)")
         if not plan["block_aligned"]:
             problems.append(
@@ -628,6 +708,7 @@ def run_jaxpr_rules(closed, report: Optional[Report] = None) -> Report:
     _rule_pallas(levels, report)
     _rule_host_sync(levels, report)
     _rule_collectives(levels, report)
+    _rule_quant_dequant_upcast(levels, report)
     return report
 
 

@@ -72,6 +72,14 @@ def main(argv=None):
                    help="top ops listed per category")
     p.add_argument("--seq", type=int, default=None,
                    help="transformer_lm* sequence override (model mode)")
+    p.add_argument("--quantize", default=None,
+                   choices=("off", "int8", "fp8", "kv8", "int8+kv8",
+                            "fp8+kv8"),
+                   help="with --mem on a transformer_lm target: account "
+                        "the serving KV cache and weights under this "
+                        "quantize mode (ISSUE 17) and re-fit the "
+                        "max-slot forecast — kv8 roughly quarters the "
+                        "per-slot bytes, so ~2x the slots fit")
     from bigdl_tpu.cli.common import (_add_platform_arg, add_strategy_arg,
                                       apply_platform)
     _add_platform_arg(p)
@@ -94,16 +102,36 @@ def main(argv=None):
         plan2 = memory.plan_for_model(args.target, 2 * b,
                                       seq_len=args.seq)
         fc = memory.forecast(plan, plan2)
+        kvp = fcs = None
+        if args.target.startswith("transformer_lm"):
+            # serving-side companion (ISSUE 17): per-slot KV bytes and
+            # the max-slot fit, dtype-aware under --quantize
+            kvp = memory.serving_kv_plan(args.target, seq_len=args.seq,
+                                         quantize=args.quantize)
+            fcs = memory.forecast_slots(kvp)
         if args.json:
             out = memory.compact(plan)
             out["model"] = args.target
             out["forecast"] = fc
             out["plan_2x"] = memory.compact(plan2)
+            if kvp is not None:
+                out["serving_kv"] = kvp
+                out["forecast_slots"] = fcs
             print(json.dumps(out))
         else:
             print(f"memory plan: {args.target} b={b} "
                   f"({plan.get('device')})")
             print(memory.render(plan, fc))
+            if kvp is not None:
+                print(f"\nserving (quantize={kvp['quantize']}): "
+                      f"kv/slot {kvp['kv_bytes_per_slot']} B "
+                      f"(L={kvp['max_len']}, "
+                      f"dtype={kvp['cache_dtype']}"
+                      + (f", page={kvp['page_tokens']}"
+                         if kvp['page_tokens'] else "")
+                      + f"), weights {kvp['params_bytes']} B"
+                      f" -> predicted max slots "
+                      f"{fcs['predicted_max_slots']}")
         return 0
 
     if os.path.isdir(args.target):

@@ -107,6 +107,17 @@ def build_parser() -> argparse.ArgumentParser:
                    help="share page-aligned prompt-prefix K/V across "
                         "requests (needs --kvPageTokens): hits copy "
                         "resident pages and prefill only the suffix")
+    p.add_argument("--quantize", default="off",
+                   choices=("off", "int8", "fp8", "kv8", "int8+kv8",
+                            "fp8+kv8"),
+                   help="quantized serving (ISSUE 17): int8/fp8 weights "
+                        "(per-channel symmetric, dequant fused into the "
+                        "matmul epilogue), kv8 stores the paged KV pools "
+                        "8-bit with per-row scales (~2x the slots at "
+                        "equal HBM; implies --kvPageTokens, auto-picked "
+                        "if unset). Greedy-agreement + logit-error vs "
+                        "f32 are measured at startup and stamped into "
+                        "provenance. 'off' is byte-identical to today")
     p.add_argument("--classes", type=int, default=1000)
     p.add_argument("--bf16", action="store_true",
                    help="bf16 activations (vision: input cast; LM: "
@@ -286,6 +297,28 @@ def build_app(args):
             "checkpoint dir or file) or --randomInit for smoke/bench "
             "runs")
 
+    # --quantize (ISSUE 17): quantize the weight tree ONCE up front (the
+    # engines re-apply idempotently, so dp replicas share the 8-bit
+    # tree) and measure the quality guardrail against the full-precision
+    # tree while it is still around. 'off' never touches params.
+    quantize = getattr(args, "quantize", None) or "off"
+    q_wfmt, q_kv8, quant_info = None, False, None
+    if quantize != "off":
+        from bigdl_tpu.serving.quant import (parse_quantize, quant_report,
+                                             quantize_params)
+        q_wfmt, q_kv8 = parse_quantize(quantize)
+        if q_kv8 and not is_lm:
+            raise SystemExit("--quantize kv8 quantizes the decode KV "
+                             "cache — transformer_lm models only")
+        qparams = quantize_params(params, q_wfmt)
+        if is_lm:
+            probe = list(range(1, min(9, model.vocab)))
+            quant_info = quant_report(model, params, qparams,
+                                      prompt=probe, max_new_tokens=8,
+                                      kv8=q_kv8,
+                                      cache_dtype=compute_dtype)
+        params = qparams
+
     metrics = MetricsRegistry()
     # install as the process-global registry (ISSUE 7): resilience
     # fault/retry counters and any training-side phase publishes in this
@@ -324,6 +357,18 @@ def build_app(args):
     draft_model = draft_params = None
     if is_lm:
         page_tokens = _resolve_page_tokens(args, model, compute_dtype)
+        if q_kv8 and page_tokens is None:
+            # kv8 is a page-pool layout; pick a page size automatically
+            # rather than bounce the operator to --kvPageTokens
+            for cand in (128, 64, 32, 256):
+                if model.max_len % cand == 0:
+                    page_tokens = cand
+                    break
+            if page_tokens is None:
+                raise SystemExit(
+                    f"--quantize {quantize}: no page size in "
+                    f"(128, 64, 32, 256) divides max_len "
+                    f"{model.max_len}; pass --kvPageTokens explicitly")
         if args.prefixCache and page_tokens is None:
             raise SystemExit("--prefixCache needs --kvPageTokens (prefix "
                              "sharing is a page copy)")
@@ -346,7 +391,7 @@ def build_app(args):
             model, params, mod_state,
             buckets=_parse_buckets(args.buckets),
             compute_dtype=compute_dtype, lint=lint_mode,
-            metrics=m, mesh=mesh)
+            metrics=m, mesh=mesh, quantize=quantize)
         if first:
             # lint pre-flight over the exact serving graph BEFORE first
             # compile (strict refuses to serve, same contract as the
@@ -378,7 +423,7 @@ def build_app(args):
                                    draft_model=draft_model,
                                    draft_params=draft_params,
                                    prefix_cache=args.prefixCache,
-                                   mesh=mesh)
+                                   mesh=mesh, quantize=quantize)
             # decode-path lint pre-flight (ISSUE 14): sampling-sort /
             # host-sync rules over the traced decode step + the
             # page-layout fit, same strict contract as the forward's
@@ -435,6 +480,12 @@ def build_app(args):
         "shed_at": args.shedAt,
         "reqtrace": "on" if reqtracer is not None else "off",
     })
+    if quant_info is not None:
+        # measured quality guardrail (ISSUE 17): greedy agreement vs the
+        # f32 tree and worst-case logit error, pinned into every scrape
+        prov["quant_agreement"] = round(float(quant_info["agreement"]), 4)
+        prov["quant_logit_max_err"] = round(
+            float(quant_info["logit_max_err"]), 6)
     if strategy:
         import jax
         # multi-chip topology provenance (ISSUE 16): every /metrics

@@ -310,7 +310,12 @@ class LookupTable(SimpleModule):
 
     def _forward(self, params, x, *, training, rng):
         w = params["weight"]
-        rows = jnp.take(w, x.astype(jnp.int32), axis=0)
+        if hasattr(w, "take_rows"):
+            # quantized serving weight (serving/quant.QuantizedWeight):
+            # gather the 8-bit rows, scale after — same result dtype
+            rows = w.take_rows(x.astype(jnp.int32))
+        else:
+            rows = jnp.take(w, x.astype(jnp.int32), axis=0)
         if self.max_norm is not None:
             n = jnp.linalg.norm(rows, ord=self.norm_type, axis=-1, keepdims=True)
             rows = rows * jnp.minimum(1.0, self.max_norm / jnp.maximum(n, 1e-7))

@@ -50,6 +50,7 @@ logger = logging.getLogger("bigdl_tpu")
 __all__ = [
     "HBM_BYTES", "device_hbm_bytes", "tree_bytes", "build_plan",
     "forecast", "plan_for_model", "render", "compact",
+    "serving_kv_plan", "forecast_slots",
     "HbmSampler", "install", "installed_plan", "installed_trace_dir",
     "is_resource_exhausted", "handle_oom", "write_oom_report",
     "OOM_REPORT_NAME",
@@ -299,6 +300,90 @@ def plan_for_model(model_name: str, batch: int,
     return build_plan(compiled, params=params, opt_state=opt_state,
                       batch=(x, y), device=jax.devices()[0],
                       batch_size=batch, model_name=model_name)
+
+
+def serving_kv_plan(model_name: str, *, seq_len: Optional[int] = None,
+                    page_tokens: Optional[int] = None,
+                    quantize: Optional[str] = None,
+                    cache_dtype=None, device=None) -> dict:
+    """Per-slot serving byte accounting for a transformer_lm target: the
+    KV-cache cost of one decode slot (dense slab, or the kv8 page-pool
+    layout — int8 rows + one f32 scale per (page, head, token), exactly
+    :class:`~bigdl_tpu.serving.kv_pages.QuantPool`'s arrays) plus the
+    resident weight bytes under ``--quantize``. This is the dtype-aware
+    half of ``explain --mem``: quantized modes change per-slot and
+    fixed bytes, and :func:`forecast_slots` re-fits the max-slot
+    prediction from them."""
+    import jax
+    import numpy as np
+
+    from bigdl_tpu.cli.perf import build_model
+    from bigdl_tpu.serving.quant import parse_quantize, quantize_params
+
+    if not model_name.startswith("transformer_lm"):
+        raise ValueError("serving_kv_plan targets transformer_lm* models "
+                         f"(decode KV slots), got {model_name!r}")
+    model, _ = build_model(model_name, seq_len=seq_len)
+    wfmt, kv8 = parse_quantize(quantize) if quantize else (None, False)
+    L = int(model.max_len)
+    pt = page_tokens
+    if kv8 and pt is None:
+        # same auto ladder the serve CLI uses for --quantize kv8
+        for cand in (128, 64, 32, 256):
+            if L % cand == 0:
+                pt = cand
+                break
+        if pt is None:
+            raise ValueError(f"no page size in (128, 64, 32, 256) "
+                             f"divides max_len {L}; pass page_tokens")
+    dt = np.dtype(cache_dtype) if cache_dtype is not None \
+        else np.dtype(np.float32)
+    cache = model.encoder.init_cache(1, L, dt)
+    kv_slot = 0
+    for leaf in jax.tree_util.tree_leaves(cache):
+        _, kh, _, hd = leaf.shape
+        if kv8:
+            # QuantPool layout: q int8 (pages, kh, pt, hd) + s f32
+            # (pages, kh, pt); a slot owns L/pt pages
+            kv_slot += (L // pt) * (kh * pt * hd * 1 + kh * pt * 4)
+        else:
+            kv_slot += int(np.prod(leaf.shape)) * dt.itemsize
+    params = model.init(jax.random.PRNGKey(0))
+    dense_b = tree_bytes(params)
+    if wfmt is not None:
+        params = quantize_params(params, wfmt)
+    params_b = tree_bytes(params)
+    hbm, hbm_label = device_hbm_bytes(device)
+    return {
+        "model": model_name,
+        "quantize": quantize or "off",
+        "max_len": L,
+        "page_tokens": pt,
+        "cache_dtype": dt.name,
+        "kv_bytes_per_slot": int(kv_slot),
+        "params_bytes": int(params_b),
+        "params_bytes_f32": int(dense_b),
+        "hbm_bytes": int(hbm),
+        "hbm_match": hbm_label,
+    }
+
+
+def forecast_slots(plan: dict, hbm_bytes=None) -> dict:
+    """Max decode slots that fit the budget: ``(hbm - weights) /
+    kv_bytes_per_slot`` — the serving twin of :func:`forecast`. Under
+    kv8 the per-slot cost roughly quarters, so the prediction roughly
+    doubles even after the weight savings are counted."""
+    cap = float(hbm_bytes if hbm_bytes is not None
+                else plan["hbm_bytes"])
+    fixed = float(plan["params_bytes"])
+    per = float(plan["kv_bytes_per_slot"])
+    n = int(math.floor((cap - fixed) / per)) if per > 0 else None
+    return {
+        "hbm_bytes": int(cap),
+        "fixed_bytes": int(fixed),
+        "kv_bytes_per_slot": int(per),
+        "predicted_max_slots": (max(n, 0) if n is not None else None),
+    }
 
 
 # ------------------------------------------------------------ rendering

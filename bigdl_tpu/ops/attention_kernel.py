@@ -32,6 +32,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from bigdl_tpu.nn import attention as _dense
 
@@ -542,9 +543,13 @@ def kv_page_plan(page_tokens: int, max_len: int, head_dim: int,
 
     * ``divides_max_len`` — False is a hard engine error (the gathered
       view must be exactly max_len);
-    * ``sublane_ok`` — pages whose token dim is not a multiple of 8
-      break the (8, 128) tile on every pool leaf: each page then pays a
-      padded sublane, and gathers re-lay the data;
+    * ``sublane_ok`` — pages whose token dim is not a multiple of the
+      dtype's minimum sublane count (8 for 4-byte, 16 for bf16, 32 for
+      int8 — the Mosaic tile rule) break the minimum tile on every pool
+      leaf: each page then pays a padded sublane, and gathers re-lay
+      the data. 8-bit KV pools (ISSUE 17 kv8) therefore need 32-token
+      pages at minimum;
+    * ``sublane`` — the minimum applied, for the lint message;
     * ``block_aligned`` — the prefill flash kernel reads K in
       ``block_k`` tiles; when neither divides the other, a single K
       block straddles a page boundary in the gathered view and the
@@ -554,11 +559,13 @@ def kv_page_plan(page_tokens: int, max_len: int, head_dim: int,
     plan = flash_block_plan(max_len, max_len, head_dim, causal, dtype)
     bk = int(plan["block_k"])
     pt = int(page_tokens)
+    sub = {4: 8, 2: 16, 1: 32}.get(np.dtype(dtype).itemsize, 8)
     return {
         "page_tokens": pt,
         "block_k": bk,
         "divides_max_len": max_len % pt == 0,
-        "sublane_ok": pt % 8 == 0,
+        "sublane": sub,
+        "sublane_ok": pt % sub == 0,
         "block_aligned": (pt % bk == 0) or (bk % pt == 0),
     }
 

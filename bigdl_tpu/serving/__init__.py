@@ -34,6 +34,10 @@ Modules:
 * :mod:`sharding` — tensor-parallel placement for serving (ISSUE 16):
   reuses the training Megatron specs for params, shards KV on the
   kv_heads dim, restores checkpoints onto any serving mesh;
+* :mod:`quant` — quantized serving (ISSUE 17): per-channel int8/fp8
+  weights dequantized in the matmul epilogue (or native int8 dot),
+  8-bit paged KV pools, and the ``quant_report`` quality guardrail —
+  ``--quantize off`` is byte-identical to not having the module;
 * :mod:`replicas` — data-parallel engine replicas behind one front
   door (ISSUE 16): least-loaded deterministic routing, fleet-level
   readiness/shedding, per-replica labelled metrics + fleet aggregates.
@@ -44,10 +48,14 @@ from bigdl_tpu.serving.batcher import (AdmissionError, DeadlineExceeded,
 from bigdl_tpu.serving.decode import DecodeEngine, DecodeRequest
 from bigdl_tpu.serving.engine import InferenceEngine, power_of_two_buckets
 from bigdl_tpu.serving.kv_pages import (PageAllocator, PagedKvCache,
+                                        QuantPool, kv_quant_rows,
                                         pages_needed)
 from bigdl_tpu.serving.metrics import (Counter, Gauge, Histogram,
                                        MetricsRegistry)
 from bigdl_tpu.serving.prefix_cache import PrefixCache
+from bigdl_tpu.serving.quant import (QUANTIZE_CHOICES, QuantizedWeight,
+                                     parse_quantize, quant_report,
+                                     quantize_params)
 from bigdl_tpu.serving.replicas import Replica, ReplicaSet
 from bigdl_tpu.serving.reqtrace import (AccessLog, RequestRecord,
                                         RequestTracer, SloPolicy,
@@ -66,6 +74,9 @@ __all__ = ["AdmissionError", "DeadlineExceeded", "MicroBatcher",
            "WorkerDied", "DecodeEngine", "DecodeRequest",
            "InferenceEngine", "power_of_two_buckets",
            "PageAllocator", "PagedKvCache", "pages_needed", "PrefixCache",
+           "QUANTIZE_CHOICES", "QuantizedWeight", "QuantPool",
+           "kv_quant_rows", "parse_quantize", "quant_report",
+           "quantize_params",
            "accept_chunk", "parse_draft_dims", "request_key",
            "sample_token", "warp_logits",
            "Counter", "Gauge", "Histogram", "MetricsRegistry",

@@ -119,6 +119,10 @@ class DecodeEngine:
       dispatch-count wins and CI determinism).
     * ``prefix_cache`` — share page-aligned prompt-prefix K/V across
       requests (requires paging).
+    * ``quantize`` — ``--quantize`` mode (ISSUE 17): int8/fp8 weights
+      via ``serving.quant``, ``kv8`` stores the page pools 8-bit
+      (requires paging). ``off``/None is byte-identical to the
+      unquantized path — no quant code runs.
     """
 
     def __init__(self, model, params, *, slots: int = 4,
@@ -130,7 +134,8 @@ class DecodeEngine:
                  draft_model=None, draft_params=None,
                  prefix_cache: bool = False,
                  prefix_cache_pages: Optional[int] = None,
-                 mesh=None, model_axis: str = "model"):
+                 mesh=None, model_axis: str = "model",
+                 quantize: Optional[str] = None):
         import jax
         import jax.numpy as jnp
         import time as _time
@@ -143,6 +148,17 @@ class DecodeEngine:
         self._worker_error: Optional[BaseException] = None
         self._last_beat = self.clock()
         self.model = model
+        # ---- quantized serving (ISSUE 17): weights go 8-bit BEFORE tp
+        # placement so each scale vector ships to the mesh alongside its
+        # weight (column-split weight -> split scale). Idempotent: trees
+        # cli/serve already quantized pass through untouched.
+        from bigdl_tpu.serving import quant as _q
+        self.quantize = quantize if quantize else "off"
+        self._wfmt, self._kv8 = _q.parse_quantize(quantize)
+        if self._wfmt is not None:
+            params = _q.quantize_params(params, self._wfmt)
+            if draft_model is not None and draft_params is not None:
+                draft_params = _q.quantize_params(draft_params, self._wfmt)
         # ---- tp placement (ISSUE 16): params go to the mesh under the
         # Megatron layout, KV leaves split on the kv_heads dim, logits /
         # host scalars stay replicated. mesh=None keeps the single-chip
@@ -183,6 +199,9 @@ class DecodeEngine:
         if prefix_cache and not self.paged:
             raise ValueError("prefix_cache requires kv_page_tokens "
                              "(prefix sharing is a page copy)")
+        if self._kv8 and not self.paged:
+            raise ValueError("--quantize kv8 requires kv_page_tokens "
+                             "(8-bit KV is a page-pool layout)")
         if self.paged:
             extra = 0
             if prefix_cache and pool_pages is None:
@@ -194,7 +213,8 @@ class DecodeEngine:
                 page_tokens=self.page_tokens, dtype=self.cache_dtype,
                 pool_pages=pool_pages, extra_pages=extra,
                 sharding=(self._shard.kv_sharding
-                          if self._shard is not None else None))
+                          if self._shard is not None else None),
+                quantized=self._kv8)
             self._cache = None
         else:
             self._kv = None

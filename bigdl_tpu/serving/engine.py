@@ -73,10 +73,19 @@ class InferenceEngine:
                  buckets: Sequence[int] = (1, 2, 4, 8, 16, 32),
                  compute_dtype=None, donate_inputs: bool = True,
                  lint: Optional[str] = None, metrics=None,
-                 mesh=None, model_axis: str = "model"):
+                 mesh=None, model_axis: str = "model",
+                 quantize: Optional[str] = None):
         import jax
 
         self.module = module
+        # quantized weights (ISSUE 17) go 8-bit BEFORE mesh placement so
+        # scales ride their weight's layout; "off"/None never touches
+        # the tree (byte-identical serving path, CI-enforced)
+        from bigdl_tpu.serving import quant as _q
+        self.quantize = quantize if quantize else "off"
+        wfmt, _ = _q.parse_quantize(quantize)
+        if wfmt is not None:
+            params = _q.quantize_params(params, wfmt)
         # tp placement (ISSUE 16): params committed to the mesh under
         # the training-side Megatron layout; GSPMD partitions the
         # bucketed forwards from there. A 1-device mesh just pins the
@@ -331,6 +340,7 @@ class InferenceEngine:
                               else "float32"),
             "bn_fused": bn_fused_mode(self.module),
             "autotune": tuning.get_mode(),
+            "quantize": self.quantize,
         }
         cl = conv_layouts_if_nondefault()
         out["conv_layouts"] = ("/".join(f"{k}={v}" for k, v in

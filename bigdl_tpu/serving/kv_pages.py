@@ -46,13 +46,88 @@ from __future__ import annotations
 import collections
 from typing import List, Optional
 
-__all__ = ["PageAllocator", "PagedKvCache", "gather_cache",
+__all__ = ["PageAllocator", "PagedKvCache", "QuantPool", "gather_cache",
            "scatter_tokens", "scatter_pages", "copy_pages",
-           "pages_needed"]
+           "pages_needed", "kv_quant_rows"]
 
 
 def pages_needed(tokens: int, page_tokens: int) -> int:
     return -(-int(tokens) // int(page_tokens))
+
+
+# --------------------------------------------------------- quantized pools
+class QuantPool:
+    """One layer's K (or V) pool stored 8-bit (ISSUE 17): ``q`` is the
+    int8 pool ``(pool_pages, kv_heads, page_tokens, head_dim)`` and
+    ``s`` the f32 scale plane ``(pool_pages, kv_heads, page_tokens)`` —
+    one symmetric scale per stored token row, computed over head_dim at
+    write time. Registered as a pytree node so it sits AT the pools'
+    leaf positions: the decode/verify/prefill programs, their
+    ShapeDtypeStruct shadows, ``out_shardings`` pytrees and
+    ``device_put`` all flow through unchanged, and the device helpers
+    below dispatch on ``isinstance`` — quantize on scatter, dequantize
+    on gather. ``view_dtype`` is the dtype the gathered contiguous view
+    dequantizes to (the engine's cache dtype, so the decode graph
+    downstream of the gather is the same program as the dense path).
+
+    HBM per page drops from ``kh*pt*hd*itemsize(cache_dtype)`` to
+    ``kh*pt*(hd + 4)`` bytes — ~0.27x at head_dim 64 vs f32, so
+    reservation-based admission grants ~2x the slots even after adding
+    the weight savings' headroom elsewhere.
+    """
+
+    __slots__ = ("q", "s", "view_dtype")
+
+    def __init__(self, q, s, view_dtype):
+        import numpy as np
+        self.q = q
+        self.s = s
+        self.view_dtype = np.dtype(view_dtype)
+
+    def tree_flatten(self):
+        return (self.q, self.s), (self.view_dtype,)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(children[0], children[1], aux[0])
+
+    def __repr__(self):
+        return (f"QuantPool(q={getattr(self.q, 'shape', None)}, "
+                f"view={self.view_dtype})")
+
+
+def _is_qp(x) -> bool:
+    return isinstance(x, QuantPool)
+
+
+def kv_quant_rows(vals):
+    """Quantize K/V rows ``(..., head_dim)`` to the kv8 storage format:
+    per-row symmetric int8 over head_dim. Returns ``(q int8, s f32)``
+    with ``s`` shaped ``vals.shape[:-1]``. The op order here is the
+    contract ``serving.quant.kv_fake_quant`` mirrors — keep them in
+    lockstep or the paged==dense parity pin breaks."""
+    import jax.numpy as jnp
+
+    v = vals.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(v), axis=-1)
+    s = jnp.maximum(amax, 1e-8) / 127.0
+    q = jnp.clip(jnp.round(v / s[..., None]), -127, 127).astype(jnp.int8)
+    return q, s
+
+
+def _zip_map(fn, pools, vals):
+    """Map ``fn(pool_leaf, val_leaf)`` over a pools tree whose leaves may
+    be :class:`QuantPool` nodes and a vals tree of plain arrays at the
+    same positions — explicit flatten/zip/unflatten, because two-tree
+    ``tree_map`` would descend INTO the QuantPool children on one side
+    only."""
+    import jax
+    pl, treedef = jax.tree_util.tree_flatten(pools, is_leaf=_is_qp)
+    vl = jax.tree_util.tree_leaves(vals)
+    if len(pl) != len(vl):
+        raise ValueError(f"pools/vals leaf mismatch: {len(pl)} vs {len(vl)}")
+    return jax.tree_util.tree_unflatten(
+        treedef, [fn(p, v) for p, v in zip(pl, vl)])
 
 
 # --------------------------------------------------------- device helpers
@@ -69,11 +144,21 @@ def gather_cache(pools, pages):
     import jax.numpy as jnp
 
     def g(leaf):
+        if _is_qp(leaf):
+            # kv8 (ISSUE 17): 8-bit gather out of HBM, dequantize into
+            # the transient view — q * s row-wise, the exact inverse of
+            # the scatter-side kv_quant_rows
+            x = jnp.take(leaf.q, pages, axis=0)   # (mp, kh, pt, hd) i8
+            s = jnp.take(leaf.s, pages, axis=0)   # (mp, kh, pt) f32
+            v = (x.astype(jnp.float32) * s[..., None]).astype(
+                leaf.view_dtype)
+            mp, kh, pt, hd = v.shape
+            return v.transpose(1, 0, 2, 3).reshape(kh, mp * pt, hd)
         x = jnp.take(leaf, pages, axis=0)      # (mp, kh, pt, hd)
         mp, kh, pt, hd = x.shape
         return x.transpose(1, 0, 2, 3).reshape(kh, mp * pt, hd)
 
-    return jax.tree_util.tree_map(g, pools)
+    return jax.tree_util.tree_map(g, pools, is_leaf=_is_qp)
 
 
 def scatter_tokens(pools, tok_kv, page_ids, offsets):
@@ -83,13 +168,19 @@ def scatter_tokens(pools, tok_kv, page_ids, offsets):
     ``page_ids``/``offsets``: (n,) int32. Slots own disjoint pages so
     real writes never collide; junk writes all land in null page 0.
     """
-    import jax
-
     def s(pool, vals):
+        if _is_qp(pool):
+            # quantize-on-write: the row's scale lands in the scale
+            # plane at the same (page, head, offset) address
+            q, sc = kv_quant_rows(vals)      # (n, kh, hd) i8 / (n, kh)
+            return QuantPool(
+                pool.q.at[page_ids, :, offsets, :].set(q),
+                pool.s.at[page_ids, :, offsets].set(sc),
+                pool.view_dtype)
         return pool.at[page_ids, :, offsets, :].set(
             vals.astype(pool.dtype))
 
-    return jax.tree_util.tree_map(s, pools, tok_kv)
+    return _zip_map(s, pools, tok_kv)
 
 
 def scatter_pages(pools, cache, pages):
@@ -97,16 +188,19 @@ def scatter_pages(pools, cache, pages):
     into pool pages ``pages`` ((max_pages,) int32) — the post-prefill
     write. Tail entries past the reservation are 0: those page-sized
     chunks of pad K/V pile into the null page, harmlessly."""
-    import jax
-
     def s(pool, c):
         kh, length, hd = c.shape[1], c.shape[2], c.shape[3]
         mp = pages.shape[0]
         pt = length // mp
         x = c[0].reshape(kh, mp, pt, hd).transpose(1, 0, 2, 3)
+        if _is_qp(pool):
+            q, sc = kv_quant_rows(x)  # (mp, kh, pt, hd) i8 / (mp, kh, pt)
+            return QuantPool(pool.q.at[pages].set(q),
+                             pool.s.at[pages].set(sc),
+                             pool.view_dtype)
         return pool.at[pages].set(x.astype(pool.dtype))
 
-    return jax.tree_util.tree_map(s, pools, cache)
+    return _zip_map(s, pools, cache)
 
 
 def copy_pages(pools, src, dst):
@@ -116,9 +210,16 @@ def copy_pages(pools, src, dst):
     import jax.numpy as jnp
 
     def c(pool):
+        if _is_qp(pool):
+            # already 8-bit at rest: copy q and scale rows verbatim, no
+            # re-quantization loss on prefix-cache hits
+            return QuantPool(
+                pool.q.at[dst].set(jnp.take(pool.q, src, axis=0)),
+                pool.s.at[dst].set(jnp.take(pool.s, src, axis=0)),
+                pool.view_dtype)
         return pool.at[dst].set(jnp.take(pool, src, axis=0))
 
-    return jax.tree_util.tree_map(c, pools)
+    return jax.tree_util.tree_map(c, pools, is_leaf=_is_qp)
 
 
 # ------------------------------------------------------------- allocation
@@ -167,7 +268,8 @@ class PagedKvCache:
 
     def __init__(self, encoder, *, slots: int, max_len: int,
                  page_tokens: int, dtype, pool_pages: Optional[int] = None,
-                 extra_pages: int = 0, sharding=None):
+                 extra_pages: int = 0, sharding=None,
+                 quantized: bool = False):
         import numpy as np
 
         page_tokens = int(page_tokens)
@@ -188,10 +290,24 @@ class PagedKvCache:
         # pools: template one-page cache broadcast to pool_pages
         import jax
         import jax.numpy as jnp
+        self.quantized = bool(quantized)
         tmpl = encoder.init_cache(1, page_tokens, dtype)
-        self.pools = jax.tree_util.tree_map(
-            lambda a: jnp.zeros((self.pool_pages,) + a.shape[1:], a.dtype),
-            tmpl)
+        if self.quantized:
+            # kv8 (ISSUE 17): int8 pools + f32 per-row scale planes at
+            # the same leaf positions — the device helpers dispatch on
+            # the QuantPool node, every program shape stays put
+            def mk(a):
+                kh, pt, hd = a.shape[1], a.shape[2], a.shape[3]
+                return QuantPool(
+                    jnp.zeros((self.pool_pages, kh, pt, hd), jnp.int8),
+                    jnp.zeros((self.pool_pages, kh, pt), jnp.float32),
+                    dtype)
+            self.pools = jax.tree_util.tree_map(mk, tmpl)
+        else:
+            self.pools = jax.tree_util.tree_map(
+                lambda a: jnp.zeros((self.pool_pages,) + a.shape[1:],
+                                    a.dtype),
+                tmpl)
         # tp (ISSUE 16): commit the pools to the caller's layout (a
         # per-leaf callable, e.g. ServingSharding.kv_sharding — kv_heads
         # dim split over the model axis) and keep the sharding pytree so
@@ -242,3 +358,11 @@ class PagedKvCache:
 
     def pool_bytes(self) -> int:
         return self.pool_pages * self._bytes_per_page
+
+
+def _register():
+    import jax
+    jax.tree_util.register_pytree_node_class(QuantPool)
+
+
+_register()

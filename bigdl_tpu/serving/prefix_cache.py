@@ -26,6 +26,12 @@ walks aligned prefix lengths longest-first, so a hit is always the
 deepest cached ancestor. Eviction is LRU under a page budget served by
 the SAME allocator the slots use — cache pressure and decode pressure
 meet in one accounting (``kv_pages_in_use`` counts both).
+
+Quantized pools (ISSUE 17 ``--quantize kv8``) compose for free: the
+cache holds page IDs, never tensors, and ``copy_pages`` moves the int8
+rows AND their scales verbatim — a hit replays the exact stored
+quantization, so there is no re-quantization loss on reuse, and each
+cached page costs ~4x fewer HBM bytes under the same page budget.
 """
 
 from __future__ import annotations

@@ -1,0 +1,398 @@
+"""Quantized serving weights: per-channel int8/fp8 with fused dequant
+(ISSUE 17).
+
+Every serving byte was f32/bf16 until now, so slot count and max model
+size per chip were half of what the hardware admits. This module
+quantizes the 2-D projection weights (Linear / attention projections /
+the tied embedding) to 8 bits at engine-construction time and swaps a
+**dequant-fused matmul** into the exact code paths the engines already
+trace — without editing a single module forward:
+
+* :class:`QuantizedWeight` is a registered pytree node holding the int8
+  (or fp8) tensor plus one f32 scale per output channel (per-channel
+  symmetric, axis 1). It flows through ``jax.jit`` / ``tree_map`` /
+  ``device_put`` like any other params leaf.
+* Module code reads weights as ``x @ params["weight"].astype(x.dtype)``
+  (and the tied head as ``h @ w.astype(h.dtype).T``). ``astype`` on a
+  :class:`QuantizedWeight` returns a :class:`_QView` — an ephemeral,
+  non-pytree handle WITHOUT ``__jax_array__``, so jax's binary ops defer
+  to ``_QView.__rmatmul__`` and the dequant lands fused into the matmul
+  epilogue: ``(x @ q.astype(dt)) * scale`` (scale on the output dim is
+  exact — it commutes with the contraction). The transposed tied-head
+  orientation folds into the prologue instead: ``(x * scale) @ q.T``
+  (scale is on the contraction dim there, equally exact).
+* Embedding gathers go through :meth:`QuantizedWeight.take_rows`
+  (``nn.linear.LookupTable`` guards on the attribute): gather the int8
+  rows, then scale — 8-bit HBM traffic on the gather.
+* Where the backend multiplies int8 natively, the ``quant`` autotune
+  namespace (:func:`bigdl_tpu.tuning.quant_matmul_kind`) can pick a
+  **native-int8** kernel per shape instead: dynamic per-row activation
+  quant + ``lax.dot_general`` with i32 accumulation, both scales folded
+  into the output epilogue.
+
+fp8 uses ``jnp.float8_e4m3fn`` where this jax build has it and falls
+back to int8 (with a log line) where it doesn't — capability, not
+version, is what's probed.
+
+Quality is measured, not assumed: :func:`quant_report` runs a greedy
+teacher-forced decode on the f32 path and the quantized path and
+reports the argmax agreement rate plus the max logit error —
+``cli/serve`` stamps both into provenance, tests pin them.
+
+The KV-cache half of ISSUE 17 (8-bit paged pools) lives in
+``serving/kv_pages`` — this module owns only the weight side and the
+shared report.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional, Tuple
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["QuantizedWeight", "quantize_weight", "quantize_params",
+           "is_quantized", "parse_quantize", "fp8_supported",
+           "quant_report", "QUANTIZE_CHOICES"]
+
+QUANTIZE_CHOICES = ("off", "int8", "fp8", "kv8", "int8+kv8", "fp8+kv8")
+
+# dict keys that hold 2-D projection weights across the model zoo:
+# nn.Linear / LookupTable ("weight"), nn.attention's qkv/out projections
+# and the transformer block's MLP pair. Biases, norms scales and conv
+# kernels stay in full precision — they are a rounding error of the
+# footprint and the quality risk is all theirs.
+_QUANT_KEYS = frozenset(
+    {"weight", "wq", "wk", "wv", "wo", "w1", "w2"})
+
+_FP8_MAX = 448.0  # float8_e4m3fn finite max
+_EPS = 1e-8
+
+
+def fp8_supported() -> bool:
+    """True when this jax build ships ``float8_e4m3fn`` (capability
+    probe — the fallback is per-build, not per-version)."""
+    import jax.numpy as jnp
+    return hasattr(jnp, "float8_e4m3fn")
+
+
+def parse_quantize(mode: Optional[str]) -> Tuple[Optional[str], bool]:
+    """``--quantize`` value -> ``(weight_fmt, kv8)`` where weight_fmt is
+    ``"int8"``/``"fp8"``/None. ``fp8`` degrades to ``int8`` when the
+    dtype is absent from this jax build (logged once per call site)."""
+    if mode is None:
+        return None, False
+    mode = str(mode)
+    if mode not in QUANTIZE_CHOICES:
+        raise ValueError(
+            f"--quantize must be one of {'/'.join(QUANTIZE_CHOICES)}, "
+            f"got {mode!r}")
+    if mode == "off":
+        return None, False
+    parts = mode.split("+")
+    kv8 = "kv8" in parts
+    wfmt = next((p for p in parts if p in ("int8", "fp8")), None)
+    if wfmt == "fp8" and not fp8_supported():
+        logger.warning("quantize: this jax build has no float8_e4m3fn; "
+                       "falling back to int8 weights")
+        wfmt = "int8"
+    return wfmt, kv8
+
+
+class QuantizedWeight:
+    """A 2-D weight stored 8-bit with per-output-channel f32 scales.
+
+    Registered as a pytree node (children ``q``/``scale``, static
+    ``fmt``), so placement, jit tracing and ShapeDtypeStruct shadowing
+    all flow through it. The module-facing protocol is duck-typed:
+    ``.astype(dt)`` hands back a :class:`_QView` whose matmul overloads
+    fold the dequant into the contraction; ``.take_rows(idx)`` is the
+    embedding gather. ``shape``/``ndim``/``dtype`` report the LOGICAL
+    f32 weight, which is what spec builders inspect.
+    """
+
+    __slots__ = ("q", "scale", "fmt")
+
+    def __init__(self, q, scale, fmt: str):
+        self.q = q
+        self.scale = scale
+        self.fmt = fmt
+
+    # pytree protocol ----------------------------------------------------
+    def tree_flatten(self):
+        return (self.q, self.scale), (self.fmt,)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(children[0], children[1], aux[0])
+
+    # array-ish surface (what spec builders / accounting touch) ----------
+    @property
+    def shape(self):
+        return tuple(self.q.shape)
+
+    @property
+    def ndim(self):
+        return len(self.q.shape)
+
+    @property
+    def dtype(self):
+        import jax.numpy as jnp
+        return jnp.dtype(jnp.float32)
+
+    @property
+    def nbytes(self) -> int:
+        import numpy as np
+        q_b = int(np.prod(self.q.shape)) * np.dtype(self.q.dtype).itemsize
+        s_b = (int(np.prod(self.scale.shape))
+               * np.dtype(self.scale.dtype).itemsize)
+        return q_b + s_b
+
+    def __repr__(self):
+        return (f"QuantizedWeight({self.fmt}, shape={self.shape}, "
+                f"q={self.q.dtype})")
+
+    # module-facing protocol ---------------------------------------------
+    def astype(self, dt):
+        return _QView(self, dt, transposed=False)
+
+    @property
+    def T(self):
+        return _QView(self, None, transposed=True)
+
+    def take_rows(self, idx):
+        """Embedding gather: 8-bit rows out of HBM, scaled after —
+        returns f32 rows exactly like ``jnp.take`` on the dense f32
+        weight would (the caller casts to compute dtype downstream)."""
+        import jax.numpy as jnp
+        rows = jnp.take(self.q, idx, axis=0)
+        return rows.astype(self.scale.dtype) * self.scale
+
+    def dequantize(self):
+        """The full-precision tensor back (tests / reporting — the hot
+        path never materializes this)."""
+        return self.q.astype(self.scale.dtype) * self.scale[None, :]
+
+
+class _QView:
+    """Ephemeral dequant handle: what ``QuantizedWeight.astype`` returns
+    into module code. Deliberately NOT a pytree and WITHOUT
+    ``__jax_array__`` — jax's binary ops then return NotImplemented on
+    it and Python dispatches to our ``__rmatmul__``, which is where the
+    dequant fuses into the matmul."""
+
+    __slots__ = ("_w", "_dt", "_transposed")
+
+    def __init__(self, w: QuantizedWeight, dt, transposed: bool):
+        self._w = w
+        self._dt = dt
+        self._transposed = transposed
+
+    def astype(self, dt):
+        return _QView(self._w, dt, self._transposed)
+
+    @property
+    def T(self):
+        return _QView(self._w, self._dt, not self._transposed)
+
+    @property
+    def shape(self):
+        s = tuple(self._w.q.shape)
+        return s[::-1] if self._transposed else s
+
+    @property
+    def ndim(self):
+        return len(self._w.q.shape)
+
+    @property
+    def dtype(self):
+        import jax.numpy as jnp
+        return jnp.dtype(self._dt) if self._dt is not None \
+            else jnp.dtype(jnp.float32)
+
+    def __rmatmul__(self, x):
+        import jax
+        import jax.numpy as jnp
+
+        w = self._w
+        dt = self._dt if self._dt is not None else x.dtype
+        scale = w.scale.astype(dt)
+        if self._transposed:
+            # w is (n, k) with scale on k (the contraction dim here):
+            # x @ (q * s).T == (x * s) @ q.T — prologue fold, exact.
+            return (x * scale) @ w.q.astype(dt).T
+        if w.fmt == "int8" and _matmul_kind(x, w, dt) == "native-int8":
+            # dynamic per-row activation quant + i32-accumulated int8
+            # dot; both scales fold into the output epilogue
+            xf = x.astype(jnp.float32)
+            xs = jnp.maximum(jnp.max(jnp.abs(xf), axis=-1, keepdims=True),
+                             _EPS) / 127.0
+            xq = jnp.clip(jnp.round(xf / xs), -127, 127).astype(jnp.int8)
+            acc = jax.lax.dot_general(
+                xq, w.q, (((xq.ndim - 1,), (0,)), ((), ())),
+                preferred_element_type=jnp.int32)
+            return acc.astype(dt) * xs.astype(dt) * scale
+        # dequant fused into the epilogue: scale sits on the output
+        # channels, so it commutes with the contraction — exact.
+        return (x @ w.q.astype(dt)) * scale
+
+
+def _matmul_kind(x, w: QuantizedWeight, dt) -> str:
+    """Consult the ``quant`` autotune namespace for this shape (static
+    at trace time). Off mode -> the dequant-fused default."""
+    from bigdl_tpu import tuning
+    m = int(x.shape[-2]) if getattr(x, "ndim", 1) >= 2 else 1
+    k, n = int(w.q.shape[0]), int(w.q.shape[1])
+    return tuning.quant_matmul_kind(m, k, n, dt)
+
+
+def is_quantized(x) -> bool:
+    return isinstance(x, QuantizedWeight)
+
+
+def quantize_weight(w, fmt: str = "int8") -> QuantizedWeight:
+    """Per-channel symmetric quantization of a 2-D weight, axis 1 (one
+    scale per output channel — and, for the tied embedding's transposed
+    read, per contraction channel, which folds just as exactly)."""
+    import jax.numpy as jnp
+
+    if is_quantized(w):
+        return w
+    if getattr(w, "ndim", None) != 2:
+        raise ValueError(f"quantize_weight wants a 2-D weight, got shape "
+                         f"{getattr(w, 'shape', None)}")
+    if fmt == "fp8" and not fp8_supported():
+        fmt = "int8"
+    wf = w.astype(jnp.float32)
+    amax = jnp.maximum(jnp.max(jnp.abs(wf), axis=0), _EPS)
+    if fmt == "int8":
+        scale = amax / 127.0
+        q = jnp.clip(jnp.round(wf / scale[None, :]),
+                     -127, 127).astype(jnp.int8)
+    elif fmt == "fp8":
+        scale = amax / _FP8_MAX
+        q = jnp.clip(wf / scale[None, :],
+                     -_FP8_MAX, _FP8_MAX).astype(jnp.float8_e4m3fn)
+    else:
+        raise ValueError(f"unknown quantize format {fmt!r}")
+    return QuantizedWeight(q, scale.astype(jnp.float32), fmt)
+
+
+def quantize_params(params, fmt: Optional[str]):
+    """Quantize every eligible 2-D projection leaf in a params tree
+    (dict keys in ``_QUANT_KEYS``, floating, ndim 2). Idempotent —
+    already-quantized leaves pass through, so engines can re-apply it
+    on trees ``cli/serve`` quantized up front."""
+    import jax.numpy as jnp
+
+    if fmt is None:
+        return params
+
+    def _eligible(v):
+        return (not is_quantized(v)
+                and getattr(v, "ndim", None) == 2
+                and hasattr(v, "dtype")
+                and jnp.issubdtype(v.dtype, jnp.floating))
+
+    def rec(node):
+        if isinstance(node, dict):
+            return {k: (quantize_weight(v, fmt)
+                        if k in _QUANT_KEYS and _eligible(v)
+                        else rec(v))
+                    for k, v in node.items()}
+        if isinstance(node, (list, tuple)):
+            return type(node)(rec(v) for v in node)
+        return node
+
+    return rec(params)
+
+
+# ------------------------------------------------------------- reporting
+def kv_fake_quant(vals):
+    """Round-trip ``vals`` (…, head_dim) through the kv8 storage format:
+    one symmetric int8 scale per (…,) row over head_dim — the SAME math
+    ``serving.kv_pages`` applies on scatter, computed with the same op
+    order, so a dense cache fake-quantized with this is bit-identical
+    to a quantized pool gathered back (pinned in tests/test_quant.py)."""
+    import jax.numpy as jnp
+
+    v = vals.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(v), axis=-1)
+    s = jnp.maximum(amax, _EPS) / 127.0
+    q = jnp.clip(jnp.round(v / s[..., None]), -127, 127).astype(jnp.int8)
+    return (q.astype(jnp.float32) * s[..., None]).astype(vals.dtype)
+
+
+def quant_report(model, params, qparams, *, prompt,
+                 max_new_tokens: int = 16, kv8: bool = False,
+                 cache_dtype=None) -> dict:
+    """Greedy-decode quality report: f32 reference vs the quantized
+    path, teacher-forced on the reference's tokens so every step's
+    logits compare like-for-like. Returns::
+
+        {"agreement": float,       # argmax match rate over decode steps
+         "logit_max_err": float,   # max |logits_q - logits_f32|
+         "steps": int}
+
+    ``kv8`` additionally round-trips the quantized path's cache rows
+    through the 8-bit storage format after every write (prefill rows
+    once, each decoded token's row as it lands) — exactly the pool
+    semantics, on a dense cache.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    toks = jnp.asarray(prompt, jnp.int32)[None, :]
+    p = int(toks.shape[1])
+    max_len = p + int(max_new_tokens)
+    dt = cache_dtype if cache_dtype is not None else jnp.float32
+
+    prefill = jax.jit(model.prefill_logits)
+    decode = jax.jit(model.decode_logits)
+
+    @jax.jit
+    def _fq_row(cache, pos):
+        # fake-quant the single cache row at ``pos`` on every leaf —
+        # the decode-step quantize-on-write
+        def f(leaf):
+            row = jax.lax.dynamic_slice_in_dim(leaf, pos, 1, axis=2)
+            return jax.lax.dynamic_update_slice_in_dim(
+                leaf, kv_fake_quant(row), pos, axis=2)
+        return jax.tree_util.tree_map(f, cache)
+
+    def run(ps, fake, forced):
+        cache = model.encoder.init_cache(1, max_len, dt)
+        logits, cache = prefill(ps, toks, cache)
+        if fake:
+            cache = jax.tree_util.tree_map(
+                lambda leaf: leaf.at[:, :, :p, :].set(
+                    kv_fake_quant(leaf[:, :, :p, :])), cache)
+        outs = [logits]
+        for i in range(int(max_new_tokens) - 1):
+            tok = (forced[i] if forced is not None
+                   else jnp.argmax(outs[-1], -1).astype(jnp.int32))[:, None]
+            pos = p + i
+            logits, cache = decode(ps, tok, cache, jnp.int32(pos))
+            if fake:
+                cache = _fq_row(cache, jnp.int32(pos))
+            outs.append(logits)
+        return jnp.stack(outs, 0)  # (steps, 1, vocab)
+
+    import numpy as np
+    ref = np.asarray(run(params, False, None))
+    forced = [jnp.asarray(t) for t in
+              np.argmax(ref, -1).astype(np.int32)]
+    got = np.asarray(run(qparams, kv8, forced))
+    agree = float(np.mean(np.argmax(ref, -1) == np.argmax(got, -1)))
+    err = float(np.max(np.abs(ref.astype(np.float64)
+                              - got.astype(np.float64))))
+    return {"agreement": agree, "logit_max_err": err,
+            "steps": int(ref.shape[0])}
+
+
+def _register():
+    import jax
+    jax.tree_util.register_pytree_node_class(QuantizedWeight)
+
+
+_register()

@@ -96,23 +96,61 @@ class ServingSharding:
     def param_specs(self, module, params):
         """PartitionSpec pytree for ``params`` — the training-side
         Megatron layout (column/row pairing, head-divisibility gates)
-        whenever tp > 1, fully replicated otherwise."""
+        whenever tp > 1, fully replicated otherwise.
+
+        Quantized trees (ISSUE 17): spec building runs over a SHADOW
+        tree with each :class:`~bigdl_tpu.serving.quant.QuantizedWeight`
+        replaced by a logical-f32 ShapeDtypeStruct — the spec builders'
+        bare tree_maps would otherwise descend into the node and
+        reconstruct QuantizedWeights holding PartitionSpecs. The
+        returned tree carries ONE spec at each quantized position (the
+        weight's); :meth:`place_params` derives the scale's from it."""
         import jax
 
+        from bigdl_tpu.serving.quant import is_quantized
+
+        shadow = jax.tree_util.tree_map(
+            lambda p: (jax.ShapeDtypeStruct(p.shape, p.dtype)
+                       if is_quantized(p) else p),
+            params, is_leaf=is_quantized)
         if self.n_shard <= 1:
-            return jax.tree_util.tree_map(lambda _: self._P(), params)
+            return jax.tree_util.tree_map(lambda _: self._P(), shadow)
         from bigdl_tpu.parallel.tensor_parallel import megatron_specs
-        return megatron_specs(module, params, self.axis, self.n_shard)
+        return megatron_specs(module, shadow, self.axis, self.n_shard)
+
+    def scale_spec(self, weight_spec):
+        """Placement of a quantized weight's per-output-channel scale
+        vector: split exactly when the weight's axis 1 is split (the
+        scale indexes output channels), replicated otherwise (row-split
+        weights contract over their axis 0 — every shard needs every
+        output scale)."""
+        ws = tuple(weight_spec)
+        if len(ws) >= 2 and ws[1] is not None:
+            return self._P(ws[1])
+        return self._P()
 
     def place_params(self, module, params):
-        """Commit ``params`` to the mesh under the Megatron layout."""
+        """Commit ``params`` to the mesh under the Megatron layout.
+        Quantized leaves place their int8 tensor under the weight's
+        spec and the scale under :meth:`scale_spec`."""
         import jax
         from jax.sharding import NamedSharding
 
+        from bigdl_tpu.serving.quant import QuantizedWeight, is_quantized
+
         specs = self.param_specs(module, params)
-        return jax.tree_util.tree_map(
-            lambda p, s: jax.device_put(p, NamedSharding(self.mesh, s)),
-            params, specs)
+
+        def put(p, s):
+            if is_quantized(p):
+                return QuantizedWeight(
+                    jax.device_put(p.q, NamedSharding(self.mesh, s)),
+                    jax.device_put(p.scale, NamedSharding(
+                        self.mesh, self.scale_spec(s))),
+                    p.fmt)
+            return jax.device_put(p, NamedSharding(self.mesh, s))
+
+        return jax.tree_util.tree_map(put, params, specs,
+                                      is_leaf=is_quantized)
 
     # ----------------------------------------------------------------- kv
     def kv_spec(self, leaf):

@@ -6,12 +6,13 @@ ops/conv2d.py (per-pass layouts), ops/attention_kernel.py (flash block
 sizes) and ops/bn_kernel.py (stats row block).
 """
 
-from bigdl_tpu.tuning.autotune import (MODES, annotation, bn_row_block,
+from bigdl_tpu.tuning.autotune import (MODES, QUANT_MATMUL_KINDS,
+                                       annotation, bn_row_block,
                                        conv_geom_key, conv_geom_layout,
                                        dry_run, fba_row_block, flash_blocks,
                                        get_cache, get_mode,
                                        grad_bucket_bytes,
-                                       kv_page_tokens,
+                                       kv_page_tokens, quant_matmul_kind,
                                        install_conv_layouts,
                                        make_key, put_geom_decisions,
                                        reset, reset_decisions,
@@ -19,9 +20,10 @@ from bigdl_tpu.tuning.autotune import (MODES, annotation, bn_row_block,
 from bigdl_tpu.tuning.cache import (CACHE_VERSION, AutotuneCache, cache_dir,
                                     cache_path, device_kind, device_slug)
 
-__all__ = ["MODES", "set_mode", "get_mode", "dry_run", "make_key",
+__all__ = ["MODES", "QUANT_MATMUL_KINDS",
+           "set_mode", "get_mode", "dry_run", "make_key",
            "flash_blocks", "bn_row_block", "fba_row_block",
-           "grad_bucket_bytes", "kv_page_tokens",
+           "grad_bucket_bytes", "kv_page_tokens", "quant_matmul_kind",
            "install_conv_layouts", "conv_geom_key", "conv_geom_layout",
            "put_geom_decisions",
            "annotation", "reset", "reset_decisions", "get_cache",

@@ -36,7 +36,7 @@ from bigdl_tpu.tuning.cache import AutotuneCache
 
 __all__ = ["MODES", "set_mode", "get_mode", "dry_run", "make_key",
            "flash_blocks", "bn_row_block", "fba_row_block",
-           "grad_bucket_bytes", "kv_page_tokens",
+           "grad_bucket_bytes", "kv_page_tokens", "quant_matmul_kind",
            "install_conv_layouts", "conv_geom_layout", "conv_geom_key",
            "peek_geom_layout", "put_geom_decisions",
            "annotation", "reset", "reset_decisions", "get_cache"]
@@ -59,6 +59,12 @@ BN_ROW_BLOCKS = (128, 256, 512, 1024, 2048)
 # default: small enough to keep several reduces in flight behind the
 # backward, large enough to amortize per-collective launch latency
 GRAD_BUCKET_BYTES = tuple(m * 2 ** 20 for m in (1, 2, 4, 8, 16))
+
+# quantized-matmul spellings swept per shape (ISSUE 17): the dequant-
+# fused epilogue (always correct, default) vs a native int8 dot_general
+# with i32 accumulation (wins where the MXU multiplies int8 natively
+# and the per-row activation-quant prologue amortizes)
+QUANT_MATMUL_KINDS = ("dequant", "native-int8")
 
 # KV page sizes swept for the paged decode cache (ISSUE 14): small pages
 # cut allocation waste on short requests, large pages cut the gather's
@@ -332,6 +338,30 @@ def kv_page_tokens(max_len: int, kv_heads: int, head_dim: int,
 
     config, _ = _resolve(key, default, _measure)
     return int(config["page_tokens"])
+
+
+def quant_matmul_kind(m: int, k: int, n: int, dtype) -> str:
+    """Tuned quantized-matmul spelling for one (m, k, n, dtype) shape
+    (``quant`` namespace; ISSUE 17): ``"dequant"`` — the fused
+    dequant-epilogue matmul, always available — or ``"native-int8"`` —
+    int8 ``dot_general`` with i32 accumulation plus dynamic per-row
+    activation quant. Consulted at trace time by the serving engines'
+    :class:`bigdl_tpu.serving.quant.QuantizedWeight` views; off mode
+    keeps the shipped dequant default so ``--quantize`` alone never
+    changes which kernel serves."""
+    if _MODE == "off":
+        return "dequant"
+    key = make_key("quant", m=int(m), k=int(k), n=int(n),
+                   dtype=_dtype_name(dtype))
+    default = {"kind": "dequant"}
+
+    def _measure():
+        from bigdl_tpu.tuning.measure import measure_quant_matmul
+        return measure_quant_matmul(int(m), int(k), int(n), dtype)
+
+    config, _ = _resolve(key, default, _measure)
+    kind = str(config.get("kind", "dequant"))
+    return kind if kind in QUANT_MATMUL_KINDS else "dequant"
 
 
 def conv_geom_key(pass_name: str, geom: tuple) -> str:

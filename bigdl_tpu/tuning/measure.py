@@ -21,7 +21,7 @@ from typing import Dict, List, Sequence, Tuple
 __all__ = ["time_fn", "measure_flash_blocks", "measure_bn_row_block",
            "measure_fba_row_block", "measure_conv_layouts",
            "measure_conv_geom", "measure_grad_buckets",
-           "measure_kv_page_tokens",
+           "measure_kv_page_tokens", "measure_quant_matmul",
            "CONV_PROBE_SHAPES"]
 
 _WARMUP = 1
@@ -328,4 +328,42 @@ def measure_kv_page_tokens(max_len: int, kv_heads: int, head_dim: int,
         fn = jax.jit(roundtrip)
         ms = time_fn(fn, pool)  # pool-shaped output: calls chain
         timed.append(({"page_tokens": int(pt)}, ms))
+    return _pick(timed)
+
+
+def measure_quant_matmul(m: int, k: int, n: int, dtype
+                         ) -> Tuple[dict, float]:
+    """Time the two quantized-matmul spellings for one (m, k, n)
+    activation/weight shape (ISSUE 17): the dequant-fused epilogue
+    (``(x @ q.astype(dt)) * s``) vs the native int8 ``dot_general``
+    with i32 accumulation plus the dynamic activation-quant prologue.
+    Candidate order puts dequant first so exact ties keep the shipped
+    default. Returns ({"kind": best}, best_ms)."""
+    import jax
+    import jax.numpy as jnp
+
+    kx, kw = jax.random.split(jax.random.PRNGKey(0))
+    x = jax.random.normal(kx, (m, k), dtype)
+    q = jax.random.randint(kw, (k, n), -127, 128, jnp.int8)
+    s = jnp.full((n,), 0.01, jnp.float32)
+
+    def dequant(x_):
+        return (x_ @ q.astype(x_.dtype)) * s.astype(x_.dtype)
+
+    def native(x_):
+        xf = x_.astype(jnp.float32)
+        xs = jnp.maximum(jnp.max(jnp.abs(xf), axis=-1, keepdims=True),
+                         1e-8) / 127.0
+        xq = jnp.clip(jnp.round(xf / xs), -127, 127).astype(jnp.int8)
+        acc = jax.lax.dot_general(
+            xq, q, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32)
+        return acc.astype(x_.dtype) * xs.astype(x_.dtype) \
+            * s.astype(x_.dtype)
+
+    timed: List[Tuple[dict, float]] = []
+    for kind, fn in (("dequant", dequant), ("native-int8", native)):
+        jitted = jax.jit(fn)
+        ms = time_fn(jitted, x)  # (m, n) output: re-invokes
+        timed.append(({"kind": kind}, ms))
     return _pick(timed)

@@ -440,6 +440,109 @@ def run_spec_smoke(args):
     return 0
 
 
+_QUANT_AGREE_MIN = 0.9       # client-side A/B token agreement floor
+_QUANT_GUARDRAIL_MIN = 0.98  # server-measured quant_report floor
+_QUANT_SLOT_FACTOR = 2.0     # kv8 must admit >= 2x slots at equal HBM
+
+
+def _kv_slot_capacity(page_tokens=16, max_len=64, dense_slots=8):
+    """In-process PagedKvCache A/B at EQUAL pool bytes: size both pools
+    to the HBM budget ``dense_slots`` full-length slots cost in f32,
+    then count how many slots each variant actually admits via
+    ``reserve()`` — the real allocator, not arithmetic."""
+    if REPO not in sys.path:  # the spawned servers get cwd=REPO; we
+        sys.path.insert(0, REPO)  # import in-process for the allocator
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    from bigdl_tpu import models
+    from bigdl_tpu.serving.kv_pages import PagedKvCache, pages_needed
+
+    model = models.transformer_lm(64, d_model=32, num_layers=2,
+                                  num_heads=2, max_len=max_len)
+    per_slot = pages_needed(max_len, page_tokens)
+
+    def probe_bpp(quantized):
+        return PagedKvCache(model.encoder, slots=1, max_len=max_len,
+                            page_tokens=page_tokens, dtype=jnp.float32,
+                            pool_pages=2,
+                            quantized=quantized).bytes_per_page
+
+    budget = probe_bpp(False) * per_slot * dense_slots
+    out = {}
+    for name, quantized in (("off", False), ("int8+kv8", True)):
+        bpp = probe_bpp(quantized)
+        kv = PagedKvCache(model.encoder, slots=budget // bpp,
+                          max_len=max_len, page_tokens=page_tokens,
+                          dtype=jnp.float32, pool_pages=budget // bpp,
+                          quantized=quantized)
+        admitted = 0
+        while admitted < kv.slots and kv.reserve(admitted, max_len):
+            admitted += 1
+        out[name] = {"slots": admitted, "bytes_per_page": int(bpp),
+                     "pool_bytes": int(bpp * kv.pool_pages)}
+    out["budget_bytes"] = int(budget)
+    return out
+
+
+def run_quant_smoke(args):
+    """ISSUE 17 quantized-serving assertion pass (CI quant-smoke leg):
+
+    A/B the same tiny LM under --quantize off and --quantize int8+kv8
+    with one fixed greedy /generate prompt. Asserts: the quantized
+    output agrees with the full-precision one position-wise at >=
+    _QUANT_AGREE_MIN; every server's provenance stamps its quantize
+    mode; the quantized server carries the measured quant_report
+    guardrail (agreement >= _QUANT_GUARDRAIL_MIN, finite logit error);
+    and — the capacity headline — an in-process PagedKvCache A/B at
+    EQUAL pool bytes admits >= 2x the slots with 8-bit pools."""
+    prompt = list(range(1, 13))
+    body = {"tokens": prompt, "max_new_tokens": 16}
+    results = {}
+    for mode in ("off", "int8+kv8"):
+        extra = list(args.serveArg) + ["--quantize", mode]
+        proc, url, log_lines = spawn_server(args, extra)
+        try:
+            st, out = _post(url + "/generate", body)
+            assert st == 200, f"--quantize {mode} /generate -> {st}"
+            prov, _page = scrape_provenance(url)
+            assert prov is not None, "metrics page lost its provenance"
+            assert prov.get("quantize") == mode, \
+                f"provenance quantize missing/wrong under {mode}: {prov}"
+            results[mode] = (out["tokens"], prov)
+        finally:
+            _shutdown_clean(proc, log_lines)
+    base, quant = results["off"][0], results["int8+kv8"][0]
+    assert len(base) == len(quant) > 0, (base, quant)
+    agree = sum(a == b for a, b in zip(base, quant)) / len(base)
+    assert agree >= _QUANT_AGREE_MIN, (
+        f"int8+kv8 greedy agreement {agree:.2f} < {_QUANT_AGREE_MIN}:\n"
+        f"  off  {base}\n  int8 {quant}")
+    qprov = results["int8+kv8"][1]
+    assert qprov.get("quant_agreement", 0) >= _QUANT_GUARDRAIL_MIN, qprov
+    assert qprov.get("quant_logit_max_err") is not None, qprov
+    assert results["off"][1].get("quant_agreement") is None, \
+        "off must not pay (or stamp) the quant_report guardrail"
+    cap = _kv_slot_capacity()
+    factor = cap["int8+kv8"]["slots"] / max(1, cap["off"]["slots"])
+    assert factor >= _QUANT_SLOT_FACTOR, (
+        f"kv8 admitted only {factor:.2f}x slots at equal HBM: {cap}")
+    record = {"bench": "serving_quant_smoke", "prompt_len": len(prompt),
+              "max_new_tokens": 16, "agreement": round(agree, 4),
+              "quant_agreement": qprov.get("quant_agreement"),
+              "quant_logit_max_err": qprov.get("quant_logit_max_err"),
+              "slots_off": cap["off"]["slots"],
+              "slots_int8_kv8": cap["int8+kv8"]["slots"],
+              "slot_factor": round(factor, 2),
+              "kv_budget_bytes": cap["budget_bytes"]}
+    print(json.dumps(record), flush=True)
+    print(f"quant-smoke: int8+kv8 agreement={agree:.2f}, guardrail="
+          f"{qprov.get('quant_agreement')}, slots "
+          f"{cap['off']['slots']} -> {cap['int8+kv8']['slots']} "
+          f"({factor:.1f}x) at equal HBM OK", flush=True)
+    return 0
+
+
 def run_slo_smoke(args):
     """ISSUE 15 assertion pass (CI slo-smoke leg), two servers:
 
@@ -875,6 +978,13 @@ def main(argv=None):
                         " --speculate 4 /generate bit-identical to "
                         "--speculate 0, non-zero accept rate, >1 "
                         "accepted-tokens/step (spawns its own servers)")
+    p.add_argument("--quantSmoke", action="store_true",
+                   help="quantized-serving assertion pass (ISSUE 17): "
+                        "--quantize int8+kv8 /generate agrees with "
+                        "--quantize off, quantize + measured guardrail "
+                        "stamped in provenance, and 8-bit KV pools "
+                        "admit >= 2x the slots at equal pool bytes "
+                        "(spawns its own servers)")
     p.add_argument("--sloSmoke", action="store_true",
                    help="per-request observability assertion pass "
                         "(ISSUE 15): TTFT/TPOT histograms populate, "
@@ -923,6 +1033,8 @@ def main(argv=None):
         return run_chaos_smoke(args)
     if args.specSmoke:
         return run_spec_smoke(args)
+    if args.quantSmoke:
+        return run_quant_smoke(args)
     if args.sloSmoke:
         return run_slo_smoke(args)
     if args.tpSmoke:
@@ -955,6 +1067,15 @@ def main(argv=None):
                 # server-side request-latency columns next to the
                 # client-side quantiles (None when --reqTrace off)
                 res["server_latency_ms"] = scrape_server_latency(page)
+                # quant columns (ISSUE 17): mode + measured guardrail
+                # ride every /generate record, "off" included, so A/B
+                # lines are self-describing
+                res["quant"] = {
+                    "quantize": (prov or {}).get("quantize", "off"),
+                    "agreement": (prov or {}).get("quant_agreement"),
+                    "logit_max_err":
+                        (prov or {}).get("quant_logit_max_err"),
+                }
             print(json.dumps(res), flush=True)
     finally:
         if proc is not None:

@@ -1,0 +1,112 @@
+#!/usr/bin/env bash
+# Round-21 capture: ISSUE 17 (quantized serving) chip evidence.
+# The correctness contracts are CPU-verified (tests/test_quant.py, the
+# tier1 quant-smoke job): greedy-token identity off vs int8+kv8, kv8
+# bitwise pool parity, the quant_report guardrail, and the >= 2x
+# slots-at-equal-HBM count through the real allocator. What only
+# hardware can tell us is the WIN: (a) weight A/B — off vs int8 vs fp8
+# per-token latency + HBM on the SAME one-stream workload (dequant rides
+# the matmul epilogue; fp8 additionally exercises the native fp8 path on
+# chips that have it); (b) the kv8 slot sweep — --slots pushed past the
+# f32 HBM ceiling under --quantize int8+kv8 at fixed geometry, the
+# measured counterpart of the forecaster's ~2x; (c) composed legs —
+# quantize under tp:2 and under --speculate (accept-rate delta is part
+# of the evidence). Appends to $OUT, mirrored into the repo per step.
+
+set -uo pipefail
+cd "$(dirname "$0")/.."
+OUT="${OUT:-/tmp/tpu_capture_r21.log}"
+REPO_LOG="${REPO_LOG:-TPU_CAPTURE_r21.log}"
+trap 'cp -f "$OUT" "$REPO_LOG" 2>/dev/null || true' EXIT
+
+step() {
+  local name="$1" tmo="$2"; shift 2
+  echo "=== $name ($(date -u +%H:%M:%SZ))" | tee -a "$OUT"
+  timeout "$tmo" "$@" 2>&1 | tail -40 | tee -a "$OUT"
+  echo "=== end $name rc=$?" | tee -a "$OUT"
+  cp -f "$OUT" "$REPO_LOG" 2>/dev/null || true
+}
+
+# identical serving geometry + workload to tpu_capture_r18..r20.sh so
+# the r21 quantization numbers read directly against those slots
+LM="--serveArg=--vocabSize --serveArg=32000 \
+    --serveArg=--dModel --serveArg=1024 \
+    --serveArg=--numLayers --serveArg=8 \
+    --serveArg=--numHeads --serveArg=16 \
+    --serveArg=--seq --serveArg=1024 \
+    --serveArg=--slots --serveArg=8"
+GEN="--model transformer_lm --endpoint generate \
+     --requests 32 --promptLen 128 --maxNewTokens 128"
+# kv8 needs page-aligned pools; 128 divides seq 1024 on every leg
+PAGED="--serveArg=--kvPageTokens --serveArg=128"
+
+# 0. the quant test file + the full A/B assertion pass on this env
+step "pytest_quant" 900 env JAX_PLATFORMS=cpu python -m pytest \
+  tests/test_quant.py -q
+step "quant_smoke" 900 python scripts/serving_bench.py \
+  --quantSmoke --model transformer_lm
+
+# 1. weight-format A/B x3 — one stream (c1), per-token latency. Every
+#    quantized JSON line carries quantize= + quant_agreement +
+#    quant_logit_max_err in provenance (the guardrail numbers PERF.md
+#    §24 records next to the speed). Acceptance: int8/fp8 p50 at or
+#    under off on the SAME workload, agreement >= 0.98.
+for REP in 1 2 3; do
+  for MODE in off int8 fp8; do
+    # shellcheck disable=SC2086
+    step "w_${MODE}_rep${REP}" 1800 python scripts/serving_bench.py \
+      $GEN $LM --concurrency 1 \
+      --serveArg=--quantize --serveArg="$MODE" || true
+  done
+done
+
+# 2. THE r21 leg — kv8 slot sweep at fixed HBM. f32 pools OOM-bound
+#    the slot count; int8+kv8 at the same geometry must serve >= 2x
+#    the slots (forecaster prediction: explain --mem --quantize). Walk
+#    slots up under both modes; the last slot count that serves without
+#    RESOURCE_EXHAUSTED is the measured ceiling for §24.
+for SLOTS in 8 16 24 32 48 64; do
+  # shellcheck disable=SC2086
+  step "kv_f32_s${SLOTS}" 1800 python scripts/serving_bench.py \
+    $GEN --concurrency 8 $PAGED \
+    --serveArg=--vocabSize --serveArg=32000 \
+    --serveArg=--dModel --serveArg=1024 \
+    --serveArg=--numLayers --serveArg=8 \
+    --serveArg=--numHeads --serveArg=16 \
+    --serveArg=--seq --serveArg=1024 \
+    --serveArg=--slots --serveArg="$SLOTS" || true
+  # shellcheck disable=SC2086
+  step "kv_kv8_s${SLOTS}" 1800 python scripts/serving_bench.py \
+    $GEN --concurrency 8 $PAGED \
+    --serveArg=--quantize --serveArg=int8+kv8 \
+    --serveArg=--vocabSize --serveArg=32000 \
+    --serveArg=--dModel --serveArg=1024 \
+    --serveArg=--numLayers --serveArg=8 \
+    --serveArg=--numHeads --serveArg=16 \
+    --serveArg=--seq --serveArg=1024 \
+    --serveArg=--slots --serveArg="$SLOTS" || true
+done
+
+# 3. composed legs: quantize under tp:2 (scale placement on real
+#    chips) and under speculative decode (accept-rate delta vs the
+#    unquantized speculative run is part of the §24 evidence).
+# shellcheck disable=SC2086
+step "q_tp2" 1800 python scripts/serving_bench.py $GEN $LM \
+  --concurrency 1 --strategy tp:2 \
+  --serveArg=--quantize --serveArg=int8+kv8 $PAGED || true
+# shellcheck disable=SC2086
+step "q_spec" 1800 python scripts/serving_bench.py $GEN $LM \
+  --concurrency 1 $PAGED \
+  --serveArg=--speculate --serveArg=4 || true
+# shellcheck disable=SC2086
+step "q_spec_int8kv8" 1800 python scripts/serving_bench.py $GEN $LM \
+  --concurrency 1 $PAGED \
+  --serveArg=--quantize --serveArg=int8+kv8 \
+  --serveArg=--speculate --serveArg=4 || true
+
+# 4. the forecaster's prediction for this geometry, for the §24 table
+step "forecast" 300 env JAX_PLATFORMS=cpu python -m bigdl_tpu.cli.main \
+  explain --mem transformer_lm --json --quantize int8+kv8
+
+# 5. summarize every JSON line in this log for PERF.md §24
+step "summarize" 300 python scripts/update_perf_from_capture.py "$OUT"

@@ -1,0 +1,451 @@
+"""Quantized serving tests (ISSUE 17): weight round-trip error bounds,
+the dequant-fused matmul protocol (epilogue, transposed tied-head
+prologue, embedding gather), quantize_params key selection and
+idempotence, the quant_report quality guardrail (greedy agreement +
+logit max-error pinned on the test LM), kv8 pool bitwise parity with
+the dense fake-quant reference, paged int8+kv8 engine parity under slot
+churn (speculative + prefix-cache composed), tp:2 token identity on
+virtual devices with the scale placement pins, ``--quantize off``
+identity, the ``quant-dequant-upcast`` lint rule, the ~2x slot
+forecast, dtype-aware kv_page_plan sublanes, and the ``quant`` autotune
+namespace round-trip."""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bigdl_tpu import models, tuning
+from bigdl_tpu.serving import DecodeEngine, serving_mesh
+from bigdl_tpu.serving import kv_pages as kvp
+from bigdl_tpu.serving import quant as q
+from bigdl_tpu.serving.kv_pages import PagedKvCache
+from bigdl_tpu.serving.quant import (QuantizedWeight, is_quantized,
+                                     kv_fake_quant, parse_quantize,
+                                     quant_report, quantize_params,
+                                     quantize_weight)
+
+
+@pytest.fixture(scope="module")
+def tiny_lm():
+    m = models.transformer_lm(50, d_model=32, num_layers=2, num_heads=2,
+                              max_len=64)
+    return m, m.init(jax.random.PRNGKey(1))
+
+
+PROMPTS = [[3, 9, 44, 1], [7, 7, 12, 30, 2], [49, 1, 2], [8, 41]]
+
+
+def _decode_tokens(model, params, prompts, n=8, **kw):
+    eng = DecodeEngine(model, params, slots=2, **kw)
+    try:
+        return [eng.generate(p, n) for p in prompts]
+    finally:
+        eng.close()
+
+
+# ---------------------------------------------------------- mode parsing
+class TestParseQuantize:
+    def test_modes(self):
+        assert parse_quantize(None) == (None, False)
+        assert parse_quantize("off") == (None, False)
+        assert parse_quantize("int8") == ("int8", False)
+        assert parse_quantize("kv8") == (None, True)
+        assert parse_quantize("int8+kv8") == ("int8", True)
+        wfmt, kv8 = parse_quantize("fp8+kv8")
+        assert kv8 and wfmt in ("fp8", "int8")  # int8 = capability fallback
+
+    def test_fp8_capability_not_version(self):
+        wfmt, _ = parse_quantize("fp8")
+        assert wfmt == ("fp8" if q.fp8_supported() else "int8")
+
+    def test_bad_mode_raises(self):
+        with pytest.raises(ValueError, match="--quantize"):
+            parse_quantize("int4")
+
+
+# ------------------------------------------------------- weight round-trip
+class TestQuantizedWeight:
+    def test_int8_roundtrip_error_bound(self, rng):
+        w = jax.random.normal(rng, (64, 48), jnp.float32)
+        qw = quantize_weight(w, "int8")
+        rel = float(jnp.max(jnp.abs(qw.dequantize() - w))
+                    / jnp.max(jnp.abs(w)))
+        assert rel < 0.01, rel  # symmetric per-channel: < 1% of amax
+
+    @pytest.mark.skipif(not q.fp8_supported(),
+                        reason="no float8_e4m3fn in this jax build")
+    def test_fp8_roundtrip_error_bound(self, rng):
+        w = jax.random.normal(rng, (64, 48), jnp.float32)
+        qw = quantize_weight(w, "fp8")
+        assert qw.q.dtype == jnp.float8_e4m3fn
+        rel = float(jnp.max(jnp.abs(qw.dequantize() - w))
+                    / jnp.max(jnp.abs(w)))
+        assert rel < 0.08, rel  # e4m3: ~2^-3 relative steps
+
+    def test_logical_surface_and_footprint(self, rng):
+        w = jax.random.normal(rng, (64, 48), jnp.float32)
+        qw = quantize_weight(w, "int8")
+        assert qw.shape == (64, 48) and qw.ndim == 2
+        assert qw.dtype == jnp.float32  # LOGICAL dtype: spec builders
+        dense = w.nbytes
+        assert qw.nbytes == 64 * 48 * 1 + 48 * 4
+        assert qw.nbytes < dense / 3  # the storage win itself
+
+    def test_pytree_roundtrip(self, rng):
+        qw = quantize_weight(jax.random.normal(rng, (8, 8)), "int8")
+        leaves, treedef = jax.tree_util.tree_flatten(qw)
+        assert len(leaves) == 2
+        back = jax.tree_util.tree_unflatten(treedef, leaves)
+        assert is_quantized(back) and back.fmt == "int8"
+
+    def test_epilogue_matches_dense(self, rng):
+        k1, k2 = jax.random.split(rng)
+        w = jax.random.normal(k1, (32, 24), jnp.float32)
+        x = jax.random.normal(k2, (4, 32), jnp.float32)
+        qw = quantize_weight(w, "int8")
+        # the exact module spelling: x @ params["weight"].astype(x.dtype)
+        got = jax.jit(lambda x: x @ qw.astype(x.dtype))(x)
+        want = x @ qw.dequantize()
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_transposed_prologue_matches_dense(self, rng):
+        k1, k2 = jax.random.split(rng)
+        w = jax.random.normal(k1, (50, 32), jnp.float32)  # tied emb
+        h = jax.random.normal(k2, (4, 32), jnp.float32)
+        qw = quantize_weight(w, "int8")
+        got = jax.jit(lambda h: h @ qw.astype(h.dtype).T)(h)
+        want = h @ qw.dequantize().T
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_take_rows_matches_dequantized_gather(self, rng):
+        qw = quantize_weight(jax.random.normal(rng, (50, 16)), "int8")
+        idx = jnp.asarray([[0, 7, 49]], jnp.int32)
+        got = qw.take_rows(idx)
+        want = jnp.take(qw.dequantize(), idx, axis=0)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-6, atol=1e-6)
+
+
+class TestQuantizeParams:
+    def test_selects_projection_keys_only(self, tiny_lm):
+        _, params = tiny_lm
+        qp = quantize_params(params, "int8")
+        flat = jax.tree_util.tree_flatten_with_path(
+            qp, is_leaf=is_quantized)[0]
+        quant_keys = {str(path[-1]) for path, leaf in flat
+                      if is_quantized(leaf)}
+        assert quant_keys  # the projections went 8-bit
+        for path, leaf in flat:
+            if not is_quantized(leaf):
+                # everything left behind is a bias/norm/1-D leaf or a
+                # non-projection key — never an eligible 2-D projection
+                name = path[-1].key if hasattr(path[-1], "key") else None
+                assert not (name in q._QUANT_KEYS
+                            and getattr(leaf, "ndim", 0) == 2
+                            and jnp.issubdtype(leaf.dtype, jnp.floating))
+
+    def test_idempotent_and_off(self, tiny_lm):
+        _, params = tiny_lm
+        qp = quantize_params(params, "int8")
+        qp2 = quantize_params(qp, "int8")
+        a = jax.tree_util.tree_leaves(qp, is_leaf=is_quantized)
+        b = jax.tree_util.tree_leaves(qp2, is_leaf=is_quantized)
+        assert all(x is y for x, y in zip(a, b) if is_quantized(x))
+        assert quantize_params(params, None) is params
+
+
+# --------------------------------------------------------- quality report
+class TestQuantReport:
+    def test_int8_agreement_and_logit_error(self, tiny_lm):
+        model, params = tiny_lm
+        rep = quant_report(model, params, quantize_params(params, "int8"),
+                           prompt=PROMPTS[0], max_new_tokens=8)
+        assert rep["steps"] == 8
+        assert rep["agreement"] >= 0.99, rep
+        assert 0.0 < rep["logit_max_err"] < 0.5, rep
+
+    def test_kv8_report_and_identity(self, tiny_lm):
+        model, params = tiny_lm
+        rep = quant_report(model, params, quantize_params(params, "int8"),
+                           prompt=PROMPTS[0], max_new_tokens=8, kv8=True)
+        assert rep["agreement"] >= 0.99, rep
+        # identical params, no fake-quant: the report machinery itself
+        # must measure exactly zero error
+        ident = quant_report(model, params, params, prompt=PROMPTS[0],
+                             max_new_tokens=4)
+        assert ident["agreement"] == 1.0
+        assert ident["logit_max_err"] == 0.0
+
+
+# ------------------------------------------------------------- kv8 pools
+class TestQuantPools:
+    def _paged(self, model, quantized, page_tokens=16, slots=2):
+        return PagedKvCache(model.encoder, slots=slots, max_len=64,
+                            page_tokens=page_tokens, dtype=jnp.float32,
+                            quantized=quantized)
+
+    def test_scatter_gather_bitwise_matches_fake_quant(self, tiny_lm,
+                                                       rng):
+        model, _ = tiny_lm
+        kv = self._paged(model, quantized=True)
+        assert kv.reserve(0, 64)
+        cache = jax.tree_util.tree_map(
+            lambda a: jax.random.normal(rng, (1,) + a.shape[1:4][:1]
+                                        + (64,) + a.shape[3:4],
+                                        jnp.float32),
+            model.encoder.init_cache(1, 64, jnp.float32))
+        pages = jnp.asarray(kv.page_table[0], jnp.int32)
+        pools = kvp.scatter_pages(kv.pools, cache, pages)
+        got = kvp.gather_cache(pools, pages)
+        want = jax.tree_util.tree_map(lambda c: kv_fake_quant(c[0]),
+                                      cache)
+        for g, w in zip(jax.tree_util.tree_leaves(got),
+                        jax.tree_util.tree_leaves(want)):
+            assert np.array_equal(np.asarray(g), np.asarray(w))  # BITWISE
+
+    def test_scatter_tokens_quantizes_on_write(self, tiny_lm, rng):
+        model, _ = tiny_lm
+        kv = self._paged(model, quantized=True)
+        assert kv.reserve(0, 64)
+        tok = jax.tree_util.tree_map(
+            lambda a: jax.random.normal(rng, (1, a.shape[1], a.shape[3]),
+                                        jnp.float32),
+            model.encoder.init_cache(1, 64, jnp.float32))
+        pid = jnp.asarray([kv.page_table[0, 0]], jnp.int32)
+        off = jnp.asarray([5], jnp.int32)
+        pools = kvp.scatter_tokens(kv.pools, tok, pid, off)
+        got = kvp.gather_cache(pools, jnp.asarray(kv.page_table[0],
+                                                  jnp.int32))
+        for g, t in zip(jax.tree_util.tree_leaves(got),
+                        jax.tree_util.tree_leaves(tok)):
+            assert np.array_equal(np.asarray(g[:, 5, :]),
+                                  np.asarray(kv_fake_quant(t)[0]))
+
+    def test_copy_pages_verbatim_no_requant(self, tiny_lm, rng):
+        model, _ = tiny_lm
+        kv = self._paged(model, quantized=True, slots=3)
+        assert kv.reserve(0, 64) and kv.reserve(1, 64)
+        cache = jax.tree_util.tree_map(
+            lambda a: jax.random.normal(rng, (1, a.shape[1], 64,
+                                              a.shape[3]), jnp.float32),
+            model.encoder.init_cache(1, 64, jnp.float32))
+        src = jnp.asarray(kv.page_table[0], jnp.int32)
+        dst = jnp.asarray(kv.page_table[1], jnp.int32)
+        pools = kvp.scatter_pages(kv.pools, cache, src)
+        pools = kvp.copy_pages(pools, src, dst)
+        a = kvp.gather_cache(pools, src)
+        b = kvp.gather_cache(pools, dst)
+        for x, y in zip(jax.tree_util.tree_leaves(a),
+                        jax.tree_util.tree_leaves(b)):
+            assert np.array_equal(np.asarray(x), np.asarray(y))
+
+    def test_bytes_per_page_quarters(self, tiny_lm):
+        model, _ = tiny_lm
+        dense = self._paged(model, quantized=False).bytes_per_page
+        kv8 = self._paged(model, quantized=True).bytes_per_page
+        # (hd + 4) / (4 * hd) per token-row; hd=16 here -> 0.3125
+        assert kv8 / dense <= 0.3125 + 1e-9, (kv8, dense)
+
+
+# --------------------------------------------------------- engine parity
+class TestEngineParity:
+    def test_int8_kv8_greedy_identical_under_churn(self, tiny_lm):
+        model, params = tiny_lm
+        base = _decode_tokens(model, params, PROMPTS)
+        got = _decode_tokens(model, params, PROMPTS,
+                             kv_page_tokens=16, quantize="int8+kv8")
+        assert got == base
+
+    def test_speculative_and_prefix_cache_compose(self, tiny_lm):
+        model, params = tiny_lm
+        shared = list(range(1, 17))
+        prompts = [shared + [5, 9], shared + [30], shared + [2, 2, 7]]
+        base = _decode_tokens(model, params, prompts)
+        got = _decode_tokens(model, params, prompts, kv_page_tokens=16,
+                             speculate=3, prefix_cache=True,
+                             quantize="int8+kv8")
+        assert got == base
+
+    def test_quantize_off_is_identity(self, tiny_lm):
+        model, params = tiny_lm
+        for mode in (None, "off"):
+            eng = DecodeEngine(model, params, slots=2, quantize=mode)
+            try:
+                assert not any(
+                    is_quantized(l) for l in jax.tree_util.tree_leaves(
+                        eng.params, is_leaf=is_quantized))
+                # byte-identical: the off path never touches the tree
+                for a, b in zip(jax.tree_util.tree_leaves(params),
+                                jax.tree_util.tree_leaves(eng.params)):
+                    assert np.array_equal(np.asarray(a), np.asarray(b))
+                assert eng.generate(PROMPTS[0], 8) == \
+                    _decode_tokens(model, params, [PROMPTS[0]])[0]
+            finally:
+                eng.close()
+
+    def test_kv8_requires_paged(self, tiny_lm):
+        model, params = tiny_lm
+        with pytest.raises(ValueError, match="kv_page_tokens"):
+            DecodeEngine(model, params, slots=2, quantize="kv8")
+
+
+# ------------------------------------------------------------ tp serving
+class TestQuantTp:
+    def test_tp2_greedy_identical(self, tiny_lm):
+        model, params = tiny_lm
+        mesh = serving_mesh(jax.devices()[:2])
+        base = _decode_tokens(model, params, PROMPTS, kv_page_tokens=16,
+                              quantize="int8+kv8")
+        got = _decode_tokens(model, params, PROMPTS, kv_page_tokens=16,
+                             quantize="int8+kv8", mesh=mesh)
+        assert got == base
+
+    def test_scale_spec_follows_weight_split(self):
+        from jax.sharding import PartitionSpec as P
+
+        from bigdl_tpu.serving import ServingSharding
+        sh = ServingSharding(serving_mesh(jax.devices()[:2]))
+        # column-split (wq/wk/wv/w1/emb): scale indexes the SPLIT output
+        # channels -> the scale itself splits
+        assert sh.scale_spec(P(None, "model")) == P("model")
+        # row-split (wo/w2): contraction over axis 0 -> every shard
+        # needs every output scale -> replicated
+        assert sh.scale_spec(P("model", None)) == P()
+
+    def test_placed_scales_follow_specs(self, tiny_lm):
+        model, params = tiny_lm
+        from bigdl_tpu.serving import ServingSharding
+        sh = ServingSharding(serving_mesh(jax.devices()[:2]))
+        placed = sh.place_params(model, quantize_params(params, "int8"))
+        flat = jax.tree_util.tree_flatten_with_path(
+            placed, is_leaf=is_quantized)[0]
+        by_key = {str(path[-1]): leaf for path, leaf in flat}
+        wq = next(v for k, v in by_key.items() if "wq" in k)
+        wo = next(v for k, v in by_key.items() if "wo" in k)
+        assert not wq.q.sharding.is_fully_replicated
+        assert not wq.scale.sharding.is_fully_replicated
+        assert not wo.q.sharding.is_fully_replicated
+        assert wo.scale.sharding.is_fully_replicated
+
+
+# -------------------------------------------------------------- lint rule
+class TestQuantLintRule:
+    def test_catalog_severity(self):
+        from bigdl_tpu.analysis.rules import CATALOG
+        assert CATALOG["quant-dequant-upcast"][1] == "error"
+
+    def test_fires_on_f32_rematerialized_dequant(self):
+        from bigdl_tpu.analysis.rules import run_jaxpr_rules
+        qv = jnp.ones((16, 32), jnp.int8)
+        s = jnp.full((32,), 0.01, jnp.float32)
+        x = jnp.ones((4, 16), jnp.bfloat16)
+
+        def bad(x, qv, s):
+            return x.astype(jnp.float32) @ (qv.astype(jnp.float32) * s)
+
+        rep = run_jaxpr_rules(jax.make_jaxpr(bad)(x, qv, s))
+        hits = [f for f in rep.findings
+                if f.rule == "quant-dequant-upcast"]
+        assert len(hits) == 1 and hits[0].severity == "error"
+
+    def test_silent_on_activation_dtype_epilogue(self, rng):
+        from bigdl_tpu.analysis.rules import run_jaxpr_rules
+        qw = quantize_weight(jax.random.normal(rng, (16, 32)), "int8")
+        x = jnp.ones((4, 16), jnp.bfloat16)
+
+        def good(x):
+            return x @ qw.astype(x.dtype)  # the serving/quant epilogue
+
+        rep = run_jaxpr_rules(jax.make_jaxpr(good)(x))
+        assert not [f for f in rep.findings
+                    if f.rule == "quant-dequant-upcast"]
+
+    def test_silent_on_plain_f32_path(self):
+        from bigdl_tpu.analysis.rules import run_jaxpr_rules
+        qv = jnp.ones((16, 32), jnp.int8)
+        s = jnp.full((32,), 0.01, jnp.float32)
+        x = jnp.ones((4, 16), jnp.float32)  # no bf16 anywhere: fine
+
+        def plain(x, qv, s):
+            return x @ (qv.astype(jnp.float32) * s)
+
+        rep = run_jaxpr_rules(jax.make_jaxpr(plain)(x, qv, s))
+        assert not [f for f in rep.findings
+                    if f.rule == "quant-dequant-upcast"]
+
+
+# -------------------------------------------------- memory slot forecast
+class TestSlotForecast:
+    def test_kv8_roughly_doubles_predicted_slots(self):
+        from bigdl_tpu.obs import memory
+        budget = 2e9
+        plans = {m: memory.serving_kv_plan("transformer_lm", seq_len=128,
+                                           quantize=m)
+                 for m in ("off", "int8+kv8")}
+        slots = {m: memory.forecast_slots(p, hbm_bytes=budget)[
+            "predicted_max_slots"] for m, p in plans.items()}
+        assert slots["int8+kv8"] >= 2 * slots["off"], slots
+        # the per-slot cost itself roughly quarters ((hd+4)/(4*hd))
+        ratio = (plans["int8+kv8"]["kv_bytes_per_slot"]
+                 / plans["off"]["kv_bytes_per_slot"])
+        assert ratio <= 0.3125, ratio
+
+    def test_kv_plan_fields(self):
+        from bigdl_tpu.obs import memory
+        p = memory.serving_kv_plan("transformer_lm", seq_len=128,
+                                   quantize="kv8")
+        assert p["quantize"] == "kv8" and p["page_tokens"] == 128
+        assert p["params_bytes"] == p["params_bytes_f32"]  # kv8 only
+        with pytest.raises(ValueError, match="transformer_lm"):
+            memory.serving_kv_plan("resnet50")
+
+
+# ------------------------------------------------- dtype-aware page plan
+class TestKvPagePlanDtype:
+    def test_int8_needs_32_token_pages(self):
+        from bigdl_tpu.ops.attention_kernel import kv_page_plan
+        p = kv_page_plan(16, 128, 64, jnp.int8)
+        assert p["sublane"] == 32 and not p["sublane_ok"]
+        assert kv_page_plan(32, 128, 64, jnp.int8)["sublane_ok"]
+
+    def test_f32_pins_unchanged(self):
+        from bigdl_tpu.ops.attention_kernel import kv_page_plan
+        p = kv_page_plan(32, 128, 64, jnp.float32)
+        assert p["sublane"] == 8 and p["sublane_ok"]
+        assert not kv_page_plan(12, 96, 64, jnp.float32)["sublane_ok"]
+
+    def test_misfit_rule_reports_dtype_sublane(self):
+        from bigdl_tpu.analysis.rules import run_decode_rules
+        rep = run_decode_rules(page_tokens=16, max_len=128, head_dim=64,
+                               dtype=jnp.int8)
+        hit = next(f for f in rep.findings if f.rule == "kv-page-misfit")
+        assert "% 32" in hit.message
+
+
+# --------------------------------------------------- autotune namespace
+class TestQuantAutotune:
+    def test_quant_matmul_kind_roundtrip(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("BIGDL_TPU_AUTOTUNE_CACHE", str(tmp_path))
+        tuning.reset()
+        try:
+            # off: the dequant-fused default, no cache touch
+            assert tuning.quant_matmul_kind(4, 32, 24, jnp.float32) \
+                == "dequant"
+            tuning.set_mode("measure")  # dry off-TPU: persists a choice
+            kind = tuning.quant_matmul_kind(4, 32, 24, jnp.float32)
+            assert kind in tuning.QUANT_MATMUL_KINDS
+            key = tuning.make_key("quant", m=4, k=32, n=24,
+                                  dtype="float32")
+            with open(tuning.cache_path()) as f:
+                assert key in json.load(f)["entries"]
+            tuning.reset()
+            tuning.set_mode("cached")
+            assert tuning.quant_matmul_kind(4, 32, 24, jnp.float32) \
+                == kind
+        finally:
+            tuning.reset()
