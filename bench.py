@@ -37,6 +37,26 @@ import subprocess
 import sys
 
 TPU_TIMEOUT = int(os.environ.get("BENCH_TPU_TIMEOUT", "900"))
+
+
+def _provenance_companion_keys():
+    """Canonical provenance key list from bigdl_tpu.cli.provenance
+    (ISSUE 18 satellite: one list for every record assembly). Loaded by
+    FILE PATH, not package import — the parent's never-import-jax
+    contract holds (the package __init__ pulls in jax); the provenance
+    module itself is import-light. Falls back to the frozen copy if the
+    tree moved out from under us."""
+    import importlib.util
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "bigdl_tpu", "cli", "provenance.py")
+    try:
+        spec = importlib.util.spec_from_file_location("_bt_prov", path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return tuple(mod.PROVENANCE_COMPANION_KEYS)
+    except Exception:
+        return ("conv_layouts", "conv_geom", "autotune", "bn_fused",
+                "pipeline", "stall_frac", "data_wait_s")
 CPU_TIMEOUT = int(os.environ.get("BENCH_CPU_TIMEOUT", "900"))
 PROBE_TIMEOUT = int(os.environ.get("BENCH_PROBE_TIMEOUT", "150"))
 # a successful TPU probe is cached for this long; inside one tunnel
@@ -448,13 +468,11 @@ def main() -> None:
                             # multi-point curve (VERDICT r5 weak #3)
                             "hard_data", "grade_lift", "grade_noise",
                             "epochs_run", "val_points", "curve",
-                            # conv layout provenance (global triple +
-                            # per-geometry decisions, ISSUE 3)
-                            "conv_layouts", "conv_geom",
-                            "autotune", "bn_fused",
-                            # ISSUE 13 feed A/B columns: which machinery
-                            # fed the chip and how often it starved
-                            "pipeline", "stall_frac", "data_wait_s")
+                            # config + feed provenance: the canonical
+                            # list (conv layouts, autotune, bn_fused,
+                            # pipeline attribution) now lives in
+                            # bigdl_tpu.cli.provenance (ISSUE 18)
+                            *_provenance_companion_keys())
                         if cres.get(k) is not None}
                     if cres.get("backend") == "tpu":
                         _partial(cname, cres)

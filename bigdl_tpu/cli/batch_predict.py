@@ -1,0 +1,226 @@
+"""Offline bulk inference over record shards (ISSUE 18 tentpole a) —
+the TPU-native analog of BigDL's RDD batch scoring (the
+``model.predict(rdd)`` workhorse of arxiv 1804.05839 §3), built by
+composition: the ``dataset/pipeline`` executor feeds the serving
+engine's bucketed forwards, and a cursor checkpoint makes kill+resume
+byte-identical.
+
+    bigdl-tpu batch-predict --modelName resnet50 --model ckpt_dir \\
+        -f record:/data/shards --out /data/scores -b 128 \\
+        --dataWorkers 8 --stage device --strategy dp
+
+* the record feed is the training input pipeline in eval mode
+  (``shuffle=False``, deterministic center-crop transforms): N decode
+  workers race the :class:`EpochPlan`'s tickets, batches reassemble in
+  plan order, ``--stage device`` overlaps the h2d copy with scoring;
+* ``--strategy dp[:N]`` shards the batch stream round-robin across N
+  engine replicas on disjoint device groups
+  (:func:`replica_device_groups`), ``tp:K`` runs each replica
+  tensor-parallel over K chips — the same spellings ``serve`` takes;
+* outputs append to sharded JSONL (``scores-XXXXX-of-NNNNN.jsonl``,
+  global order reconstructible by sorting on ``"i"``), with a cursor
+  checkpoint every ``--checkpointEvery`` batches (serving/bulk.py) so a
+  killed job resumes with no re-scored and no dropped records;
+* the report line carries the training-perf phase/provenance columns
+  (``stall_frac``, ``data_wait_s``, ``pipeline``, hbm/mem columns under
+  ``--obs``) plus ``images_per_second_per_chip``.
+
+The tail remainder (``n % global_batch`` records the EpochPlan drops by
+design for training) is scored as one final partial batch — the engine
+pads it to a compiled bucket — so bulk scoring covers every record.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+from bigdl_tpu.cli import common
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser("bigdl-tpu batch-predict")
+    p.add_argument("--modelName", default="resnet50",
+                   choices=["alexnet", "inception_v1", "inception_v2",
+                            "vgg16", "vgg19", "resnet50", "resnet20_cifar",
+                            "vit_b16", "vit_s16"],
+                   help="image model (cli/perf.py build table); sets the "
+                        "eval crop geometry")
+    p.add_argument("--model", default=None,
+                   help="trained checkpoint dir (newest model.<n>) or "
+                        "single saved file")
+    p.add_argument("--randomInit", action="store_true",
+                   help="random weights instead of --model (throughput "
+                        "smoke / perf capture)")
+    p.add_argument("-f", "--folder", required=True,
+                   help="record:<dir> (or plain dir/glob) of .btr record "
+                        "shards to score")
+    p.add_argument("--out", required=True,
+                   help="output dir: scores-*.jsonl shards + cursor.json "
+                        "(an existing cursor resumes the job)")
+    p.add_argument("-b", "--batchSize", type=int, default=32)
+    p.add_argument("--classNum", type=int, default=1000)
+    p.add_argument("--limit", type=int, default=None, metavar="N",
+                   help="score only the first N records of the plan order")
+    p.add_argument("--scores", action="store_true",
+                   help="emit full score vectors per record, not just the "
+                        "argmax pred")
+    p.add_argument("--checkpointEvery", type=int, default=32, metavar="K",
+                   help="drain + fsync + cursor write every K dispatched "
+                        "batches (the resume granularity)")
+    p.add_argument("--strategy", default=None, metavar="dp[:N]|tp[:K]",
+                   help="device fan-out, serve spellings: dp[:N] = N "
+                        "engine replicas on disjoint device groups fed "
+                        "round-robin; tp[:K] = each replica "
+                        "tensor-parallel over K chips; dp:N+tp:K "
+                        "combines. Default: one single-group engine")
+    common.add_pipeline_args(p)
+    common._add_platform_arg(p)
+    common.add_autotune_arg(p)
+    common.add_fused_bn_arg(p)
+    common.add_obs_args(p)
+    return p
+
+
+def _build_feed(args, crop):
+    """The eval-mode executor feed + its provenance: record source ->
+    StreamingSampleSource -> EpochPlan(shuffle=False) -> ExecutorDataSet
+    [-> StagedDataSet]. Returns ``(feed_iter, plan, n, sig, pipeline)``
+    where ``feed_iter`` yields ``(ordinal, indices, x)`` including the
+    final tail-remainder partial batch."""
+    import numpy as np
+
+    from bigdl_tpu.cli.perf import _short_side
+    from bigdl_tpu.dataset.pipeline import (EpochPlan, ExecutorDataSet,
+                                            StagedDataSet,
+                                            StreamingSampleSource)
+    from bigdl_tpu.dataset.streaming import RecordImageDataSet
+
+    source = args.folder
+    if source.startswith("record:"):
+        source = source[len("record:"):]
+    batch = args.batchSize
+    # the perf-harness record recipe, eval mode: deterministic resize +
+    # center crop (train=False), so scores are reproducible run-to-run
+    rds = RecordImageDataSet(
+        source, batch_size=batch, crop=crop, train=False,
+        short_side=_short_side(crop), mean=[123.68, 116.779, 103.939],
+        std=[58.4, 57.1, 57.4], n_threads=1, window=1)
+    src = StreamingSampleSource(rds)
+    n = len(src)
+    if args.limit is not None:
+        n = min(n, int(args.limit))
+    if n <= 0:
+        raise SystemExit(f"no records to score under {source}")
+    plan = EpochPlan(n, batch, seed=0, shuffle=False,
+                     process_index=0, process_count=1)
+    workers = max(1, int(args.dataWorkers or 0))
+    depth = max(1, int(args.prefetchDepth or 2))
+    ds = ExecutorDataSet(src, workers=workers, depth=depth, plan=plan)
+    staged = ds
+    if args.stage != "off":
+        staged = StagedDataSet(ds, stage=args.stage, depth=depth)
+    pipeline_sig = staged.signature()
+
+    batch_rows = plan.batch_indices(0)  # (steps, batch) plan-order rows
+
+    def feed():
+        s = 0
+        for mb in staged:
+            if s >= plan.steps:
+                break
+            yield s, batch_rows[s], mb.input
+            s += 1
+        # the EpochPlan drops n % global_batch for training lockstep;
+        # bulk scoring must cover every record — score the tail as one
+        # partial batch (the engine pads it to a compiled bucket)
+        tail = np.arange(plan.steps * plan.global_batch, n)
+        if len(tail):
+            mb = src.collate([src.load(int(i), 0) for i in tail])
+            yield plan.steps, tail, mb.input
+
+    return feed(), plan, n, src.signature(), pipeline_sig
+
+
+def main(argv=None):
+    common.setup_logging()
+    args = build_parser().parse_args(argv)
+    if not args.randomInit and not args.model:
+        raise SystemExit("need --model CKPT (or --randomInit for a "
+                         "throughput smoke)")
+    common.apply_platform(args)
+
+    import jax
+    import numpy as np  # noqa: F401  (feed helpers)
+
+    from bigdl_tpu.cli.perf import _annotate_obs_phases, build_model
+    from bigdl_tpu.cli.provenance import provenance_dict
+    from bigdl_tpu.serving import (InferenceEngine, bulk,
+                                   power_of_two_buckets)
+    from bigdl_tpu.serving.sharding import (replica_device_groups,
+                                            serving_mesh)
+
+    model, size = build_model(args.modelName, class_num=args.classNum)
+    common.apply_fused_bn(model, getattr(args, "fusedBN", None))
+    crop = tuple(size[:2])
+    if args.randomInit:
+        params, mod_state = model.init(jax.random.PRNGKey(0)), None
+    else:
+        params, mod_state = common.load_trained(model, args.model)
+
+    devices = jax.devices()
+    replicas, tp_k = common.parse_serving_strategy(args.strategy,
+                                                   len(devices))
+    groups = replica_device_groups(replicas, tp_k)
+    # one engine per device group, mirroring serve's replica stacks —
+    # batch ordinal s scores on engine s % len(groups)
+    engines = [InferenceEngine(model, params, mod_state,
+                               buckets=power_of_two_buckets(args.batchSize),
+                               mesh=serving_mesh(g))
+               for g in groups]
+
+    feed, plan, n, src_sig, pipeline_sig = _build_feed(args, crop)
+    # the resume identity: the exact plan + source + scoring config —
+    # any drift refuses to resume instead of silently rescoring
+    signature = {"plan": plan.signature(), "src": src_sig,
+                 "model": args.modelName, "class_num": int(args.classNum),
+                 "scores": bool(args.scores), "groups": len(engines),
+                 "tp": int(tp_k)}
+
+    prior = bulk.load_cursor(args.out)
+    records_prior = int(prior.get("records_done", 0)) if prior else 0
+
+    obs_state = getattr(args, "_obs", None)
+    phase: dict = {}
+    t0 = time.perf_counter()
+    rep = bulk.run_bulk(engines, feed, signature, args.out,
+                        scores=args.scores,
+                        checkpoint_every=args.checkpointEvery,
+                        phase=phase)
+    wall = time.perf_counter() - t0
+
+    n_chips = len(groups) * max(1, tp_k)
+    scored = max(0, rep["records"] - records_prior)
+    out = {"bench": "batch_predict", "model": args.modelName,
+           "batch": args.batchSize, "records": rep["records"],
+           "records_scored_this_run": scored,
+           "batches": rep["batches"],
+           "resumed_from_batch": rep["resumed_from_batch"],
+           "groups": rep["groups"], "tp": tp_k, "chips": n_chips,
+           "shards": rep["shards"], "seconds": round(wall, 3),
+           "images_per_second": (round(scored / wall, 2) if wall else None),
+           "images_per_second_per_chip": (round(scored / wall / n_chips, 2)
+                                          if wall else None),
+           "pipeline": pipeline_sig}
+    # same schema-stable phase/provenance columns as the training perf
+    # JSON — stall_frac is the acceptance number (ISSUE 18: <= 0.02 at
+    # --dataWorkers 8 --stage device)
+    _annotate_obs_phases(out, obs_state, phase, wall)
+    out.update(provenance_dict(model))
+    print(json.dumps(out))
+    return out
+
+
+if __name__ == "__main__":
+    main()

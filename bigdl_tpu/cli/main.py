@@ -33,6 +33,7 @@ _COMMANDS = {
     "lint": "lint",
     "serve": "serve",
     "predict": "predict",
+    "batch-predict": "batch_predict",
     "loadmodel": "loadmodel",
     "record-gen": "record_gen",
 }

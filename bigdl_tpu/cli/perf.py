@@ -256,34 +256,32 @@ def _annotate_conv_layouts(out: dict) -> None:
     """Stamp the active non-default conv layout policy — global triple
     AND installed per-geometry decisions — into a result dict; shared by
     run() and run_time_to_acc() so their JSON provenance cannot drift
-    apart. (Tuner-resolved per-geometry decisions additionally appear in
-    the autotune ledger under their ``conv_geom`` keys.)"""
-    from bigdl_tpu.ops.conv2d import (conv_layouts_if_nondefault,
-                                      geom_policy_if_any)
-    cl = conv_layouts_if_nondefault()
-    if cl:
-        out["conv_layouts"] = cl
-    gp = geom_policy_if_any()
-    if gp:
-        out["conv_geom"] = gp
+    apart. Delegates to the shared assembly (ISSUE 18 satellite) —
+    perf JSON, /metrics _info, bench companions, and batch-predict all
+    read the same code now."""
+    from bigdl_tpu.cli.provenance import provenance_dict
+    core = provenance_dict()
+    for k in ("conv_layouts", "conv_geom"):
+        if k in core:
+            out[k] = core[k]
 
 
 def _annotate_autotune(out: dict) -> None:
     """Stamp the run's tuning provenance (mode + per-key decision or
     'default') into a result dict — ISSUE 1 acceptance: every perf JSON
     line says which decisions it ran under."""
-    from bigdl_tpu import tuning
-    ann = tuning.annotation()
-    if ann is not None:
-        out["autotune"] = ann
+    from bigdl_tpu.cli.provenance import provenance_dict
+    core = provenance_dict()
+    if "autotune" in core:
+        out["autotune"] = core["autotune"]
 
 
 def _annotate_bn_fused(out: dict, model) -> None:
     """Stamp the model's effective BN fusion mode (off/stats/apply) the
     same way the autotune decisions are stamped, so fused-vs-stats-vs-
     default A/B rows are self-describing (ISSUE 2 satellite)."""
-    from bigdl_tpu.nn.norm import bn_fused_mode
-    out["bn_fused"] = bn_fused_mode(model)
+    from bigdl_tpu.cli.provenance import provenance_dict
+    out["bn_fused"] = provenance_dict(model)["bn_fused"]
 
 
 _PHASE_COLUMNS = ("data_wait_s", "h2d_s", "dispatch_s", "device_s",

@@ -40,11 +40,16 @@ Modules:
   ``--quantize off`` is byte-identical to not having the module;
 * :mod:`replicas` — data-parallel engine replicas behind one front
   door (ISSUE 16): least-loaded deterministic routing, fleet-level
-  readiness/shedding, per-replica labelled metrics + fleet aggregates.
+  readiness/shedding, per-replica labelled metrics + fleet aggregates;
+* :mod:`bulk` — offline bulk scoring (ISSUE 18): the executor-fed,
+  cursor-checkpointed sharded batch job behind ``bigdl-tpu
+  batch-predict`` — kill+resume byte-identical output.
 """
 
 from bigdl_tpu.serving.batcher import (AdmissionError, DeadlineExceeded,
                                        MicroBatcher, WorkerDied)
+from bigdl_tpu.serving.bulk import (ShardSink, load_cursor, merge_shards,
+                                    run_bulk, save_cursor, shard_paths)
 from bigdl_tpu.serving.decode import DecodeEngine, DecodeRequest
 from bigdl_tpu.serving.engine import InferenceEngine, power_of_two_buckets
 from bigdl_tpu.serving.kv_pages import (PageAllocator, PagedKvCache,
@@ -86,4 +91,6 @@ __all__ = ["AdmissionError", "DeadlineExceeded", "MicroBatcher",
            "ServingApp", "make_server", "run_server", "Watchdog",
            "Replica", "ReplicaSet", "ServingSharding",
            "replica_device_groups", "restore_for_serving",
-           "serving_mesh"]
+           "serving_mesh",
+           "ShardSink", "load_cursor", "merge_shards", "run_bulk",
+           "save_cursor", "shard_paths"]

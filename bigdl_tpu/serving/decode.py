@@ -77,11 +77,11 @@ __all__ = ["DecodeEngine", "DecodeRequest"]
 class DecodeRequest:
     __slots__ = ("tokens", "max_new_tokens", "temperature", "stop_token",
                  "top_k", "top_p", "seed", "future", "out", "deadline",
-                 "rid")
+                 "rid", "emit")
 
     def __init__(self, tokens, max_new_tokens, temperature=0.0,
                  stop_token=None, deadline=None, top_k=0, top_p=1.0,
-                 seed=0, rid=None):
+                 seed=0, rid=None, emit=None):
         self.tokens = [int(t) for t in tokens]
         self.max_new_tokens = int(max_new_tokens)
         self.temperature = float(temperature)
@@ -93,6 +93,11 @@ class DecodeRequest:
         self.future = _Future()
         self.out: list = []
         self.rid = rid  # lifecycle-trace request id (ISSUE 15)
+        # streaming sink (ISSUE 18): called as emit(new_tokens, done)
+        # after every round that appended tokens — only ACCEPTED tokens
+        # reach it on the speculative path, so streamed output is
+        # structurally identical to the buffered future result
+        self.emit = emit
 
 
 class DecodeEngine:
@@ -271,7 +276,7 @@ class DecodeEngine:
         if metrics is None:
             self._m_tokens = self._m_steps = self._m_prefills = None
             self._m_prompt_tokens = self._m_rejected = None
-            self._m_expired = self._m_dead = None
+            self._m_expired = self._m_dead = self._m_cancelled = None
             self._m_spec_prop = self._m_spec_acc = None
             self._m_draft_steps = None
             return
@@ -293,6 +298,9 @@ class DecodeEngine:
         self._m_dead = metrics.counter(
             "decode_dead_submit_total",
             "generate submits fast-failed (decode worker dead)")
+        self._m_cancelled = metrics.counter(
+            "decode_cancelled_total",
+            "generate requests cancelled mid-flight (client disconnect)")
         metrics.gauge("decode_worker_up",
                       "1 while the decode loop is healthy",
                       fn=lambda: 0.0 if self._worker_error else 1.0)
@@ -680,7 +688,7 @@ class DecodeEngine:
                temperature: float = 0.0, stop_token=None,
                deadline: Optional[float] = None, top_k: int = 0,
                top_p: float = 1.0, seed: int = 0,
-               rid: Optional[str] = None) -> _Future:
+               rid: Optional[str] = None, emit=None) -> _Future:
         """Queue one generation request; the future resolves to the list
         of generated token ids. Validates the length budget, fast-rejects
         when the waiting queue is full, when the decode worker is dead
@@ -689,7 +697,10 @@ class DecodeEngine:
         passed (:class:`DeadlineExceeded`). ``top_k=0`` / ``top_p=1``
         disable those filters; ``seed`` makes sampled output
         deterministic per request; ``rid`` tags the request for
-        lifecycle tracing (ISSUE 15)."""
+        lifecycle tracing (ISSUE 15); ``emit`` is an optional streaming
+        sink called as ``emit(new_tokens, done)`` per emitting round
+        (ISSUE 18) — called under the engine lock, so it must only hand
+        tokens off (e.g. queue.put), never block."""
         tokens = list(tokens)
         if not tokens:
             raise ValueError("empty prompt")
@@ -706,7 +717,7 @@ class DecodeEngine:
             raise ValueError(f"top_p must be in (0, 1], got {top_p}")
         req = DecodeRequest(tokens, max_new_tokens, temperature,
                             stop_token, deadline, top_k, top_p, seed,
-                            rid=rid)
+                            rid=rid, emit=emit)
         with self._lock:
             if self._closed:
                 raise RuntimeError("decode engine is closed")
@@ -916,6 +927,17 @@ class DecodeEngine:
                 pages=(len(self._kv.slot_pages[slot])
                        if self.paged else None),
                 pos=int(self._pos[slot]))
+        if req.emit is not None and emitted:
+            # streaming sink (ISSUE 18): hand the round's accepted
+            # tokens to the HTTP handler's queue. A broken sink must
+            # never take the decode loop (and every other slot) down —
+            # the disconnect path is decoder.cancel(), not an exception
+            # propagated from here.
+            try:
+                req.emit(req.out[-emitted:], done)
+            except Exception:
+                logger.exception("streaming emit sink failed (rid=%s)",
+                                 req.rid)
         if done:
             self._release_slot(slot)
             req.future.set_result(list(req.out))
@@ -960,6 +982,52 @@ class DecodeEngine:
                               error=f"expired mid-decode after "
                                     f"{len(req.out)} tokens")
                 self._handoff(i)
+
+    # --------------------------------------------------------- cancellation
+    def cancel(self, rid: str, reason: str = "client disconnected") -> bool:
+        """First-class mid-decode cancellation (ISSUE 18): drop the
+        request identified by ``rid`` wherever it is — waiting queue or
+        active slot — releasing the slot AND its paged-KV page
+        reservation atomically under the engine lock, then hand the slot
+        to the next waiting request. This is the primitive the streaming
+        disconnect path uses (previously only deadline expiry and
+        shutdown freed slots early).
+
+        Returns True iff a request was found and cancelled. Safe against
+        the speculative verify/accept race: ``step()`` holds the engine
+        lock for the ENTIRE round (draft feeds, the chunked verify
+        dispatch, acceptance, and emission), so a cancel landing between
+        a verify dispatch and its accept simply waits for the round to
+        retire — it can never free pages the in-flight verify is still
+        writing, and a stale ``_pending`` feed is reset by the next
+        ``_install`` into that slot."""
+        if rid is None:
+            return False
+        err = RuntimeError(f"request {rid} cancelled: {reason}")
+        rt = _get_reqtracer()
+        with self._lock:
+            for req in self._waiting:
+                if req.rid == rid:
+                    self._waiting.remove(req)
+                    break
+            else:
+                req = None
+            if req is None:
+                for i, r in enumerate(self._reqs):
+                    if r is not None and r.rid == rid:
+                        req = r
+                        self._release_slot(i)
+                        self._handoff(i)
+                        break
+            if req is None:
+                return False
+            if self._m_cancelled is not None:
+                self._m_cancelled.inc()
+            self._work.notify()
+        req.future.set_exception(err)
+        if rt is not None:
+            rt.finish(rid, "closed", error=reason)
+        return True
 
     # ---------------------------------------------------------------- step
     def step(self) -> int:

@@ -328,26 +328,18 @@ class InferenceEngine:
         lines — the same fields the perf harness stamps (bn_fused, conv
         layout source, autotune mode, lint summary) plus the bucket set,
         so every latency number is attributable to an exact program."""
-        from bigdl_tpu import tuning
-        from bigdl_tpu.nn.norm import bn_fused_mode
-        from bigdl_tpu.ops.conv2d import (conv_layouts_if_nondefault,
-                                          geom_policy_if_any)
+        from bigdl_tpu.cli.provenance import provenance_dict
         out = {
             "buckets": ",".join(str(b) for b in self.buckets),
             **(self._shard.describe() if self._shard is not None else {}),
             "compute_dtype": (np.dtype(self.compute_dtype).name
                               if self.compute_dtype is not None
                               else "float32"),
-            "bn_fused": bn_fused_mode(self.module),
-            "autotune": tuning.get_mode(),
+            # shared assembly (ISSUE 18 satellite): same code path as
+            # the perf JSON line and batch-predict reports
+            **provenance_dict(self.module, flat=True),
             "quantize": self.quantize,
         }
-        cl = conv_layouts_if_nondefault()
-        out["conv_layouts"] = ("/".join(f"{k}={v}" for k, v in
-                                        sorted(cl.items()))
-                               if cl else "default")
-        gp = geom_policy_if_any()
-        out["conv_geom_decisions"] = len(gp) if gp else 0
         for b, m in sorted(self._bucket_mem.items()):
             # per-bucket compile-time memory (ISSUE 12): the HBM cost of
             # each program in the ladder, scrape-visible

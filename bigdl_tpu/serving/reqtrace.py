@@ -122,7 +122,8 @@ class RequestRecord:
     __slots__ = ("rid", "endpoint", "state", "status",
                  "t_admit", "t_queue", "t_dequeue",
                  "t_prefill0", "t_prefill1",
-                 "t_first_token", "t_last_token", "t_finish",
+                 "t_first_token", "t_first_byte", "t_last_token",
+                 "t_finish",
                  "prompt_tokens", "max_new", "tokens_out",
                  "rounds", "round_count", "accepted_total",
                  "prefix_hit_tokens", "pages_held", "slot", "replica",
@@ -140,6 +141,7 @@ class RequestRecord:
         self.t_prefill0: Optional[float] = None
         self.t_prefill1: Optional[float] = None
         self.t_first_token: Optional[float] = None
+        self.t_first_byte: Optional[float] = None
         self.t_last_token: Optional[float] = None
         self.t_finish: Optional[float] = None
         self.prompt_tokens: Optional[int] = None
@@ -176,9 +178,15 @@ class RequestRecord:
         return max(self.t_last_token - self.t_prefill1, 0.0) * 1000.0
 
     def ttft_ms(self) -> Optional[float]:
-        """Admission -> first emitted token. For ``/predict`` (scores,
-        not tokens) the response-ready time stands in for token one."""
-        t1 = self.t_first_token
+        """Admission -> first token as FELT by the client: when the
+        streaming handler stamped a first-byte-out time (``--stream``)
+        that wins over the engine-side first-emit time, so SLO judgment
+        covers the wire, not just the decode loop. For ``/predict``
+        (scores, not tokens) the response-ready time stands in for
+        token one."""
+        t1 = self.t_first_byte
+        if t1 is None:
+            t1 = self.t_first_token
         if t1 is None and self.endpoint == "predict" \
                 and self.state == "finished":
             t1 = self.t_finish
@@ -561,6 +569,16 @@ class RequestTracer:
             if pages is not None:
                 rec.pages_held = int(pages)
             rec.state = "decode"
+
+    def note_first_byte(self, rid: Optional[str]) -> None:
+        """Streaming handler wrote the first response byte for this
+        request (chunked ``/generate``). First call wins; the derived
+        TTFT prefers this over the engine-emit time so ``--slo`` judges
+        streamed traffic on felt latency."""
+        with self._lock:
+            rec = self._rec(rid)
+            if rec is not None and rec.t_first_byte is None:
+                rec.t_first_byte = self.clock()
 
     def note_round(self, rid: Optional[str], emitted: int,
                    accepted: Optional[int] = None,

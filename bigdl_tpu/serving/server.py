@@ -47,6 +47,7 @@ from __future__ import annotations
 
 import json
 import logging
+import queue as _queue_mod
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -65,6 +66,25 @@ logger = logging.getLogger(__name__)
 __all__ = ["ServingApp", "make_server", "run_server"]
 
 _MAX_BODY = 64 * 1024 * 1024  # refuse absurd payloads before np.asarray
+
+
+class _GenerateStream:
+    """Handle for one streamed /generate (ISSUE 18): the per-request
+    emit queue the decode loop feeds (``(tokens, done)`` per emitting
+    round), the request future (error surface for terminations that
+    never emit — expiry in the waiting queue, shutdown), the decoder
+    that owns the slot (the disconnect path calls ``cancel`` on exactly
+    this one, which matters under dp routing), and the prompt length for
+    the final frame."""
+
+    __slots__ = ("rid", "queue", "future", "decoder", "prompt_len")
+
+    def __init__(self, rid, queue, future, decoder, prompt_len):
+        self.rid = rid
+        self.queue = queue
+        self.future = future
+        self.decoder = decoder
+        self.prompt_len = prompt_len
 
 
 class ServingApp:
@@ -256,36 +276,156 @@ class ServingApp:
             out["scores"] = np.asarray(scores, np.float64).tolist()
         return 200, out
 
+    @staticmethod
+    def _parse_generate(payload: dict):
+        """Validate the /generate payload; ``(parsed, None)`` or
+        ``(None, error_string)`` — shared by the buffered and streamed
+        paths so the two can never diverge on what they admit."""
+        tokens = payload.get("tokens")
+        if (not isinstance(tokens, (list, tuple)) or not tokens
+                or not all(isinstance(t, int) for t in tokens)):
+            return None, "'tokens' must be a non-empty list of ints"
+        try:
+            opts = {"max_new": payload.get("max_new_tokens", 16),
+                    "temperature": payload.get("temperature", 0.0),
+                    "stop": payload.get("stop_token"),
+                    "top_k": int(payload.get("top_k", 0)),
+                    "top_p": float(payload.get("top_p", 1.0)),
+                    "seed": int(payload.get("seed", 0))}
+        except (TypeError, ValueError):
+            return None, "'top_k'/'seed' must be ints, 'top_p' a float"
+        return (list(tokens), opts), None
+
     def handle_generate(self, payload: dict, rid: Optional[str] = None):
         _, _, decoder = self._route("generate", rid)
         if decoder is None:
             return 400, {"error": "no /generate decoder for this model "
                                   "(serve a transformer_lm* model)"}
-        tokens = payload.get("tokens")
-        if (not isinstance(tokens, (list, tuple)) or not tokens
-                or not all(isinstance(t, int) for t in tokens)):
-            return 400, {"error": "'tokens' must be a non-empty list of "
-                                  "ints"}
-        max_new = payload.get("max_new_tokens", 16)
-        temperature = payload.get("temperature", 0.0)
-        stop = payload.get("stop_token")
+        parsed, err = self._parse_generate(payload)
+        if parsed is None:
+            return 400, {"error": err}
+        tokens, o = parsed
         try:
-            top_k = int(payload.get("top_k", 0))
-            top_p = float(payload.get("top_p", 1.0))
-            seed = int(payload.get("seed", 0))
-        except (TypeError, ValueError):
-            return 400, {"error": "'top_k'/'seed' must be ints, 'top_p' "
-                                  "a float"}
-        try:
-            fut = decoder.submit(tokens, max_new, temperature, stop,
+            fut = decoder.submit(tokens, o["max_new"], o["temperature"],
+                                 o["stop"],
                                  deadline=self._deadline_from(payload),
-                                 top_k=top_k, top_p=top_p, seed=seed,
-                                 rid=rid)
+                                 top_k=o["top_k"], top_p=o["top_p"],
+                                 seed=o["seed"], rid=rid)
         except ValueError as e:
             return 400, {"error": str(e)}
         out_tokens = fut.result(self.request_timeout_s)
         return 200, {"tokens": out_tokens,
                      "prompt_len": len(tokens)}
+
+    # ------------------------------------------------------------- streaming
+    def start_generate_stream(self, payload: dict,
+                              rid: Optional[str] = None):
+        """Admission for a streamed /generate (ISSUE 18): same shed /
+        validation / error ladder as :meth:`dispatch_post`, but instead
+        of blocking on the future it submits with a queue-backed emit
+        sink and returns ``(200, _GenerateStream)`` for the HTTP handler
+        to drain. Every pre-stream failure returns a plain
+        ``(status, body)`` — errors before the first byte stay ordinary
+        JSON responses."""
+        rt = _reqtrace.get()
+        if rt is not None:
+            toks = payload.get("tokens")
+            prompt_n = (len(toks) if isinstance(toks, (list, tuple))
+                        else None)
+            try:
+                max_new = int(payload.get("max_new_tokens", 16))
+            except (TypeError, ValueError):
+                max_new = None
+            rid = rt.admit("generate", rid, prompt_tokens=prompt_n,
+                           max_new=max_new)
+        if self._shed_generate():
+            self._m_shed.inc()
+            self._m_errors.inc()
+            if rt is not None:
+                rt.finish(rid, "shed", status=429)
+            return 429, {"error": "overloaded: shedding /generate "
+                                  "(retry, or use /predict capacity)"}
+        parsed, err = self._parse_generate(payload)
+        if parsed is None:
+            self._m_errors.inc()
+            if rt is not None:
+                rt.finish(rid, "bad_request", status=400, error=err)
+            return 400, {"error": err}
+        tokens, o = parsed
+        q: _queue_mod.Queue = _queue_mod.Queue()
+        try:
+            _fault_hook("request")  # no-op unless --faultPlan installed
+            _, _, decoder = self._route("generate", rid)
+            if decoder is None:
+                err = ("no /generate decoder for this model "
+                       "(serve a transformer_lm* model)")
+                self._m_errors.inc()
+                if rt is not None:
+                    rt.finish(rid, "bad_request", status=400, error=err)
+                return 400, {"error": err}
+            # emit runs under the engine lock: only hand the round's
+            # tokens to the drain thread, never block
+            fut = decoder.submit(
+                tokens, o["max_new"], o["temperature"], o["stop"],
+                deadline=self._deadline_from(payload),
+                top_k=o["top_k"], top_p=o["top_p"], seed=o["seed"],
+                rid=rid,
+                emit=lambda new, done: q.put((list(new), done)))
+        except ValueError as e:
+            self._m_errors.inc()
+            if rt is not None:
+                rt.finish(rid, "bad_request", status=400, error=str(e))
+            return 400, {"error": str(e)}
+        except AdmissionError as e:
+            self._m_errors.inc()
+            if rt is not None:
+                rt.finish(rid, "rejected", status=429, error=str(e))
+            return 429, {"error": str(e)}
+        except DeadlineExceeded as e:
+            self._m_expired.inc()
+            self._m_errors.inc()
+            if rt is not None:
+                rt.finish(rid, "expired", status=504, error=str(e))
+            return 504, {"error": f"deadline exceeded: {e}"}
+        except WorkerDied as e:
+            self._m_worker_dead.inc()
+            self._m_errors.inc()
+            if rt is not None:
+                rt.finish(rid, "worker_dead", status=503, error=str(e))
+            return 503, {"error": str(e)}
+        except TransientFault as e:
+            self._m_injected.inc()
+            self._m_errors.inc()
+            if rt is not None:
+                rt.finish(rid, "error", status=503,
+                          error=f"injected fault: {e}")
+            return 503, {"error": f"injected fault: {e}"}
+        except Exception as e:
+            logger.exception("/generate stream admission failed")
+            self._m_errors.inc()
+            if rt is not None:
+                rt.finish(rid, "error", status=500,
+                          error=f"{type(e).__name__}: {e}")
+            return 500, {"error": f"{type(e).__name__}: {e}"}
+        return 200, _GenerateStream(rid, q, fut, decoder, len(tokens))
+
+    def finish_generate_stream(self, rid: Optional[str], ok: bool,
+                               t0: float) -> None:
+        """Account a drained stream the way :meth:`dispatch_post`
+        accounts a buffered response: request/latency metrics and the
+        lifecycle status annotation on success (the engine already
+        terminalized the record — this only fills in HTTP 200), error
+        counter otherwise (the terminal state was stamped where the
+        failure happened)."""
+        if ok:
+            self._m_requests["generate"].inc()
+            self._m_latency["generate"].observe(
+                (time.perf_counter() - t0) * 1000.0)
+            rt = _reqtrace.get()
+            if rt is not None:
+                rt.finish(rid, "finished", status=200)
+        else:
+            self._m_errors.inc()
 
     def handle_metrics(self) -> str:
         return self.metrics.render()
@@ -493,9 +633,94 @@ class _Handler(BaseHTTPRequestHandler):
         except (ValueError, json.JSONDecodeError) as e:
             self._send_json(400, {"error": f"bad JSON: {e}"}, rid=rid)
             return
+        if self.path.strip("/") == "generate" and payload.get("stream"):
+            self._stream_generate(payload, rid)
+            return
         status, body = self.app.dispatch_post(self.path, payload,
                                               rid=rid)
         self._send_json(status, body, rid=rid)
+
+    # ------------------------------------------------------------- streaming
+    def _write_chunk(self, data: bytes) -> None:
+        """One HTTP/1.1 chunked-transfer frame (``b""`` terminates)."""
+        self.wfile.write(b"%x\r\n" % len(data) + data + b"\r\n")
+        self.wfile.flush()
+
+    @staticmethod
+    def _sse(obj: dict) -> bytes:
+        return b"data: " + json.dumps(obj).encode() + b"\n\n"
+
+    def _stream_generate(self, payload: dict, rid: str) -> None:
+        """Streamed /generate (ISSUE 18): chunked-transfer SSE frames,
+        one per emitting decode round (only ACCEPTED tokens under
+        ``--speculate``, so concatenating the frames is bit-identical to
+        the buffered response), a final ``{"done": true}`` frame, and
+        client-disconnect detection — a failed write cancels the slot
+        mid-decode, releasing its paged-KV pages back to the
+        allocator."""
+        app = self.app
+        t0 = time.perf_counter()
+        status, obj = app.start_generate_stream(payload, rid=rid)
+        if status != 200:
+            self._send_json(status, obj, rid=rid)
+            return
+        stream: _GenerateStream = obj
+        rt = _reqtrace.get()
+        ok = False
+        first = True
+        n_out = 0
+        deadline = time.monotonic() + app.request_timeout_s
+        try:
+            self.send_response(200)
+            self.send_header("x-request-id", rid)
+            self.send_header("Content-Type", "text/event-stream")
+            self.send_header("Cache-Control", "no-cache")
+            self.send_header("Transfer-Encoding", "chunked")
+            self.send_header("Connection", "close")
+            self.end_headers()
+            self.close_connection = True
+            while True:
+                try:
+                    toks, done = stream.queue.get(timeout=0.05)
+                except _queue_mod.Empty:
+                    if stream.future.done() and stream.queue.empty():
+                        # terminated without a final emit: deadline
+                        # expiry, cancel, or shutdown — surface the
+                        # error as the last frame
+                        try:
+                            stream.future.result(0)
+                            err = "stream ended without tokens"
+                        except Exception as e:
+                            err = str(e)
+                        self._write_chunk(self._sse({"error": err}))
+                        break
+                    if time.monotonic() > deadline:
+                        stream.decoder.cancel(
+                            rid, reason="server stream timeout")
+                        self._write_chunk(
+                            self._sse({"error": "stream timeout"}))
+                        break
+                    continue
+                if first and rt is not None:
+                    # first byte is about to hit the wire: THIS is the
+                    # TTFT the client feels, and what --slo judges
+                    rt.note_first_byte(rid)
+                self._write_chunk(self._sse({"tokens": toks}))
+                first = False
+                n_out += len(toks)
+                if done:
+                    self._write_chunk(self._sse(
+                        {"done": True, "prompt_len": stream.prompt_len,
+                         "tokens_out": n_out}))
+                    ok = True
+                    break
+            self._write_chunk(b"")  # terminating chunk
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            # the client went away mid-stream: free the slot and its KV
+            # page reservation NOW instead of decoding into a dead pipe
+            stream.decoder.cancel(rid)
+        finally:
+            app.finish_generate_stream(rid, ok, t0)
 
     def log_message(self, fmt, *args):  # route access logs to logging
         logger.debug("%s - %s", self.address_string(), fmt % args)

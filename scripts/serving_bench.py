@@ -198,10 +198,15 @@ def make_payload(args):
 
 
 def closed_loop(url, args):
-    """N workers, each fire-wait-fire until the shared budget drains."""
+    """N workers, each fire-wait-fire until the shared budget drains.
+    With ``--stream`` (generate only) each request rides the chunked
+    SSE path instead, recording client-side first-byte latency — the
+    streamed half of the r22 TTFT/TPOT A/B."""
     payload = make_payload(args)
     path = f"{url}/{args.endpoint}"
-    lat, errors, lock = [], [0], threading.Lock()
+    stream = bool(getattr(args, "stream", False)) \
+        and args.endpoint == "generate"
+    lat, ttfb, errors, lock = [], [], [0], threading.Lock()
     budget = [args.requests]
     new_tokens = [0]
 
@@ -213,6 +218,17 @@ def closed_loop(url, args):
                 budget[0] -= 1
             t0 = time.perf_counter()
             try:
+                if stream:
+                    st, frames, t_first, t_done, _ = _stream_generate(
+                        url, payload)
+                    assert st == 200, frames
+                    toks = sum(len(f["tokens"]) for f in frames
+                               if "tokens" in f)
+                    with lock:
+                        lat.append(t_done * 1000.0)
+                        ttfb.append(t_first * 1000.0)
+                        new_tokens[0] += toks
+                    continue
                 _, out = _post(path, payload)
                 dt = (time.perf_counter() - t0) * 1000.0
                 with lock:
@@ -253,6 +269,13 @@ def closed_loop(url, args):
     if args.endpoint == "generate":
         out["tokens_per_second"] = (round(new_tokens[0] / wall, 1)
                                     if wall else None)
+    if stream:
+        ttfb.sort()
+        out["stream"] = True
+        out["first_byte_ms"] = {
+            "p50": round(_percentile(ttfb, 0.50), 3) if ttfb else None,
+            "p95": round(_percentile(ttfb, 0.95), 3) if ttfb else None,
+        }
     return out
 
 
@@ -876,6 +899,184 @@ def run_dp_sweep(args):
     return 0
 
 
+def _companion_keys():
+    """The shared provenance companion-key list (cli/provenance.py),
+    loaded by file path so the bench parent never imports the bigdl_tpu
+    package (whose import pulls jax; see bench.py for the failure mode).
+    """
+    import importlib.util
+    path = os.path.join(REPO, "bigdl_tpu", "cli", "provenance.py")
+    try:
+        spec = importlib.util.spec_from_file_location("_sb_prov", path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return tuple(mod.PROVENANCE_COMPANION_KEYS)
+    except Exception:
+        return ("conv_layouts", "conv_geom", "autotune", "bn_fused",
+                "pipeline", "stall_frac", "data_wait_s")
+
+
+def _stream_generate(url, body, read_frames=None, timeout=120.0):
+    """POST /generate with ``stream: true`` and parse the SSE frames off
+    the chunked response. Returns ``(status, frames, t_first_byte_s,
+    t_done_s, conn)`` — when ``read_frames`` is set, returns after that
+    many token frames WITHOUT closing the connection (``conn`` is live;
+    the disconnect leg closes it mid-decode)."""
+    import http.client
+    from urllib.parse import urlparse
+    u = urlparse(url)
+    conn = http.client.HTTPConnection(u.hostname, u.port, timeout=timeout)
+    t0 = time.perf_counter()
+    conn.request("POST", "/generate",
+                 json.dumps({**body, "stream": True}).encode(),
+                 {"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    if resp.status != 200:
+        try:
+            out = json.loads(resp.read() or b"{}")
+        except ValueError:
+            out = {}
+        conn.close()
+        return resp.status, out, None, None, None
+    assert resp.getheader("Content-Type", "").startswith(
+        "text/event-stream"), resp.getheader("Content-Type")
+    frames, t_first, buf = [], None, b""
+    while True:
+        b1 = resp.read(1)  # http.client undoes the chunked framing
+        if not b1:
+            break
+        if t_first is None:
+            t_first = time.perf_counter() - t0
+        buf += b1
+        while b"\n\n" in buf:
+            raw, buf = buf.split(b"\n\n", 1)
+            if raw.startswith(b"data: "):
+                frames.append(json.loads(raw[len(b"data: "):]))
+        if read_frames is not None and len(
+                [f for f in frames if "tokens" in f]) >= read_frames:
+            return resp.status, frames, t_first, None, conn
+        if frames and frames[-1].get("done"):
+            break
+    t_done = time.perf_counter() - t0
+    conn.close()
+    return resp.status, frames, t_first, t_done, None
+
+
+def run_stream_smoke(args):
+    """ISSUE 18 streaming assertion pass (CI throughput-smoke leg), one
+    server with the full composition on — speculative decoding, paged
+    KV, lifecycle tracing, SLOs:
+
+    leg 1 — bit-identity: for >= 3 fixed greedy prompts the streamed
+    token frames, concatenated, equal the buffered /generate response
+    exactly (speculative path included: only ACCEPTED tokens are ever
+    emitted), and the final frame carries done/prompt_len/tokens_out;
+
+    leg 2 — felt TTFT: the first SSE byte lands well before the
+    buffered response for the same prompt completes, and the
+    server-side ttft_ms histogram (stamped at first-byte-out, feeding
+    --slo) is populated;
+
+    leg 3 — disconnect: a client that walks away mid-stream gets its
+    slot cancelled — decode_cancelled_total moves, kv_pages_in_use
+    returns to the pre-request baseline (no leaked page reservations),
+    and the request lands terminal state ``closed`` in /debug/requests.
+    """
+    extra = (list(args.serveArg)
+             + ["--kvPageTokens", "16", "--speculate", "3",
+                "--reqTrace", "on", "--slo", "ttft=60000,tpot=60000"])
+    prompts = [list(range(1, 9)), list(range(5, 21)),
+               [2, 3, 5, 7, 11, 13]]
+    proc, url, log_lines = spawn_server(args, extra)
+    try:
+        # ---- leg 1: streamed == buffered, per prompt, bit for bit
+        first_ms = full_ms = None
+        for i, prompt in enumerate(prompts):
+            body = {"tokens": prompt, "max_new_tokens": 24,
+                    "temperature": 0.0}
+            t0 = time.perf_counter()
+            st, ref = _post(url + "/generate", body)
+            buffered_s = time.perf_counter() - t0
+            assert st == 200, f"buffered /generate -> {st}"
+            st, frames, t_first, t_done, _ = _stream_generate(url, body)
+            assert st == 200, f"streamed /generate -> {st}"
+            toks = [t for f in frames if "tokens" in f
+                    for t in f["tokens"]]
+            assert toks == ref["tokens"], (
+                f"streamed output diverged on prompt {i}:\n"
+                f"  buffered {ref['tokens']}\n  streamed {toks}")
+            final = frames[-1]
+            assert final.get("done") is True, final
+            assert final.get("prompt_len") == len(prompt), final
+            assert final.get("tokens_out") == len(toks), final
+            if i == 0:
+                # ---- leg 2: first byte beats the full round trip
+                assert t_first < t_done, (t_first, t_done)
+                assert t_first < buffered_s, (
+                    f"first SSE byte ({t_first * 1000:.1f} ms) not ahead "
+                    f"of the buffered response ({buffered_s * 1000:.1f} "
+                    f"ms)")
+                first_ms = round(t_first * 1000, 2)
+                full_ms = round(buffered_s * 1000, 2)
+        _, page = _get(url + "/metrics")
+        ttft = scrape_quantile(page, "ttft_ms", "0.5")
+        assert ttft is not None and ttft > 0, \
+            "ttft_ms histogram empty — first-byte stamp not feeding SLOs"
+        print(f"stream-smoke: {len(prompts)} prompts bit-identical "
+              f"(speculate on), first byte {first_ms} ms vs buffered "
+              f"{full_ms} ms, ttft_ms populated OK", flush=True)
+
+        # ---- leg 3: mid-stream disconnect frees the slot + pages
+        _, page = _get(url + "/metrics")
+        base_pages = scrape_value(page, "kv_pages_in_use") or 0
+        st, frames, _, _, conn = _stream_generate(
+            url, {"tokens": list(range(1, 9)), "max_new_tokens": 48,
+                  "temperature": 0.0}, read_frames=1)
+        assert st == 200 and conn is not None, (st, frames)
+        conn.close()  # walk away mid-decode
+        deadline = time.time() + 60
+        cancelled = pages_ok = False
+        while time.time() < deadline:
+            _, page = _get(url + "/metrics")
+            cancelled = (scrape_value(page,
+                                      "decode_cancelled_total") or 0) >= 1
+            pages_ok = (scrape_value(page, "kv_pages_in_use")
+                        or 0) <= base_pages
+            if cancelled and pages_ok:
+                break
+            time.sleep(0.2)
+        assert cancelled, "decode_cancelled_total never moved after " \
+                          "client disconnect"
+        assert pages_ok, "kv_pages_in_use never returned to baseline " \
+                         "(leaked page reservations)"
+        st, txt = _get_status(url + "/debug/requests")
+        assert st == 200, st
+        recent = json.loads(txt).get("recent", [])
+        closed = [r for r in recent if r.get("state") == "closed"]
+        assert closed, f"no terminal-state closed record: {recent}"
+        # a fresh request still runs on the freed slot
+        st, out = _post(url + "/generate",
+                        {"tokens": [1, 2, 3], "max_new_tokens": 4})
+        assert st == 200 and out["tokens"], (st, out)
+        print("stream-smoke: disconnect cancelled mid-decode, pages "
+              "freed, state=closed, slot reusable OK", flush=True)
+
+        prov, _ = scrape_provenance(url)
+        record = {"bench": "serving_stream_smoke",
+                  "prompts": len(prompts), "bit_identical": True,
+                  "first_byte_ms": first_ms, "buffered_ms": full_ms,
+                  "server_ttft_p50_ms": ttft,
+                  "disconnect_freed_pages": True,
+                  **{k: prov[k] for k in _companion_keys()
+                     if k in (prov or {})}}
+        print(json.dumps(record), flush=True)
+    finally:
+        _shutdown_clean(proc, log_lines)
+    print("stream-smoke: all ISSUE 18 streaming assertions OK",
+          flush=True)
+    return 0
+
+
 def _shutdown_clean(proc, log_lines):
     proc.send_signal(signal.SIGTERM)
     try:
@@ -969,6 +1170,12 @@ def main(argv=None):
                    help="rows per /predict request")
     p.add_argument("--promptLen", type=int, default=16)
     p.add_argument("--maxNewTokens", type=int, default=16)
+    p.add_argument("--stream", action="store_true",
+                   help="drive the load through the chunked-SSE "
+                        "/generate path instead of buffered responses; "
+                        "adds client-side first_byte_ms percentiles to "
+                        "the JSON line (the streamed half of the "
+                        "streamed-vs-buffered TTFT/TPOT A/B)")
     p.add_argument("--seq", type=int, default=None)
     p.add_argument("--platform", default=None, choices=["cpu", "tpu"])
     p.add_argument("--smoke", action="store_true",
@@ -999,6 +1206,14 @@ def main(argv=None):
                         "deadline-expiry 504, worker-kill fast 503 + "
                         "watchdog readiness flip (spawns its own "
                         "servers)")
+    p.add_argument("--streamSmoke", action="store_true",
+                   help="streaming /generate assertion pass (ISSUE 18): "
+                        "streamed SSE tokens bit-identical to buffered "
+                        "(speculate+paged KV on), first byte ahead of "
+                        "the buffered round trip with ttft_ms fed at "
+                        "first-byte-out, and a mid-stream disconnect "
+                        "cancels the slot + frees KV pages with "
+                        "terminal state closed (spawns its own server)")
     p.add_argument("--tpSmoke", action="store_true",
                    help="multi-chip serving assertion pass (ISSUE 16): "
                         "--strategy tp:2 /generate bit-identical to "
@@ -1037,6 +1252,8 @@ def main(argv=None):
         return run_quant_smoke(args)
     if args.sloSmoke:
         return run_slo_smoke(args)
+    if args.streamSmoke:
+        return run_stream_smoke(args)
     if args.tpSmoke:
         return run_tp_smoke(args)
     if args.dpSweep:

@@ -1,0 +1,278 @@
+"""``bigdl-tpu batch-predict`` + serving/bulk.py (ISSUE 18 tentpole a):
+the sharded sink and cursor contract in isolation (fake engine — no
+compile cost), then the CLI end to end over real record shards —
+executor-fed scores bit-identical to driving the engine by hand
+(including the tail-remainder partial batch), ``--strategy dp:2``
+coverage with no duplicated or dropped record, kill+resume output
+byte-identical to an uninterrupted run, and the perf-JSON phase columns
+(``stall_frac``) filled under ``--obs``."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from bigdl_tpu.serving import bulk
+
+B = 4          # CLI batch size; 22 records -> 5 full batches + tail of 2
+CLASSES = 10
+
+
+@pytest.fixture(autouse=True)
+def _obs_reset():
+    from bigdl_tpu import obs
+
+    obs.disable()
+    yield
+    obs.disable()
+
+
+# -------------------------------------------------- sink + cursor (no jax)
+def test_shard_sink_deterministic_and_truncating(tmp_path):
+    path = str(tmp_path / "scores-00000-of-00001.jsonl")
+    sink = bulk.ShardSink(path)
+    sink.write_batch([0, 1], [3, 4],
+                     np.asarray([[0.5, 0.25], [1.0, 2.0]]))
+    sink.flush()
+    mid = sink.offset
+    sink.write_batch([2], [5])
+    sink.flush()
+    sink.close()
+    with open(path, "rb") as f:
+        full = f.read()
+    assert full.decode().splitlines()[0] == json.dumps(
+        {"i": 0, "pred": 3, "scores": [0.5, 0.25]}, sort_keys=True)
+    # resume_offset truncates the un-checkpointed suffix before appending
+    sink = bulk.ShardSink(path, resume_offset=mid)
+    assert sink.offset == mid
+    sink.write_batch([2], [5])
+    sink.flush()
+    sink.close()
+    with open(path, "rb") as f:
+        assert f.read() == full
+    rows = bulk.merge_shards(str(tmp_path))
+    assert [r["i"] for r in rows] == [0, 1, 2]
+
+
+class _FakeEngine:
+    """Deterministic stand-in for InferenceEngine.predict_scores."""
+
+    def predict_scores(self, x):
+        flat = np.asarray(x, np.float64).reshape(len(x), -1)
+        return np.stack([flat[:, :5].sum(axis=1),
+                         flat[:, 5:10].sum(axis=1)], axis=1)
+
+
+def _fake_feed(n_batches=6, batch=4):
+    for s in range(n_batches):
+        idx = np.arange(s * batch, (s + 1) * batch)
+        x = ((idx[:, None] * 13 + np.arange(12)) % 7).astype(np.float32)
+        yield s, idx, x
+
+
+_SIG = {"plan": {"n": 24, "batch": 4}, "scores": True}
+
+
+def _read_shards(out_dir):
+    out = {}
+    for name in sorted(os.listdir(out_dir)):
+        if name.startswith("scores-"):
+            with open(os.path.join(out_dir, name), "rb") as f:
+                out[name] = f.read()
+    return out
+
+
+def test_run_bulk_kill_resume_byte_identical(tmp_path):
+    """The acceptance contract at the bulk layer: kill after the
+    checkpoint barrier, resume, and the output bytes equal an
+    uninterrupted run — batch 2 (dispatched after the last barrier) is
+    truncated on resume and rescored exactly once."""
+    a, b = str(tmp_path / "a"), str(tmp_path / "b")
+    ref = bulk.run_bulk([_FakeEngine()], _fake_feed(), dict(_SIG), a,
+                        scores=True, checkpoint_every=2)
+    assert ref["records"] == 24 and ref["resumed_from_batch"] == 0
+
+    def _kill(ordinal):
+        if ordinal >= 3:
+            raise RuntimeError("simulated kill")
+
+    with pytest.raises(RuntimeError, match="simulated kill"):
+        bulk.run_bulk([_FakeEngine()], _fake_feed(), dict(_SIG), b,
+                      scores=True, checkpoint_every=2, on_batch=_kill)
+    cur = bulk.load_cursor(b)
+    assert cur is not None and cur["next_batch"] == 2  # last barrier
+    rep = bulk.run_bulk([_FakeEngine()], _fake_feed(), dict(_SIG), b,
+                        scores=True, checkpoint_every=2)
+    assert rep["resumed_from_batch"] == 2
+    assert rep["batches_scored_this_run"] == 4  # 2..5, no re-score of 0-1
+    assert rep["records"] == 24
+    assert _read_shards(b) == _read_shards(a)
+    assert bulk.load_cursor(b)["next_batch"] == 6
+
+
+def test_run_bulk_resume_refuses_drifted_feed(tmp_path):
+    out = str(tmp_path / "o")
+    bulk.run_bulk([_FakeEngine()], _fake_feed(), dict(_SIG), out,
+                  scores=True, checkpoint_every=2)
+    with pytest.raises(ValueError, match="different feed"):
+        bulk.run_bulk([_FakeEngine()], _fake_feed(),
+                      {**_SIG, "scores": False}, out, checkpoint_every=2)
+    with pytest.raises(ValueError, match="changed --strategy"):
+        bulk.run_bulk([_FakeEngine(), _FakeEngine()], _fake_feed(),
+                      dict(_SIG), out, scores=True, checkpoint_every=2)
+
+
+# ------------------------------------------------------- CLI, real engine
+# The CLI tier compiles real model forwards (seconds each on CPU), so it
+# is `slow`-marked out of the tier-1 sweep; the tier1.yml
+# throughput-smoke job runs this file unfiltered on every push.
+@pytest.fixture(scope="module")
+def record_shards(tmp_path_factory):
+    from PIL import Image
+
+    from bigdl_tpu.dataset.recordfile import write_image_shards
+
+    root = tmp_path_factory.mktemp("bp_records")
+    rng = np.random.RandomState(0)
+    for cls in ("a", "b"):
+        d = root / "imgs" / cls
+        d.mkdir(parents=True)
+        for i in range(11):  # 22 records: 5 full b=4 batches + tail of 2
+            arr = rng.randint(0, 255, (40, 48, 3)).astype(np.uint8)
+            Image.fromarray(arr).save(d / f"{i}.png")
+    out = str(root / "shards")
+    write_image_shards(str(root / "imgs"), out, images_per_shard=8)
+    return out
+
+
+def _run_cli(shards, out, *extra):
+    from bigdl_tpu.cli import batch_predict
+
+    return batch_predict.main(
+        ["--modelName", "resnet20_cifar", "--randomInit",
+         "-f", f"record:{shards}", "--out", str(out),
+         "-b", str(B), "--classNum", str(CLASSES),
+         "--checkpointEvery", "2", "--platform", "cpu", *extra])
+
+
+@pytest.fixture(scope="module")
+def reference(record_shards):
+    """Preds/scores from driving the engine by hand over the same
+    eval-mode source in the same batch chunking the CLI's plan
+    produces — the executor path must match this bit for bit."""
+    import jax
+
+    from bigdl_tpu.cli.perf import _short_side, build_model
+    from bigdl_tpu.dataset.pipeline import StreamingSampleSource
+    from bigdl_tpu.dataset.streaming import RecordImageDataSet
+    from bigdl_tpu.serving import InferenceEngine, power_of_two_buckets
+    from bigdl_tpu.serving.sharding import (replica_device_groups,
+                                            serving_mesh)
+
+    model, size = build_model("resnet20_cifar", class_num=CLASSES)
+    crop = tuple(size[:2])
+    params = model.init(jax.random.PRNGKey(0))  # the --randomInit params
+    rds = RecordImageDataSet(record_shards, batch_size=B, crop=crop,
+                             train=False, short_side=_short_side(crop),
+                             mean=[123.68, 116.779, 103.939],
+                             std=[58.4, 57.1, 57.4], n_threads=1, window=1)
+    src = StreamingSampleSource(rds)
+    n = len(src)
+    assert n == 22
+    eng = InferenceEngine(model, params, None,
+                          buckets=power_of_two_buckets(B),
+                          mesh=serving_mesh(replica_device_groups(1, 1)[0]))
+    preds, scores = [], []
+    for s in range(0, n, B):
+        mb = src.collate([src.load(i, 0) for i in range(s, min(s + B, n))])
+        y = np.asarray(eng.predict_scores(mb.input))
+        preds.extend(int(v) for v in np.argmax(y, axis=-1))
+        scores.append(np.asarray(y, np.float64))
+    return {"n": n, "preds": preds, "scores": np.concatenate(scores)}
+
+
+@pytest.mark.slow
+def test_cli_parity_with_direct_engine(record_shards, reference, tmp_path):
+    """Executor feed -> engine == hand-driven engine, including the tail
+    remainder (22 % 4 = 2 records the EpochPlan would drop)."""
+    out = tmp_path / "out"
+    rep = _run_cli(record_shards, out, "--scores", "--dataWorkers", "2")
+    n = reference["n"]
+    assert rep["records"] == n and rep["batches"] == 6
+    assert rep["resumed_from_batch"] == 0
+    assert rep["images_per_second"] > 0
+    assert rep["pipeline"]["workers"] == 2
+    assert rep["bn_fused"] is not None  # provenance columns stamped
+    assert rep["stall_frac"] is None    # obs off -> schema-stable nulls
+    rows = bulk.merge_shards(str(out))
+    assert [r["i"] for r in rows] == list(range(n))  # every record once
+    assert [r["pred"] for r in rows] == reference["preds"]
+    got = np.asarray([r["scores"] for r in rows], np.float64)
+    assert np.array_equal(got, reference["scores"])  # bit-identical
+
+
+@pytest.mark.slow
+def test_cli_dp2_coverage_no_dup_no_drop(record_shards, reference,
+                                         tmp_path):
+    """dp:2 fans batches round-robin over two engines on disjoint
+    virtual-device groups: two shards, together covering every record
+    exactly once, scores unchanged from the single-engine run."""
+    out = tmp_path / "out"
+    rep = _run_cli(record_shards, out, "--strategy", "dp:2")
+    assert rep["groups"] == 2 and rep["chips"] == 2
+    shards = bulk.shard_paths(str(out), 2)
+    assert all(os.path.getsize(p) > 0 for p in shards)
+    per_shard = []
+    for p in shards:
+        with open(p) as f:
+            per_shard.append([json.loads(ln)["i"] for ln in f])
+    # ordinal s lands in shard s % 2: shard 0 = batches 0,2,4; the tail
+    # partial batch (ordinal 5) lands in shard 1
+    assert per_shard[0][:4] == [0, 1, 2, 3]
+    assert per_shard[1][:4] == [4, 5, 6, 7]
+    rows = bulk.merge_shards(str(out))
+    assert [r["i"] for r in rows] == list(range(reference["n"]))
+    assert [r["pred"] for r in rows] == reference["preds"]
+
+
+@pytest.mark.slow
+def test_cli_kill_resume_byte_identical(record_shards, tmp_path,
+                                        monkeypatch):
+    """Kill the CLI mid-job (simulated via the on_batch hook), rerun the
+    same command line, and the output shards are byte-identical to an
+    uninterrupted run — no re-scored, no dropped records."""
+    pristine, killed = tmp_path / "a", tmp_path / "b"
+    _run_cli(record_shards, pristine)
+
+    orig = bulk.run_bulk
+
+    def _with_kill(engines, feed, signature, out_dir, **kw):
+        def _boom(ordinal):
+            if ordinal >= 3:
+                raise RuntimeError("simulated kill")
+        kw["on_batch"] = _boom
+        return orig(engines, feed, signature, out_dir, **kw)
+
+    monkeypatch.setattr(bulk, "run_bulk", _with_kill)
+    with pytest.raises(RuntimeError, match="simulated kill"):
+        _run_cli(record_shards, killed)
+    monkeypatch.setattr(bulk, "run_bulk", orig)
+    rep = _run_cli(record_shards, killed)
+    assert rep["resumed_from_batch"] == 2  # checkpointEvery=2 barrier
+    assert rep["records"] == 22
+    assert rep["records_scored_this_run"] < 22  # batches 0-1 not redone
+    assert _read_shards(str(killed)) == _read_shards(str(pristine))
+
+
+@pytest.mark.slow
+def test_cli_stall_frac_filled_under_obs(record_shards, tmp_path):
+    """--obs turns the schema-stable null phase columns into measured
+    values — stall_frac is the number the ISSUE grades batch-predict
+    on."""
+    rep = _run_cli(record_shards, tmp_path / "out", "--obs",
+                   "--dataWorkers", "2")
+    assert rep["stall_frac"] is not None
+    assert 0.0 <= rep["stall_frac"] <= 1.0
+    assert rep["data_wait_s"] is not None and rep["data_wait_s"] >= 0.0
+    assert rep["device_s"] is not None and rep["device_s"] > 0.0

@@ -1,0 +1,385 @@
+"""Streaming /generate + mid-decode cancellation (ISSUE 18 tentpole b):
+the emit sink contract on DecodeEngine (plain and speculative — only
+ACCEPTED tokens ever reach a stream), first-class ``cancel(rid)``
+including the slot/page cleanup and the verify-dispatch interleave, and
+the HTTP layer end-to-end — chunked-transfer SSE framing, streamed
+output bit-identical to buffered, first-byte TTFT feeding the --slo
+histograms, and client-disconnect cancellation with no leaked KV
+pages."""
+
+import http.client
+import json
+import socket
+import threading
+import time
+import urllib.request
+
+import jax
+import numpy as np
+import pytest
+
+from bigdl_tpu import models
+from bigdl_tpu.serving import DecodeEngine, MetricsRegistry
+
+
+@pytest.fixture(scope="module")
+def tiny_lm():
+    m = models.transformer_lm(50, d_model=32, num_layers=2, num_heads=2,
+                              max_len=64)
+    return m, m.init(jax.random.PRNGKey(1))
+
+
+def _offline_greedy(model, params, prompt, n):
+    seq = [int(t) for t in prompt]
+    out = []
+    for _ in range(n):
+        logp, _ = model.apply(params, model.init_state(),
+                              np.asarray([seq], np.int32))
+        tok = int(np.argmax(np.asarray(logp)[0, -1]))
+        out.append(tok)
+        seq.append(tok)
+    return out
+
+
+def _drive(de, *futs):
+    steps = 0
+    while not all(f.done() for f in futs):
+        de.step()
+        steps += 1
+        assert steps < 200
+    return steps
+
+
+# ------------------------------------------------ emit sink (engine level)
+def test_decode_emit_streams_every_token(tiny_lm):
+    """The emit sink sees every generated token exactly once, in order,
+    with done=True on the last call — and the request's buffered result
+    is unchanged by having a sink attached (bit-identity is structural:
+    the same _emit feeds both)."""
+    model, params = tiny_lm
+    de = DecodeEngine(model, params, slots=2)
+    chunks = []
+    fut = de.submit([3, 1, 4, 1, 5], 6,
+                    emit=lambda toks, done: chunks.append(
+                        (list(toks), done)))
+    _drive(de, fut)
+    got = fut.result()
+    assert got == _offline_greedy(model, params, [3, 1, 4, 1, 5], 6)
+    assert [t for toks, _ in chunks for t in toks] == got
+    assert all(toks for toks, _ in chunks)
+    assert [d for _, d in chunks] == [False] * (len(chunks) - 1) + [True]
+
+
+@pytest.mark.slow
+def test_decode_emit_speculative_accepted_only(tiny_lm):
+    """Under speculative decoding the sink must only ever see ACCEPTED
+    tokens — the streamed concatenation equals the plain engine's
+    output bit for bit, never a speculated-then-rejected draft."""
+    model, params = tiny_lm
+    prompt = [7, 8, 9, 10]
+    plain = DecodeEngine(model, params, slots=2).generate(prompt, 8)
+    de = DecodeEngine(model, params, slots=2, speculate=4)
+    chunks = []
+    fut = de.submit(prompt, 8,
+                    emit=lambda toks, done: chunks.append(list(toks)))
+    _drive(de, fut)
+    assert fut.result() == plain
+    assert [t for toks in chunks for t in toks] == plain
+
+
+# --------------------------------------------------- cancel (engine level)
+def test_cancel_waiting_request(tiny_lm):
+    model, params = tiny_lm
+    de = DecodeEngine(model, params, slots=1)
+    f1 = de.submit([9, 9], 3, rid="keep")
+    f2 = de.submit([2, 3, 4], 3, rid="drop")  # waits for the one slot
+    assert de.cancel("drop") is True
+    with pytest.raises(RuntimeError, match="cancelled"):
+        f2.result(timeout=0)
+    _drive(de, f1)
+    assert f1.result() == _offline_greedy(model, params, [9, 9], 3)
+    # cancelling the same rid again (or a finished one) is a no-op
+    assert de.cancel("drop") is False
+    assert de.cancel("keep") is False
+    assert de.cancel(None) is False
+
+
+def test_cancel_active_frees_slot_and_pages(tiny_lm):
+    """Cancelling a request mid-decode releases its slot AND its paged-KV
+    reservation (kv_pages_in_use back to zero), and the freed slot
+    decodes a fresh request exactly — the stale pending sampled token
+    from the cancelled occupant must not leak into the next install."""
+    model, params = tiny_lm
+    reg = MetricsRegistry()
+    de = DecodeEngine(model, params, slots=2, kv_page_tokens=8,
+                      metrics=reg)
+    fut = de.submit([5, 6, 7], 40, rid="gone")
+    for _ in range(3):
+        de.step()
+    assert de.kv_pages_in_use() > 0
+    assert de.cancel("gone") is True
+    with pytest.raises(RuntimeError, match="cancelled"):
+        fut.result(timeout=0)
+    assert de.kv_pages_in_use() == 0
+    assert reg._metrics["decode_cancelled_total"].value == 1
+    f2 = de.submit([1, 2, 3], 4)
+    _drive(de, f2)
+    assert f2.result() == _offline_greedy(model, params, [1, 2, 3], 4)
+    assert de.kv_pages_in_use() == 0
+
+
+@pytest.mark.slow
+def test_cancel_speculative_interleaved_with_steps(tiny_lm):
+    """The verify-dispatch/accept race: cancel() fired from another
+    thread while a speculative engine is stepping. The lock discipline
+    (step holds the engine lock for the whole draft/verify/accept
+    round) means the cancel lands between rounds — the cancelled future
+    fails, the survivor stays bit-identical, nothing deadlocks."""
+    model, params = tiny_lm
+    prompt = [1, 2, 3, 4, 5]
+    plain = DecodeEngine(model, params, slots=2).generate(prompt, 10)
+    de = DecodeEngine(model, params, slots=2, speculate=3)
+    keep = de.submit(prompt, 10, rid="keep")
+    drop = de.submit([6, 7, 8], 30, rid="drop")
+    stop = threading.Event()
+
+    def _stepper():
+        while not stop.is_set() and not keep.done():
+            de.step()
+
+    thr = threading.Thread(target=_stepper)
+    thr.start()
+    try:
+        time.sleep(0.05)  # let both requests get in flight
+        assert de.cancel("drop") is True
+        keep.result(timeout=60)
+    finally:
+        stop.set()
+        thr.join(30)
+    assert not thr.is_alive()
+    with pytest.raises(RuntimeError, match="cancelled"):
+        drop.result(timeout=0)
+    assert keep.result() == plain
+
+
+# -------------------------------------------------------- HTTP streaming
+# The HTTP tier spins a full in-process server (bucketed compiles) —
+# `slow`-marked out of the tier-1 sweep; the tier1.yml
+# throughput-smoke job runs this file unfiltered on every push.
+@pytest.fixture(scope="module")
+def stream_server():
+    """One in-process server with the full composition on: speculative
+    decoding, paged KV, lifecycle tracing, SLOs."""
+    from bigdl_tpu.cli import common, serve as serve_cli
+    from bigdl_tpu.serving import make_server
+
+    args = serve_cli.build_parser().parse_args(
+        ["transformer_lm", "--randomInit", "--vocabSize", "50",
+         "--dModel", "32", "--numLayers", "2", "--numHeads", "2",
+         "--seq", "64", "--slots", "2", "--buckets", "1,2,4",
+         "--maxWaitMs", "2", "--speculate", "3", "--kvPageTokens", "16",
+         "--reqTrace", "on", "--slo", "ttft=60000,tpot=60000"])
+    common.apply_platform(args)
+    app, eng, in_shape, in_dtype = serve_cli.build_app(args)
+    srv = make_server(app, "127.0.0.1", 0)
+    port = srv.server_address[1]
+    thr = threading.Thread(target=srv.serve_forever, daemon=True)
+    thr.start()
+    try:
+        yield port
+    finally:
+        srv.shutdown()
+        srv.server_close()
+        app.close()
+
+
+def _post(port, path, body, timeout=120):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}", data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, json.loads(r.read())
+
+
+def _get(port, path):
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}",
+                                timeout=30) as r:
+        return r.status, r.read().decode()
+
+
+def _metric(page, name):
+    for line in page.splitlines():
+        parts = line.split()
+        if len(parts) == 2 and parts[0] in (name,
+                                            "bigdl_serving_" + name):
+            try:
+                return float(parts[1])
+            except ValueError:
+                return None
+    return None
+
+
+def _stream(port, body, read_frames=None, timeout=120):
+    """Streamed /generate via http.client (which undoes the chunked
+    framing). Returns (status, frames, t_first_s, conn_or_None)."""
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    t0 = time.perf_counter()
+    conn.request("POST", "/generate",
+                 json.dumps({**body, "stream": True}).encode(),
+                 {"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    if resp.status != 200:
+        out = json.loads(resp.read() or b"{}")
+        conn.close()
+        return resp.status, out, None, None
+    frames, t_first, buf = [], None, b""
+    while True:
+        b1 = resp.read(1)
+        if not b1:
+            break
+        if t_first is None:
+            t_first = time.perf_counter() - t0
+        buf += b1
+        while b"\n\n" in buf:
+            raw, buf = buf.split(b"\n\n", 1)
+            if raw.startswith(b"data: "):
+                frames.append(json.loads(raw[len(b"data: "):]))
+        if read_frames is not None and len(
+                [f for f in frames if "tokens" in f]) >= read_frames:
+            return resp.status, frames, t_first, conn
+        if frames and frames[-1].get("done"):
+            break
+    conn.close()
+    return resp.status, frames, t_first, None
+
+
+@pytest.mark.slow
+def test_stream_chunked_sse_wire_framing(stream_server):
+    """Raw-socket check of the wire format: chunked transfer encoding
+    (hex-length frames, 0-terminator), text/event-stream content type,
+    and every chunk decoding to ``data: {json}`` SSE frames."""
+    port = stream_server
+    body = json.dumps({"tokens": [3, 1, 4], "max_new_tokens": 4,
+                       "stream": True}).encode()
+    with socket.create_connection(("127.0.0.1", port), timeout=60) as s:
+        s.sendall(b"POST /generate HTTP/1.1\r\n"
+                  b"Host: 127.0.0.1\r\n"
+                  b"Content-Type: application/json\r\n"
+                  + b"Content-Length: %d\r\n\r\n" % len(body) + body)
+        s.settimeout(60)
+        raw = b""
+        while b"0\r\n\r\n" not in raw:
+            got = s.recv(4096)
+            if not got:
+                break
+            raw += got
+    head, _, payload = raw.partition(b"\r\n\r\n")
+    headers = head.decode().lower()
+    assert "http/1.1 200" in headers
+    assert "transfer-encoding: chunked" in headers
+    assert "content-type: text/event-stream" in headers
+    assert "x-request-id:" in headers
+    # undo the chunked framing by hand: <hex>\r\n<data>\r\n ... 0\r\n\r\n
+    frames_raw, rest = b"", payload
+    while rest:
+        size_s, _, rest = rest.partition(b"\r\n")
+        size = int(size_s, 16)
+        if size == 0:
+            break
+        frames_raw += rest[:size]
+        assert rest[size:size + 2] == b"\r\n"
+        rest = rest[size + 2:]
+    frames = [json.loads(f[len(b"data: "):])
+              for f in frames_raw.split(b"\n\n") if f]
+    assert all(("tokens" in f) or f.get("done") for f in frames)
+    final = frames[-1]
+    assert final["done"] is True and final["prompt_len"] == 3
+    assert final["tokens_out"] == sum(
+        len(f["tokens"]) for f in frames if "tokens" in f) == 4
+
+
+@pytest.mark.slow
+def test_stream_bit_identical_to_buffered(stream_server):
+    """Streamed tokens, concatenated, equal the buffered response for
+    the same prompt — with the speculative path ON, so only accepted
+    tokens ever reached the stream."""
+    port = stream_server
+    for prompt in ([3, 1, 4, 1, 5], list(range(5, 21)), [2, 2, 2]):
+        body = {"tokens": prompt, "max_new_tokens": 12,
+                "temperature": 0.0}
+        st, ref = _post(port, "/generate", body)
+        assert st == 200
+        st, frames, _, _ = _stream(port, body)
+        assert st == 200
+        toks = [t for f in frames if "tokens" in f for t in f["tokens"]]
+        assert toks == ref["tokens"]
+        assert frames[-1]["tokens_out"] == len(toks)
+        assert frames[-1]["prompt_len"] == len(prompt)
+
+
+@pytest.mark.slow
+def test_stream_first_byte_ttft_feeds_slo(stream_server):
+    """TTFT is measured at first-byte-out for streamed requests and
+    feeds the same --slo histograms/goodput accounting as buffered
+    ones."""
+    port = stream_server
+    _, page = _get(port, "/metrics")
+    done0 = _metric(page, "slo_requests_total") or 0
+    st, frames, t_first, _ = _stream(
+        port, {"tokens": [1, 2, 3, 4], "max_new_tokens": 8})
+    assert st == 200 and frames[-1].get("done")
+    assert t_first is not None
+    _, page = _get(port, "/metrics")
+    assert (_metric(page, "slo_requests_total") or 0) == done0 + 1
+    assert (_metric(page, "slo_good_total") or 0) >= done0 + 1
+    # the server-side ttft histogram populated from the stream
+    count = _metric(page, "ttft_ms_count")
+    assert count is not None and count >= 1
+
+
+@pytest.mark.slow
+def test_stream_disconnect_cancels_and_frees(stream_server):
+    """A client that walks away mid-stream: the slot is cancelled
+    (decode_cancelled_total moves), its KV pages return to the pool,
+    the request lands terminal state ``closed`` in /debug/requests, and
+    the freed slot serves the next request."""
+    port = stream_server
+    _, page = _get(port, "/metrics")
+    base_pages = _metric(page, "kv_pages_in_use") or 0
+    base_cancel = _metric(page, "decode_cancelled_total") or 0
+    st, frames, _, conn = _stream(
+        port, {"tokens": [1, 2, 3, 4, 5, 6, 7, 8],
+               "max_new_tokens": 48}, read_frames=1)
+    assert st == 200 and conn is not None
+    conn.close()  # mid-decode disconnect
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        _, page = _get(port, "/metrics")
+        if ((_metric(page, "decode_cancelled_total") or 0) > base_cancel
+                and (_metric(page, "kv_pages_in_use")
+                     or 0) <= base_pages):
+            break
+        time.sleep(0.1)
+    assert (_metric(page, "decode_cancelled_total") or 0) == \
+        base_cancel + 1, "disconnect never cancelled the slot"
+    assert (_metric(page, "kv_pages_in_use") or 0) <= base_pages, \
+        "leaked KV page reservations after disconnect"
+    st, txt = _get(port, "/debug/requests")
+    assert st == 200
+    recent = json.loads(txt).get("recent", [])
+    assert any(r.get("state") == "closed" for r in recent), recent
+    st, out = _post(port, "/generate",
+                    {"tokens": [4, 5, 6], "max_new_tokens": 4})
+    assert st == 200 and len(out["tokens"]) == 4
+
+
+@pytest.mark.slow
+def test_stream_bad_request_is_plain_json(stream_server):
+    """Pre-stream failures (validation) come back as ordinary JSON
+    errors, not as a 200 SSE stream."""
+    port = stream_server
+    st, out, _, _ = _stream(stream_server,
+                            {"tokens": [1] * 70, "max_new_tokens": 4})
+    assert st == 400 and "exceeds" in out["error"]
+    st, out, _, _ = _stream(port, {"tokens": [], "max_new_tokens": 4})
+    assert st == 400
