@@ -179,7 +179,9 @@ CATALOG: Dict[str, Tuple[str, str, str]] = {
         "tp-strategy serving graph carries a >=1 MiB matmul weight with "
         "fully-replicated placement — every chip runs the full matmul "
         "and tp buys nothing for it (a megatron_specs divisibility gate "
-        "fell back to replication)"),
+        "fell back to replication); alias of the mesh-aware "
+        "shard-replicated-operand rule (ISSUE 19), kept for stable "
+        "serve --lint output"),
 }
 
 UPCAST_MIN_BYTES = 2 * 1024 * 1024    # ignore small/scalar converts
@@ -665,36 +667,20 @@ def run_serving_tp_rules(params, n_shard: int,
     >=1 MiB weight matrix left fully replicated under tp means every
     chip runs that matmul whole (a ``megatron_specs`` divisibility gate
     fell back), which is exactly the perf bug worth refusing to serve.
-    """
+
+    Since ISSUE 19 this is an alias of the mesh-aware
+    :func:`bigdl_tpu.analysis.sharding_rules.run_replicated_operand_rules`
+    (training + serving, any mesh), kept so the serve preflight output
+    and its tests stay byte-stable."""
+    from bigdl_tpu.analysis.sharding_rules import \
+        run_replicated_operand_rules
+
     report = report if report is not None else Report()
     if n_shard <= 1:
         return report
-    import jax
-
-    flat, _ = jax.tree_util.tree_flatten_with_path(params)
-    for path, leaf in flat:
-        shape = tuple(getattr(leaf, "shape", ()))
-        if len(shape) < 2:
-            continue  # biases/scales never feed the MXU contraction
-        nbytes = int(np.prod(shape)) * leaf.dtype.itemsize
-        if nbytes < SERVING_TP_MIN_BYTES:
-            continue
-        sharding = getattr(leaf, "sharding", None)
-        if sharding is None or not sharding.is_fully_replicated:
-            continue
-        where = jax.tree_util.keystr(path)
-        report.add(_finding(
-            "serving-unsharded-matmul",
-            f"{where}: {nbytes / 2**20:.1f} MiB weight {shape} is "
-            f"fully replicated under tp={n_shard} — each chip runs "
-            "this matmul whole",
-            where=where,
-            hint="shard dims the Megatron pairing can split (d_model / "
-                 "heads divisible by K), or drop --strategy tp for "
-                 "this model",
-            detail={"bytes": nbytes, "shape": list(shape),
-                    "tp": int(n_shard)}))
-    return report
+    return run_replicated_operand_rules(
+        params, {"model": int(n_shard)}, split_axes=("model",),
+        rule_id="serving-unsharded-matmul", report=report)
 
 
 def run_jaxpr_rules(closed, report: Optional[Report] = None) -> Report:
@@ -1036,3 +1022,11 @@ def run_module_rules(model, report: Optional[Report] = None, *,
     _rule_channels(model, report)
     _rule_attention(model, report, seq, dtype=dtype)
     return report
+
+
+# shardlint (ISSUE 19) shares this catalog: merge its rule family in so
+# the CLI's rule listing and report grouping see one registry
+from bigdl_tpu.analysis.sharding_rules import \
+    SHARD_CATALOG as _SHARD_CATALOG  # noqa: E402
+
+CATALOG.update(_SHARD_CATALOG)

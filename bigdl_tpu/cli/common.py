@@ -936,6 +936,142 @@ def build_optimizer(model, dataset, criterion, args, schedule=None,
     return opt
 
 
+# ---------------------------------------------------------------------
+# the ResolvedConfig spine (ISSUE 19 satellite, ROADMAP item 5): the
+# mirrored flag families every CLI re-parses (--strategy/--gradCompress/
+# --gradBuckets/--quantize/--speculate/--fusedBN/--convLayout/--convGeom/
+# --autotune) resolved ONCE into a typed object that cli/lint.py and
+# every --lint preflight hand to the analyzer — no per-CLI re-wiring.
+import dataclasses
+
+
+@dataclasses.dataclass(frozen=True)
+class ResolvedConfig:
+    """One run configuration, resolved from the shared flag surface.
+
+    ``mesh_axes`` is the declared mesh (axis -> size) the strategy
+    implies over ``n_devices`` — for the lint CLI with no real devices
+    the virtual defaults below size it, so every multichip surface
+    lints on a 1-CPU box."""
+
+    model: str
+    batch: int = 32
+    seq: Optional[int] = None
+    classes: int = 1000
+    dtype: str = "bfloat16"
+    fused_bn: Optional[str] = None
+    conv_layout: Optional[str] = None
+    conv_geom: Optional[str] = None
+    autotune: str = "off"
+    strategy: Optional[str] = None
+    strategy_k: Optional[int] = None
+    n_devices: int = 1
+    mesh_axes: tuple = ()            # ((axis, size), ...) — hashable
+    grad_compress: str = "off"
+    grad_buckets: str = "auto"
+    quantize: Optional[str] = None
+    speculate: int = 0
+    kv_page_tokens: Optional[int] = None
+    slots: int = 4
+    lint_mode: Optional[str] = None
+    trace: bool = True
+
+    @property
+    def mesh(self) -> dict:
+        return dict(self.mesh_axes)
+
+    def make_grad_comm(self):
+        """The GradCommConfig this run would build (None when the
+        --gradCompress surface is untouched)."""
+        if (self.grad_compress or "off") == "off" \
+                and self.grad_buckets in (None, "auto"):
+            return None
+        from bigdl_tpu.parallel.grad_comm import make_config
+        try:
+            return make_config(self.grad_compress, self.grad_buckets)
+        except ValueError as e:
+            raise SystemExit(str(e))
+
+    def describe(self) -> dict:
+        """Provenance dict (result-JSON / lint-report annotation)."""
+        out = {"model": self.model, "batch": self.batch}
+        if self.strategy:
+            out["strategy"] = (f"{self.strategy}:{self.strategy_k}"
+                               if self.strategy_k else self.strategy)
+            out["mesh"] = ",".join(f"{a}:{s}" for a, s in self.mesh_axes)
+        if (self.grad_compress or "off") != "off":
+            out["grad_compress"] = self.grad_compress
+        if self.quantize:
+            out["quantize"] = self.quantize
+        if self.speculate:
+            out["speculate"] = self.speculate
+        if self.kv_page_tokens:
+            out["kv_page_tokens"] = self.kv_page_tokens
+        return out
+
+
+def _virtual_mesh_devices(name: str, k: Optional[int]) -> tuple:
+    """(n_devices, k) sized for an abstract lint with no real devices:
+    enough virtual chips that the strategy's default shape exists."""
+    if name == "dp":
+        return 8, None
+    if name in ("tp", "sp"):
+        kk = k or 4
+        return 2 * kk, kk
+    if name == "pp":
+        kk = k or 2
+        return 2 * kk, kk
+    if name == "ep":
+        return (k or 8), (k or 8)
+    raise SystemExit(f"unknown strategy {name!r}")
+
+
+def resolve_lint_config(args, *, n_devices: Optional[int] = None
+                        ) -> ResolvedConfig:
+    """Resolve the shared flag families on ``args`` into one
+    :class:`ResolvedConfig`. ``n_devices=None`` (the lint CLI: no real
+    mesh) sizes the strategy over virtual devices —
+    ``AbstractMesh``-traced, so nothing is allocated; a preflight on a
+    real run passes its actual device count."""
+    name, k = parse_strategy_spec(getattr(args, "strategy", None))
+    mesh_axes: tuple = ()
+    n = int(n_devices or 1)
+    if name is not None:
+        if n_devices is None:
+            n, k = _virtual_mesh_devices(name, k)
+        axes = strategy_mesh_axes(name, n, k)
+        mesh_axes = tuple((str(a), int(s)) for a, s in axes.items())
+    quantize = getattr(args, "quantize", None)
+    if quantize:
+        from bigdl_tpu.serving.quant import parse_quantize
+        try:
+            parse_quantize(quantize)  # validate the spelling up front
+        except ValueError as e:
+            raise SystemExit(f"--quantize {quantize!r}: {e}")
+    return ResolvedConfig(
+        model=getattr(args, "model", None) or "",
+        batch=int(getattr(args, "batchSize", 32) or 32),
+        seq=getattr(args, "seq", None),
+        classes=int(getattr(args, "classes", 1000) or 1000),
+        dtype=("float32" if getattr(args, "f32", False) else "bfloat16"),
+        fused_bn=getattr(args, "fusedBN", None),
+        conv_layout=getattr(args, "convLayout", None),
+        conv_geom=getattr(args, "convGeom", None),
+        autotune=getattr(args, "autotune", "off") or "off",
+        strategy=name, strategy_k=k, n_devices=n, mesh_axes=mesh_axes,
+        grad_compress=getattr(args, "gradCompress", "off") or "off",
+        grad_buckets=getattr(args, "gradBuckets", "auto") or "auto",
+        quantize=quantize,
+        speculate=int(getattr(args, "speculate", 0) or 0),
+        # serve spells --kvPageTokens 'auto' too; lint needs a number
+        kv_page_tokens=(int(kvp) if (kvp := getattr(
+            args, "kvPageTokens", None)) and str(kvp).lstrip("-").isdigit()
+            else None),
+        slots=int(getattr(args, "slots", 4) or 4),
+        lint_mode=getattr(args, "lint", None),
+        trace=not getattr(args, "no_trace", False))
+
+
 def load_trained(model, path: str):
     """Load params/mod_state from a checkpoint dir (newest model.<n>) or a
     single saved file (reference Module.load, nn/Module.scala:28)."""

@@ -1,4 +1,4 @@
-"""`bigdl-tpu lint` — the tpulint static-analysis CLI (ISSUE 4).
+"""`bigdl-tpu lint` — the tpulint static-analysis CLI (ISSUE 4 + 19).
 
 Trace a perf-zoo model's full train step on CPU in seconds (abstract
 inputs, no compile, no device) and report TPU perf/correctness
@@ -9,10 +9,22 @@ anti-patterns with rule-level provenance and fix hints:
     bigdl-tpu lint transformer_lm --seq 600 --strict   # ragged seq -> rc 2
     bigdl-tpu lint lenet5 --json report.json
 
-Configuration flags mirror the perf harness (--fusedBN / --convLayout /
---convGeom / --autotune) so the exact run configuration you are about to
-launch is what gets analyzed; ``--strict`` exits nonzero on any
-error-severity finding (the CI gate). Rule catalog: PERF.md §12.
+shardlint (ISSUE 19) extends the same command to every multichip
+surface — the strategy's SHARDED train step is traced over an
+``AbstractMesh`` (virtual devices, nothing allocated), and the serving
+decode step is traced when ``--quantize``/``--speculate``/
+``--kvPageTokens`` ask for one, so a laptop CPU lints the exact graph a
+pod would compile:
+
+    bigdl-tpu lint transformer_lm --strategy tp:4 --gradCompress bf16+ec \\
+        --quantize int8+kv8 --speculate 4 --strict
+
+Configuration flags mirror the perf/training/serve harnesses
+(--fusedBN / --convLayout / --convGeom / --autotune / --strategy /
+--gradCompress / --gradBuckets / --quantize / --speculate /
+--kvPageTokens) so the exact run configuration you are about to launch
+is what gets analyzed; ``--strict`` exits nonzero on any error-severity
+finding (the CI gate). Rule catalog: PERF.md §12 and §26.
 """
 
 from __future__ import annotations
@@ -25,7 +37,7 @@ def main(argv=None):
     p = argparse.ArgumentParser(
         "bigdl-tpu lint",
         description="trace-time TPU anti-pattern lint "
-                    "(bigdl_tpu.analysis; PERF.md §12)")
+                    "(bigdl_tpu.analysis; PERF.md §12, shardlint §26)")
     p.add_argument("model",
                    help="perf model-zoo name (see `bigdl-tpu perf`), "
                         "e.g. resnet50, lenet5, transformer_lm")
@@ -47,7 +59,9 @@ def main(argv=None):
     p.add_argument("--no-trace", action="store_true",
                    help="module-level rules only (skip the jaxpr pass)")
     from bigdl_tpu.cli.common import (_add_platform_arg, add_autotune_arg,
-                                      add_fused_bn_arg, apply_platform)
+                                      add_fused_bn_arg, add_grad_comm_args,
+                                      add_strategy_arg, apply_platform,
+                                      resolve_lint_config)
     _add_platform_arg(p)
     add_autotune_arg(p)
     add_fused_bn_arg(p)
@@ -58,21 +72,34 @@ def main(argv=None):
     p.add_argument("--convGeom", default=None, metavar="FILE",
                    help="analyze under this per-geometry conv decision "
                         "JSON (scripts/apply_conv_probe.py --geom)")
+    # shardlint (ISSUE 19): the multichip flag families, spelled exactly
+    # like the perf/serve CLIs — the mesh is virtual (AbstractMesh), so
+    # tp:4 lints on a 1-CPU box in seconds
+    add_strategy_arg(p)
+    add_grad_comm_args(p)
+    p.add_argument("--quantize", default=None,
+                   metavar="int8|fp8|int8+kv8|fp8+kv8",
+                   help="lint the quantized serving decode step for this "
+                        "weight/KV format (mirrors serve --quantize)")
+    p.add_argument("--speculate", type=int, default=0, metavar="K",
+                   help="lint the speculative decode surface (mirrors "
+                        "serve --speculate)")
+    p.add_argument("--kvPageTokens", default=None, metavar="N",
+                   help="lint the paged-KV decode step with N-token pages "
+                        "(mirrors serve --kvPageTokens)")
+    p.add_argument("--slots", type=int, default=4,
+                   help="decode slots for the serving-surface lint")
     args = p.parse_args(argv)
     apply_platform(args)  # installs --convLayout/--convGeom/--autotune
 
-    import jax.numpy as jnp
+    cfg = resolve_lint_config(args)
 
-    from bigdl_tpu.analysis import lint_perf_model
+    from bigdl_tpu.analysis import lint_config
     from bigdl_tpu.ops.conv2d import policy_snapshot, restore_policy
 
     snap = policy_snapshot()
     try:
-        report = lint_perf_model(
-            args.model, args.batchSize, seq_len=args.seq,
-            dtype=jnp.float32 if args.f32 else None,
-            fused_bn=args.fusedBN, classes=args.classes,
-            trace=not getattr(args, "no_trace", False))
+        report = lint_config(cfg)
     finally:
         restore_policy(snap)
 

@@ -1462,18 +1462,22 @@ def main(argv=None):
     lint_ann = None
     if args.lint:
         # pre-flight static analysis of THIS run's model/config
-        # (bigdl_tpu.analysis; PERF.md §12) — strict refuses to launch
-        # on error-severity findings, and the summary is stamped into
-        # the result JSON either way
-        import jax.numpy as jnp
+        # (bigdl_tpu.analysis; PERF.md §12 + §26) — the ResolvedConfig
+        # spine resolves the mirrored flag families once, shardlint
+        # traces the sharded step over this run's REAL device count,
+        # strict refuses to launch on error-severity findings, and the
+        # summary is stamped into the result JSON either way
+        import jax
 
-        from bigdl_tpu.analysis import lint_perf_model
-        report = lint_perf_model(
-            args.model, args.batchSize, fused_bn=args.fusedBN,
-            dtype=jnp.float32 if args.f32 else None,
-            strategy=args.strategy, grad_compress=args.gradCompress)
+        from bigdl_tpu.analysis import lint_config
+        from bigdl_tpu.cli.common import resolve_lint_config
+        cfg = resolve_lint_config(args, n_devices=len(jax.devices()))
+        report = lint_config(cfg)
         rc, lint_ann = run_preflight_lint(
             report, strict=(args.lint == "strict"))
+        if lint_ann is not None and cfg.mesh:
+            lint_ann["mesh"] = ",".join(
+                f"{a}:{s}" for a, s in cfg.mesh_axes)
         if rc:
             return rc
     obs_state = getattr(args, "_obs", None)

@@ -383,6 +383,10 @@ def build_app(args):
                 compute_dtype=compute_dtype, **dims)
             draft_params = draft_model.init(jax.random.PRNGKey(1))
 
+    # what mesh the first-stack lint pass actually vetted (stamped into
+    # provenance as lint_mesh — ISSUE 19 satellite)
+    lint_prov: dict = {}
+
     def _build_stack(mesh, m, first):
         """One replica's full serving stack. ``m`` is its metrics view
         (labelled per replica under dp); pre-flight lints run for the
@@ -426,15 +430,42 @@ def build_app(args):
                                    mesh=mesh, quantize=quantize)
             # decode-path lint pre-flight (ISSUE 14): sampling-sort /
             # host-sync rules over the traced decode step + the
-            # page-layout fit, same strict contract as the forward's
+            # page-layout fit, same strict contract as the forward's.
+            # Under dp:N+tp:K every replica compiles the IDENTICAL
+            # graph on an isomorphic tp group, so linting the first
+            # stack covers the fleet (ISSUE 19 bugfix) — the mesh the
+            # pass actually checked is stamped into provenance as
+            # lint_mesh so "which graph was vetted" is auditable
             if first and lint_mode is not None:
-                from bigdl_tpu.analysis import run_decode_rules
+                from bigdl_tpu.analysis import (run_decode_rules,
+                                                run_kv_sharding_rules,
+                                                run_sharding_rules)
                 head_dim = getattr(model.encoder._modules[0].mha,
                                    "head_dim", model.d_model // 4)
+                step_jaxpr = decoder.trace_step_jaxpr()
                 report = run_decode_rules(
-                    decoder.trace_step_jaxpr(), page_tokens=page_tokens,
+                    step_jaxpr, page_tokens=page_tokens,
                     max_len=decoder.max_len, head_dim=head_dim,
                     dtype=decoder.cache_dtype)
+                if tp_k > 1:
+                    # shardlint over the SHARDED decode step (ISSUE
+                    # 19): annotation consistency on the tp group +
+                    # the KV head-split fit of the page pools
+                    run_sharding_rules(
+                        step_jaxpr, mesh_axes={"model": tp_k},
+                        strategy=None, context="serving",
+                        report=report)
+                    run_kv_sharding_rules(
+                        decoder._kv.pools if decoder.paged
+                        else decoder._cache,
+                        tp_k, page_tokens=page_tokens, report=report)
+                    lint_prov["lint_mesh"] = (
+                        f"model:{tp_k} x {n_replicas} replica(s)"
+                        if n_replicas > 1 else f"model:{tp_k}")
+                else:
+                    lint_prov["lint_mesh"] = (
+                        f"replicated x {n_replicas} replica(s)"
+                        if n_replicas > 1 else "single-device")
                 rc, _ = common.run_preflight_lint(
                     report, strict=(lint_mode == "strict"))
                 if rc:
@@ -471,6 +502,8 @@ def build_app(args):
             mesh0, metrics, first=True)
 
     prov = engine.provenance()
+    if lint_prov:
+        prov.update(lint_prov)
     prov.update({
         "model": name,
         "max_batch": args.maxBatch,

@@ -130,6 +130,20 @@ class DataParallel:
                 "mesh": {str(name): int(self.mesh.shape[name])
                          for name in self.mesh.axis_names}}
 
+    def lint_spec_metadata(self, params=None) -> dict:
+        """What shardlint needs to reconstruct this strategy abstractly
+        (ISSUE 19): declared mesh axes, the strategy's short name, the
+        PartitionSpec tree it would commit for ``params`` (dp:
+        replicated), and the grad-comm config steering the reduce."""
+        from bigdl_tpu.parallel.tensor_parallel import replicated_specs
+        return {"strategy": "dp",
+                "mesh_axes": {str(name): int(self.mesh.shape[name])
+                              for name in self.mesh.axis_names},
+                "batch_axes": (self.axis,),
+                "param_specs": (replicated_specs(params)
+                                if params is not None else None),
+                "grad_comm": self.grad_comm}
+
     # ------------------------------------------------------------- placement
     def _opt_sharding_tree(self, opt_state):
         def leaf_sharding(x):

@@ -236,6 +236,19 @@ class TensorParallel(DataParallel):
         self.rules = rules
         self._param_shardings = None
 
+    def lint_spec_metadata(self, params=None) -> dict:
+        """Shardlint view of this strategy (ISSUE 19): the megatron spec
+        tree over ``model_axis`` for ``params`` (abstract trees from
+        ``jax.eval_shape`` work — ``rules`` only reads shapes)."""
+        meta = super().lint_spec_metadata(None)
+        meta["strategy"] = "tp"
+        meta["model_axis"] = self.model_axis
+        if params is not None:
+            n = self.mesh.shape[self.model_axis]
+            meta["param_specs"] = self.rules(self.module, params,
+                                             self.model_axis, n)
+        return meta
+
     # ------------------------------------------------------------- placement
     def _build_param_shardings(self, params):
         n = self.mesh.shape[self.model_axis]

@@ -1307,3 +1307,120 @@ class DecodeEngine:
         t, self._thread = self._thread, None
         if t is not None:
             t.join(timeout)
+
+
+# ------------------------------------------------- shardlint (ISSUE 19)
+class _AbstractPagedKv:
+    """Just enough of :class:`~bigdl_tpu.serving.kv_pages.PagedKvCache`
+    for :meth:`DecodeEngine.trace_step_jaxpr`: abstract pools (the same
+    leaf geometry the real pool allocates, as ShapeDtypeStructs) plus
+    the page-table bound — no allocator, no device memory."""
+
+    def __init__(self, pools, max_pages: int, pool_pages: int,
+                 page_tokens: int):
+        self.pools = pools
+        self.max_pages = int(max_pages)
+        self.pool_pages = int(pool_pages)
+        self.page_tokens = int(page_tokens)
+        self.pool_shardings = None
+
+
+def abstract_decode_engine(model, *, slots: int = 4,
+                           max_len: Optional[int] = None,
+                           cache_dtype=None,
+                           kv_page_tokens: Optional[int] = None,
+                           pool_pages: Optional[int] = None,
+                           speculate: int = 0, tp: int = 1,
+                           model_axis: str = "model",
+                           quantize: Optional[str] = None):
+    """A lintable :class:`DecodeEngine` shell: every field
+    ``trace_step_jaxpr`` (and the ``_get_step`` program builder under
+    it) reads, built fully abstractly — params/KV from ``eval_shape``,
+    the tp mesh an :class:`jax.sharding.AbstractMesh`, nothing placed,
+    nothing compiled, zero devices required (ISSUE 19: the serving
+    surfaces shardlint analyzes without standing up an engine).
+
+    Returns the engine shell; call ``trace_step_jaxpr()`` on it. Do NOT
+    ``start()``/``submit()`` it — there is no worker, no allocator, and
+    no real state behind it."""
+    import jax
+    import jax.numpy as jnp
+
+    from bigdl_tpu.serving import quant as _q
+
+    eng = DecodeEngine.__new__(DecodeEngine)
+    eng.model = model
+    eng._jax, eng._jnp = jax, jnp
+    eng.quantize = quantize if quantize else "off"
+    eng._wfmt, eng._kv8 = _q.parse_quantize(quantize)
+    eng.slots = int(slots)
+    eng.max_len = int(max_len or model.max_len)
+    eng.cache_dtype = cache_dtype or model.compute_dtype or jnp.float32
+    eng.speculate = int(speculate)
+    eng.page_tokens = int(kv_page_tokens) if kv_page_tokens else None
+    eng.paged = eng.page_tokens is not None
+    if eng._kv8 and not eng.paged:
+        raise ValueError("--quantize kv8 needs paged KV "
+                         "(--kvPageTokens); the dense cache path has no "
+                         "quantized pools")
+    params = jax.eval_shape(model.init, jax.random.PRNGKey(0))
+    if eng._wfmt is not None:
+        params = jax.eval_shape(
+            lambda p: _q.quantize_params(p, eng._wfmt), params)
+    eng.params = params
+
+    if int(tp) > 1:
+        from jax.sharding import AbstractMesh
+
+        from bigdl_tpu.serving.sharding import ServingSharding
+        eng.mesh = AbstractMesh(((model_axis, int(tp)),))
+        eng._shard = ServingSharding(eng.mesh, axis=model_axis)
+    else:
+        eng.mesh = None
+        eng._shard = None
+
+    if eng.paged:
+        if eng.max_len % eng.page_tokens:
+            raise ValueError(
+                f"kv page_tokens ({eng.page_tokens}) must divide "
+                f"max_len ({eng.max_len})")
+        max_pages = eng.max_len // eng.page_tokens
+        pp = int(pool_pages or (1 + eng.slots * max_pages))
+        tmpl = jax.eval_shape(
+            lambda: model.encoder.init_cache(1, eng.page_tokens,
+                                             eng.cache_dtype))
+        if eng._kv8:
+            def mk(a):
+                kh, pt, hd = a.shape[1], a.shape[2], a.shape[3]
+                return _kvp.QuantPool(
+                    jax.ShapeDtypeStruct((pp, kh, pt, hd), jnp.int8),
+                    jax.ShapeDtypeStruct((pp, kh, pt), jnp.float32),
+                    eng.cache_dtype)
+            pools = jax.tree_util.tree_map(mk, tmpl)
+        else:
+            pools = jax.tree_util.tree_map(
+                lambda a: jax.ShapeDtypeStruct((pp,) + a.shape[1:],
+                                               a.dtype), tmpl)
+        eng._kv = _AbstractPagedKv(pools, max_pages, pp, eng.page_tokens)
+        eng._cache = None
+    else:
+        eng._kv = None
+        eng._cache = jax.eval_shape(
+            lambda: model.encoder.init_cache(eng.slots, eng.max_len,
+                                             eng.cache_dtype))
+
+    shard = eng._shard
+    if shard is not None:
+        eng._repl_sh = shard.replicated
+        eng._state_sh = shard.kv_shardings(
+            eng._kv.pools if eng.paged else eng._cache)
+    else:
+        eng._repl_sh = eng._state_sh = None
+    eng._cache1_sh = eng._draft_sh = None
+    eng._don = False           # nothing real to donate; CPU-safe
+    eng._step_programs = {}
+    eng._verify_programs = {}
+    eng._accept_programs = {}
+    eng._suffix_programs = {}
+    eng._draft_step_jit = None
+    return eng
