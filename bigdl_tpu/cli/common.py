@@ -975,6 +975,12 @@ class ResolvedConfig:
     slots: int = 4
     lint_mode: Optional[str] = None
     trace: bool = True
+    # serve/fleet topology (ISSUE 20 satellite): the serving grammar's
+    # resolution (dp replicas x tp shards) and the fleet width, owned
+    # here so serve, fleet, and worker never re-mirror the parse
+    serving_replicas: int = 1
+    serving_tp: int = 1
+    fleet_workers: int = 0
 
     @property
     def mesh(self) -> dict:
@@ -1007,6 +1013,11 @@ class ResolvedConfig:
             out["speculate"] = self.speculate
         if self.kv_page_tokens:
             out["kv_page_tokens"] = self.kv_page_tokens
+        if self.serving_replicas > 1 or self.serving_tp > 1:
+            out["serving_replicas"] = self.serving_replicas
+            out["serving_tp"] = self.serving_tp
+        if self.fleet_workers:
+            out["fleet_workers"] = self.fleet_workers
         return out
 
 
@@ -1070,6 +1081,72 @@ def resolve_lint_config(args, *, n_devices: Optional[int] = None
         slots=int(getattr(args, "slots", 4) or 4),
         lint_mode=getattr(args, "lint", None),
         trace=not getattr(args, "no_trace", False))
+
+
+def _virtual_serving_devices(spec: Optional[str]) -> int:
+    """Device count for resolving a serving strategy ABSTRACTLY — in a
+    process with no accelerator client (the fleet router) or no devices
+    at all (lint). Big enough that any explicit ``dp:N+tp:K`` shape
+    exists; omitted axis sizes then default over the same count a CPU
+    smoke run would fake with XLA_FLAGS."""
+    need = 1
+    for part in str(spec or "").split("+"):
+        _, _, k = part.strip().partition(":")
+        if k and str(k).lstrip("-").isdigit():
+            need *= max(int(k), 1)
+    return max(8, need)
+
+
+def resolve_serve_config(args, *, n_devices: Optional[int] = None
+                         ) -> ResolvedConfig:
+    """The serve/fleet half of the ResolvedConfig spine (ISSUE 20
+    satellite): resolve the serving flag surface — topology via the
+    SERVING grammar (``tp[:K] | dp[:N] | dp:N+tp:K``, not the training
+    grammar), quantize/speculate modes, fleet width — ONCE, so the
+    serve CLI, the fleet router, and every worker agree on one parse.
+
+    ``n_devices=None`` resolves abstractly over virtual devices: the
+    router process calls this before any worker boots (catching a bad
+    --strategy/--quantize/--speculate without paying K engine compiles)
+    and must never initialize jax itself."""
+    spec = getattr(args, "strategy", None)
+    n = int(n_devices) if n_devices is not None \
+        else _virtual_serving_devices(spec)
+    replicas, tp_k = parse_serving_strategy(spec, n)
+    quantize = getattr(args, "quantize", None)
+    if quantize == "off":  # serve spells the default as the string off
+        quantize = None
+    if quantize:
+        from bigdl_tpu.serving.quant import parse_quantize
+        try:
+            parse_quantize(quantize)
+        except ValueError as e:
+            raise SystemExit(f"--quantize {quantize!r}: {e}")
+    speculate = int(getattr(args, "speculate", 0) or 0)
+    if speculate < 0:
+        raise SystemExit(f"--speculate {speculate}: draft length must "
+                         "be >= 0")
+    fleet = int(getattr(args, "fleet", 0) or 0)
+    if fleet < 0:
+        raise SystemExit(f"--fleet {fleet}: worker count must be >= 0")
+    mesh_axes: tuple = ()
+    if tp_k > 1:
+        mesh_axes = (("model", int(tp_k)),)
+    return ResolvedConfig(
+        model=getattr(args, "model", None) or "",
+        batch=int(getattr(args, "batchSize", 32) or 32),
+        seq=getattr(args, "seq", None),
+        dtype=("float32" if getattr(args, "f32", False) else "bfloat16"),
+        strategy=spec or None,
+        n_devices=n, mesh_axes=mesh_axes,
+        quantize=quantize, speculate=speculate,
+        kv_page_tokens=(int(kvp) if (kvp := getattr(
+            args, "kvPageTokens", None)) and str(kvp).lstrip("-").isdigit()
+            else None),
+        slots=int(getattr(args, "slots", 4) or 4),
+        lint_mode=getattr(args, "lint", None),
+        serving_replicas=int(replicas), serving_tp=int(tp_k),
+        fleet_workers=fleet)
 
 
 def load_trained(model, path: str):

@@ -1,0 +1,39 @@
+"""`bigdl-tpu fleet` — the serving fleet front door (ISSUE 20).
+
+A spelling of ``serve --fleet K`` with the fleet as the DEFAULT: the
+same flag surface as serve, but this process is always the router and
+``--fleet`` defaults to 2 workers instead of 0.
+
+    bigdl-tpu fleet transformer_lm --model ckpt_dir --fleet 4 -p 8000
+    curl -d '{"checkpoint": "ckpt_v2", "version": "v2"}' \\
+        localhost:8000/admin/reload
+"""
+
+from __future__ import annotations
+
+from bigdl_tpu.cli import common
+
+
+def build_parser():
+    from bigdl_tpu.cli import serve as serve_cli
+    p = serve_cli.build_parser()
+    p.prog = "bigdl-tpu fleet"
+    p.set_defaults(fleet=2)
+    return p
+
+
+def main(argv=None) -> int:
+    common.setup_logging()
+    import sys
+    raw_argv = list(sys.argv[1:]) if argv is None else list(argv)
+    args = build_parser().parse_args(raw_argv)
+    if int(args.fleet) < 1:
+        raise SystemExit("bigdl-tpu fleet: --fleet must be >= 1 (use "
+                         "`bigdl-tpu serve` for the single-process "
+                         "stack)")
+    from bigdl_tpu.serving.fleet.router import run_fleet
+    return run_fleet(args, raw_argv)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

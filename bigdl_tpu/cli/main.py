@@ -32,6 +32,7 @@ _COMMANDS = {
     "explain": "explain",
     "lint": "lint",
     "serve": "serve",
+    "fleet": "fleet",
     "predict": "predict",
     "batch-predict": "batch_predict",
     "loadmodel": "loadmodel",

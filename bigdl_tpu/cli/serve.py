@@ -56,6 +56,24 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("-p", "--port", type=int, default=8000,
                    help="0 = ephemeral (the chosen port is printed)")
+    p.add_argument("--fleet", type=int, default=0, metavar="K",
+                   help="serving fleet (ISSUE 20): run K engine WORKER "
+                        "PROCESSES behind a router on this port — SLO-"
+                        "burn-weighted least-loaded routing, supervised "
+                        "restart of dead workers, rolling zero-downtime "
+                        "weight swap via POST /admin/reload. 0 (default) "
+                        "= today's single process")
+    p.add_argument("--modelVersion", default=None, metavar="TAG",
+                   help="version tag for the served weights — stamped "
+                        "into provenance and echoed as x-model-version "
+                        "on every response; bumped by /admin/reload "
+                        "(default v0)")
+    p.add_argument("--fleetHeartbeatS", type=float, default=0.5,
+                   help="router -> worker heartbeat poll interval")
+    p.add_argument("--fleetRestartBudget", type=int, default=8,
+                   help="supervised restarts per worker before the "
+                        "router gives up on that slot (exponential "
+                        "backoff between attempts)")
     p.add_argument("--strategy", default=None, metavar="SPEC",
                    help="multi-chip serving (ISSUE 16): 'tp[:K]' shards "
                         "the model over K chips (Megatron layout, "
@@ -266,15 +284,18 @@ def build_app(args):
 
     # --strategy (ISSUE 16): tp shards each engine over K chips, dp
     # runs N independent replicas on disjoint device groups; composed,
-    # each replica is a K-chip tp engine
+    # each replica is a K-chip tp engine. The parse itself lives on the
+    # ResolvedConfig spine (ISSUE 20 satellite) so serve, fleet, and
+    # lint resolve the serving flag surface identically.
     strategy = getattr(args, "strategy", None)
     n_replicas, tp_k, groups, mesh0 = 1, 1, None, None
     if strategy:
         import jax
 
         from bigdl_tpu.serving import replica_device_groups, serving_mesh
-        n_replicas, tp_k = common.parse_serving_strategy(
-            strategy, len(jax.devices()))
+        cfg = common.resolve_serve_config(args,
+                                          n_devices=len(jax.devices()))
+        n_replicas, tp_k = cfg.serving_replicas, cfg.serving_tp
         groups = replica_device_groups(n_replicas, tp_k)
         mesh0 = serving_mesh(groups[0])
 
@@ -556,25 +577,39 @@ def build_app(args):
         prov["fault_plan"] = args.faultPlan
     metrics.set_provenance(prov)
 
+    version = getattr(args, "modelVersion", None) or "v0"
     if replica_set is not None:
         app = ServingApp(name=name, metrics=metrics,
                          replicas=replica_set,
                          request_timeout_s=args.timeout,
                          default_deadline_ms=args.deadlineMs,
-                         shed_generate_frac=args.shedAt)
+                         shed_generate_frac=args.shedAt,
+                         version=version)
     else:
         app = ServingApp(name=name, metrics=metrics, engine=engine,
                          batcher=batcher, decoder=decoder,
                          request_timeout_s=args.timeout,
                          default_deadline_ms=args.deadlineMs,
                          shed_generate_frac=args.shedAt,
-                         watchdog=watchdog)
+                         watchdog=watchdog, version=version)
+    # resolved per scrape: a rolling weight swap (ISSUE 20) bumps
+    # app.model_version and every later scrape names the NEW weights
+    prov["model_version"] = lambda: app.model_version
+    metrics.set_provenance(prov)
     return app, engine, in_shape, in_dtype
 
 
 def main(argv=None):
     common.setup_logging()
-    args = build_parser().parse_args(argv)
+    import sys
+    raw_argv = list(sys.argv[1:]) if argv is None else list(argv)
+    args = build_parser().parse_args(raw_argv)
+    if getattr(args, "fleet", 0):
+        # --fleet K (ISSUE 20): this process becomes the ROUTER — it
+        # never initializes jax; each worker re-enters the serve stack
+        # in its own process with the router-owned flags stripped
+        from bigdl_tpu.serving.fleet.router import run_fleet
+        return run_fleet(args, raw_argv)
     common.apply_platform(args)  # --convLayout/--convGeom/--autotune
 
     from bigdl_tpu.serving import run_server

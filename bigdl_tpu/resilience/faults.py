@@ -76,7 +76,7 @@ __all__ = [
 ]
 
 FAULT_SITES = ("data", "step", "ckpt_save", "ckpt_restore", "infer",
-               "request")
+               "request", "worker_boot")
 FAULT_KINDS = ("preempt", "preempt_soft", "dispatch", "io", "corrupt",
                "stall", "worker_kill", "kill_device")
 

@@ -1,0 +1,24 @@
+"""Serving fleet tier (ISSUE 20): router process + K engine workers.
+
+``control`` is the message schema and transport (stdlib-only, jax-free
+— safe to import in the router process). ``router`` spawns/monitors
+workers and proxies traffic by SLO-burn-weighted queue depth. ``worker``
+is the engine process (the full ``serve`` stack + control surface).
+``swap`` is the zero-downtime rolling weight reload.
+
+Deliberately lazy: importing :mod:`bigdl_tpu.serving.fleet` pulls in
+none of the submodules, and the router process never CALLS a jax API —
+backends init lazily, so the front door holds no accelerator client and
+the K workers own the chips.
+"""
+
+from __future__ import annotations
+
+__all__ = ["control", "router", "swap", "worker"]
+
+
+def __getattr__(name):
+    if name in __all__:
+        import importlib
+        return importlib.import_module(f"{__name__}.{name}")
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

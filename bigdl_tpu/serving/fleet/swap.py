@@ -1,0 +1,195 @@
+"""Zero-downtime weight swap (ISSUE 20): drain, restore, switch trees.
+
+Two halves, one invariant. The WORKER half (:func:`swap_app_weights`)
+waits until its serving stack is idle — every in-flight decode finishes
+on the OLD weights, so no response is ever computed from a
+mixed-version batch — then restores the checkpoint (through PR 10's
+topology-independent path when the engine is meshed) and swaps the
+param trees under the decoder lock. The ROUTER half
+(:func:`rolling_reload`) walks the fleet one worker at a time: pull the
+worker out of rotation, wait for its queues to hit zero, POST its
+``/admin/reload``, wait until it heartbeats ``ready`` at the new
+version, put it back. At every instant K-1 workers serve, so the fleet
+answers with zero 5xx responses across the whole swap — the old and
+new ``x-model-version`` are both observed during the window, never
+within one response.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Optional
+
+from bigdl_tpu.serving.fleet import control
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["WeightSwapError", "rolling_reload", "swap_app_weights"]
+
+
+class WeightSwapError(RuntimeError):
+    """A reload that could not complete safely (drain timeout, restore
+    failure). The worker keeps serving the OLD weights — a failed swap
+    never leaves a half-swapped tree."""
+
+
+def _stacks(app):
+    """(engine, batcher, decoder) per replica — the single-stack app is
+    a one-element fleet of itself."""
+    if app.replicas is not None:
+        return [(r.engine, r.batcher, r.decoder)
+                for r in app.replicas.replicas]
+    return [(app.engine, app.batcher, app.decoder)]
+
+
+def _in_flight(app) -> int:
+    n = 0
+    for _, batcher, decoder in _stacks(app):
+        if batcher is not None:
+            n += int(batcher.queue_depth)
+        if decoder is not None:
+            n += int(decoder.queue_load())
+    return n
+
+
+def _swap_stack(engine, decoder, params, mod_state) -> None:
+    """Point one replica's engines at the new trees. The decoder swap
+    happens under its slot lock: ``submit``/``step`` serialize on the
+    same lock, so a decode batch reads either the old tree or the new
+    one — never a mix."""
+    from bigdl_tpu.serving import quant as _q
+
+    wfmt, _ = _q.parse_quantize(engine.quantize)
+    if wfmt is not None:
+        params = _q.quantize_params(params, wfmt)
+    eng_params = params
+    if engine._shard is not None:
+        eng_params = engine._shard.place_params(engine.module, params)
+        if mod_state is not None:
+            import jax
+            mod_state = jax.device_put(mod_state, engine._shard.replicated)
+    engine.params = eng_params
+    if mod_state is not None:
+        engine.mod_state = mod_state
+    if decoder is None:
+        return
+    dec_params = params
+    if decoder._shard is not None:
+        dec_params = decoder._shard.place_params(decoder.model, params)
+    with decoder._lock:
+        decoder.params = dec_params
+        if decoder.speculate > 0 and decoder.draft_model is decoder.model:
+            # self-draft shares the target tree; a distinct draft model
+            # keeps its own (randomly initialized) proposer weights
+            decoder.draft_params = dec_params
+
+
+def swap_app_weights(app, checkpoint: str, version: str, *,
+                     drain_timeout_s: float = 60.0,
+                     poll_s: float = 0.02,
+                     clock=time.monotonic) -> None:
+    """Drain-then-swap on one worker. Blocks until every in-flight
+    request has FINISHED ON THE OLD WEIGHTS (the rolling-swap atomicity
+    contract), then restores ``checkpoint`` and swaps every replica's
+    trees. Raises :class:`WeightSwapError` without touching the served
+    weights when the drain times out or the restore fails."""
+    deadline = clock() + float(drain_timeout_s)
+    while _in_flight(app):
+        if clock() > deadline:
+            raise WeightSwapError(
+                f"drain timeout after {drain_timeout_s}s with "
+                f"{_in_flight(app)} request(s) still in flight — "
+                f"weights NOT swapped")
+        time.sleep(poll_s)
+
+    for engine, _, decoder in _stacks(app):
+        try:
+            if engine.mesh is not None:
+                from bigdl_tpu.serving.sharding import restore_for_serving
+                params, mod_state = restore_for_serving(checkpoint,
+                                                        engine.mesh)
+            else:
+                from bigdl_tpu.utils.orbax_ckpt import restore_for_inference
+                params, mod_state = restore_for_inference(checkpoint)
+        except SystemExit as e:
+            # restore_* exits clean on missing/corrupt checkpoints at
+            # startup; mid-serve that must be a refusable error instead
+            raise WeightSwapError(
+                f"restore failed for {checkpoint!r}: {e} — "
+                f"weights NOT swapped")
+        _swap_stack(engine, decoder, params, mod_state)
+
+    app.model_version = str(version)
+    logger.info("weight swap complete: %s -> version %s", checkpoint,
+                version)
+
+
+def rolling_reload(router, checkpoint: str, version: str, *,
+                   drain_timeout_s: float = 60.0,
+                   reload_timeout_s: float = 600.0,
+                   rejoin_timeout_s: float = 60.0,
+                   poll_s: float = 0.05) -> list:
+    """Walk the fleet one worker at a time: drain (out of rotation; the
+    worker finishes in-flight work on the old weights), reload, wait for
+    a ``ready`` heartbeat at the new version, rejoin. Aborts on the
+    first failure — the already-swapped workers keep the new version,
+    the untouched ones keep the old, and the result rows say which is
+    which."""
+    results = []
+    host = router.host
+    for h in router.worker_handles():
+        row = {"worker": h.index, "port": h.port}
+        if not h.process_alive():
+            row.update(status="skipped", reason="process not running")
+            results.append(row)
+            continue
+        router.set_draining(h, True)
+        try:
+            t_end = time.monotonic() + drain_timeout_s
+            while True:
+                st = control.fetch_status(host, h.port, timeout=2.0)
+                if (st is not None and st.queue_depth == 0
+                        and st.decode_active == 0):
+                    break
+                if time.monotonic() > t_end:
+                    row.update(status="error",
+                               error=f"drain timeout after "
+                                     f"{drain_timeout_s}s")
+                    results.append(row)
+                    return results
+                time.sleep(poll_s)
+            try:
+                code, body = control.request_json(
+                    "POST", host, h.port, control.RELOAD_PATH,
+                    {"checkpoint": checkpoint, "version": version,
+                     "drain_timeout_s": drain_timeout_s},
+                    timeout=reload_timeout_s)
+            except OSError as e:
+                row.update(status="error", error=f"reload transport: {e}")
+                results.append(row)
+                return results
+            if code != 200:
+                row.update(status="error",
+                           error=str(body.get("error", f"HTTP {code}")))
+                results.append(row)
+                return results
+            t_end = time.monotonic() + rejoin_timeout_s
+            while True:
+                st = control.fetch_status(host, h.port, timeout=2.0)
+                if (st is not None and st.state == "ready"
+                        and st.model_version == str(version)):
+                    break
+                if time.monotonic() > t_end:
+                    row.update(status="error",
+                               error="worker never reported ready at "
+                                     f"version {version}")
+                    results.append(row)
+                    return results
+                time.sleep(poll_s)
+            row.update(status="reloaded", version=str(version))
+            results.append(row)
+        finally:
+            router.set_draining(h, False)
+    router.note_reloaded(checkpoint, str(version))
+    return results

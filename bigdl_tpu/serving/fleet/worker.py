@@ -1,0 +1,171 @@
+"""Fleet engine worker (ISSUE 20): today's ``serve`` stack plus the
+control surface the router talks to.
+
+A worker IS the single-process server — same flags, same engines, same
+endpoints — started by the router on an ephemeral port with two extra
+routes installed:
+
+* ``GET /control/state``  — the heartbeat (:mod:`fleet.control`):
+  lifecycle state, queue depth (batcher rows + decode load), active
+  decode slots, SLO burn/goodput from the request tracer, the model
+  version currently served, and the restart count the supervisor
+  stamped into the environment.
+* ``POST /admin/reload``  — the worker half of the rolling weight swap
+  (:func:`fleet.swap.swap_app_weights`): drain, restore, swap, bump the
+  version echoed as ``x-model-version`` on every response.
+
+``python -m bigdl_tpu.serving.fleet.worker transformer_lm ...`` also
+runs standalone — a fleet worker of one, useful for poking the control
+surface by hand.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+from bigdl_tpu.cli import common
+from bigdl_tpu.serving.fleet import control, swap
+
+__all__ = ["WorkerControl", "build_parser", "main"]
+
+
+class WorkerControl:
+    """The worker-side control plane: owns the lifecycle state machine
+    (ready -> reloading -> ready), renders heartbeats, and serializes
+    reloads (one swap at a time; concurrent reload requests queue on
+    the lock rather than interleave)."""
+
+    def __init__(self, app, *, index: int = 0, version: str = "v0",
+                 port: int = 0, clock=time.monotonic):
+        self.app = app
+        self.index = int(index)
+        self.port = int(port)
+        self.clock = clock
+        self._t0 = clock()
+        self._state = "ready"
+        self._lock = threading.Lock()
+        self.restarts = int(os.environ.get("BIGDL_TPU_WORKER_RESTARTS",
+                                           "0") or 0)
+        app.model_version = str(version)
+        app.extra_routes[("GET", control.CONTROL_PATH)] = self.handle_state
+        app.extra_routes[("POST", control.RELOAD_PATH)] = self.handle_reload
+
+    # ------------------------------------------------------------- signals
+    def _components(self):
+        if self.app.replicas is not None:
+            return [(r.batcher, r.decoder)
+                    for r in self.app.replicas.replicas]
+        return [(self.app.batcher, self.app.decoder)]
+
+    def queue_depth(self) -> int:
+        n = 0
+        for batcher, decoder in self._components():
+            if batcher is not None:
+                n += int(batcher.queue_depth)
+            if decoder is not None:
+                n += int(decoder.queue_load())
+        return n
+
+    def decode_active(self) -> int:
+        n = 0
+        for _, decoder in self._components():
+            if decoder is not None:
+                n += sum(r is not None for r in decoder._reqs)
+        return n
+
+    @staticmethod
+    def _slo():
+        from bigdl_tpu.serving import reqtrace as _reqtrace
+        rt = _reqtrace.get()
+        return rt.slo if rt is not None else None
+
+    def status(self) -> control.WorkerStatus:
+        slo = self._slo()
+        return control.WorkerStatus(
+            index=self.index, pid=os.getpid(), port=self.port,
+            state=self._state,
+            queue_depth=self.queue_depth(),
+            decode_active=self.decode_active(),
+            slo_burn=(round(slo.burn_rate(), 4) if slo is not None
+                      else 0.0),
+            goodput=(round(slo.goodput_frac(), 4) if slo is not None
+                     else 1.0),
+            model_version=str(self.app.model_version or "v0"),
+            restarts=self.restarts,
+            uptime_s=round(self.clock() - self._t0, 3))
+
+    # ------------------------------------------------------------ handlers
+    def handle_state(self, _payload=None):
+        return 200, self.status().to_dict()
+
+    def handle_reload(self, payload):
+        payload = payload or {}
+        ckpt = payload.get("checkpoint")
+        version = payload.get("version")
+        if not ckpt or not version:
+            return 400, {"error": "reload needs 'checkpoint' and "
+                                  "'version'"}
+        try:
+            drain_s = float(payload.get("drain_timeout_s", 60.0))
+        except (TypeError, ValueError):
+            return 400, {"error": "'drain_timeout_s' must be a number"}
+        with self._lock:
+            self._state = "reloading"
+            try:
+                swap.swap_app_weights(self.app, str(ckpt), str(version),
+                                      drain_timeout_s=drain_s)
+            except swap.WeightSwapError as e:
+                return 503, {"error": str(e)}
+            except Exception as e:  # restore/placement bug: old weights
+                return 500, {"error": f"{type(e).__name__}: {e}"}
+            finally:
+                # a failed swap leaves the old tree serving — the worker
+                # goes straight back into rotation either way
+                self._state = "ready"
+        return 200, {"status": "reloaded",
+                     "version": str(self.app.model_version)}
+
+
+def build_parser():
+    from bigdl_tpu.cli import serve as serve_cli
+    p = serve_cli.build_parser()
+    p.prog = "bigdl_tpu.serving.fleet.worker"
+    p.add_argument("--workerIndex", type=int, default=0,
+                   help="this worker's slot in the fleet (router-"
+                        "assigned; labels heartbeats and metrics)")
+    return p
+
+
+def main(argv=None) -> int:
+    common.setup_logging()
+    args = build_parser().parse_args(argv)
+    if getattr(args, "fleet", 0):
+        raise SystemExit("--fleet belongs to the router process; a "
+                         "worker serves exactly one engine stack")
+    common.apply_platform(args)
+
+    # fleet chaos drill site: a --faultPlan 'worker_kill@worker_boot:N'
+    # kills the Nth boot of this PROCESS — the supervisor-restart path
+    # the fleet CI smoke exercises (no-op without a plan)
+    from bigdl_tpu.resilience.faults import hook as _fault_hook
+    _fault_hook("worker_boot")
+
+    from bigdl_tpu.cli import serve as serve_cli
+    from bigdl_tpu.serving import run_server
+
+    app, engine, in_shape, in_dtype = serve_cli.build_app(args)
+    WorkerControl(app, index=args.workerIndex,
+                  version=getattr(args, "modelVersion", None) or "v0",
+                  port=args.port)
+    if not args.no_warmup:
+        engines = ([r.engine for r in app.replicas.replicas]
+                   if app.replicas is not None else [engine])
+        for e in engines:
+            e.warmup(in_shape, in_dtype)
+    return run_server(app, args.host, args.port)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -112,7 +112,8 @@ class ServingApp:
                  decoder=None, request_timeout_s: float = 120.0,
                  default_deadline_ms: Optional[float] = None,
                  shed_generate_frac: float = 0.75,
-                 watchdog=None, replicas=None, clock=time.monotonic):
+                 watchdog=None, replicas=None, version: str = "v0",
+                 clock=time.monotonic):
         if replicas is not None and (engine is not None
                                      or batcher is not None
                                      or decoder is not None):
@@ -126,6 +127,14 @@ class ServingApp:
         self.watchdog = watchdog
         self.replicas = replicas
         self.clock = clock
+        # the weights generation served right now — bumped by the fleet
+        # rolling swap (ISSUE 20) and echoed as x-model-version on every
+        # response so a client can prove which weights answered it
+        self.model_version = str(version)
+        # extension point for process-role routes (the fleet worker's
+        # /control/state heartbeat and /admin/reload) — keyed
+        # ("GET"|"POST", path), handler returns (status, body_dict)
+        self.extra_routes = {}
         self.request_timeout_s = float(request_timeout_s)
         self.default_deadline_ms = (float(default_deadline_ms)
                                     if default_deadline_ms else None)
@@ -588,6 +597,9 @@ class _Handler(BaseHTTPRequestHandler):
             self.send_header("Retry-After", "1")
         if rid is not None:
             self.send_header("x-request-id", rid)
+        version = getattr(self.app, "model_version", None)
+        if version:
+            self.send_header("x-model-version", str(version))
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(data)))
         self.end_headers()
@@ -607,11 +619,17 @@ class _Handler(BaseHTTPRequestHandler):
             data = self.app.handle_metrics().encode()
             self.send_response(200)
             self.send_header("x-request-id", rid)
+            version = getattr(self.app, "model_version", None)
+            if version:
+                self.send_header("x-model-version", str(version))
             self.send_header("Content-Type",
                              "text/plain; version=0.0.4")
             self.send_header("Content-Length", str(len(data)))
             self.end_headers()
             self.wfile.write(data)
+        elif ("GET", self.path) in getattr(self.app, "extra_routes", {}):
+            handler = self.app.extra_routes[("GET", self.path)]
+            self._send_json(*handler(None), rid=rid)
         else:
             self._send_json(404, {"error": f"unknown path {self.path}"},
                             rid=rid)
@@ -632,6 +650,10 @@ class _Handler(BaseHTTPRequestHandler):
                 raise ValueError("body must be a JSON object")
         except (ValueError, json.JSONDecodeError) as e:
             self._send_json(400, {"error": f"bad JSON: {e}"}, rid=rid)
+            return
+        if ("POST", self.path) in getattr(self.app, "extra_routes", {}):
+            handler = self.app.extra_routes[("POST", self.path)]
+            self._send_json(*handler(payload), rid=rid)
             return
         if self.path.strip("/") == "generate" and payload.get("stream"):
             self._stream_generate(payload, rid)
@@ -673,6 +695,9 @@ class _Handler(BaseHTTPRequestHandler):
         try:
             self.send_response(200)
             self.send_header("x-request-id", rid)
+            version = getattr(app, "model_version", None)
+            if version:
+                self.send_header("x-model-version", str(version))
             self.send_header("Content-Type", "text/event-stream")
             self.send_header("Cache-Control", "no-cache")
             self.send_header("Transfer-Encoding", "chunked")

@@ -33,6 +33,11 @@ PRs 2-4 extended to serving.
     # --assertScaling 0.8)
     python scripts/serving_bench.py --dpSweep 1,2,4 \
         --model transformer_lm --endpoint generate
+
+    # CI fleet-smoke: ISSUE 20 multi-process fleet assertions (router
+    # proxy, kill/restart/rejoin, zero-5xx rolling weight swap)
+    python scripts/serving_bench.py --fleetSmoke --model transformer_lm \
+        --platform cpu
 """
 
 from __future__ import annotations
@@ -1148,7 +1153,229 @@ def run_chaos_smoke(args):
               flush=True)
     finally:
         _shutdown_clean(proc, log_lines)
+
+    # ---- leg 3 (ISSUE 20 satellite): rids must survive the router —
+    # 5xx responses produced BEHIND a proxy hop (and by the router
+    # itself once every worker is gone) still echo x-request-id
+    proc, url, log_lines = spawn_fleet(
+        args, list(args.serveArg)
+        + ["--faultPlan", "worker_kill@infer:2", "--watchdogStallS", "5",
+           "--fleetRestartBudget", "0"], k=1)
+    try:
+        st, _, hdr = _post_h(url + "/predict", rng_payload,
+                             headers={"x-request-id": "chaos-hop-00"})
+        assert st == 200, f"fleet predict -> {st}"
+        assert hdr.get("x-request-id") == "chaos-hop-00", hdr
+        # deadline expiry 504 answered by the WORKER, relayed by the
+        # router (dropped before compute, so no infer flush is spent)
+        st, body, hdr = _post_h(url + "/predict",
+                                {**rng_payload, "deadline_ms": 0},
+                                headers={"x-request-id": "chaos-hop-04"})
+        assert st == 504, f"proxied expired-deadline -> {st} ({body})"
+        assert hdr.get("x-request-id") == "chaos-hop-04", \
+            f"rid lost on proxied 504: {hdr}"
+        # 2nd infer flush kills the batcher worker thread: 500 then a
+        # fast 503, both proxied, both rid-stamped
+        st, body, hdr = _post_h(url + "/predict", rng_payload,
+                                headers={"x-request-id": "chaos-hop-05"})
+        assert st == 500, f"proxied killed-flush -> {st} ({body})"
+        assert hdr.get("x-request-id") == "chaos-hop-05", \
+            f"rid lost on proxied 500: {hdr}"
+        st, body, hdr = _post_h(url + "/predict", rng_payload,
+                                headers={"x-request-id": "chaos-hop-03"})
+        assert st == 503, f"proxied dead-worker -> {st} ({body})"
+        assert hdr.get("x-request-id") == "chaos-hop-03", \
+            f"rid lost on proxied 503: {hdr}"
+        # now remove the PROCESS: restart budget 0 means the router
+        # gives the slot up, and its OWN no-live-worker 503 (and the
+        # /readyz flip) must still carry the rid
+        st, body = _get(url + "/debug/fleet")
+        pid = json.loads(body)["workers"][0]["pid"]
+        os.kill(pid, signal.SIGKILL)
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            st, body, hdr = _post_h(url + "/predict", rng_payload,
+                                    headers={"x-request-id":
+                                             "chaos-hop-99"})
+            assert hdr.get("x-request-id") == "chaos-hop-99", \
+                f"rid lost on router {st}: {hdr}"
+            if st == 503 and "no live fleet worker" in \
+                    body.get("error", ""):
+                break
+            time.sleep(0.5)
+        else:
+            raise AssertionError("router never originated its own 503")
+        st, _ = _get_status(url + "/readyz")
+        assert st == 503, f"/readyz with zero workers -> {st}"
+        print("chaos-smoke: x-request-id survives the proxy hop on "
+              "504/500/503 + router-originated 503 OK", flush=True)
+    finally:
+        _shutdown_clean(proc, log_lines)
     print("chaos-smoke: all serving-hardening assertions OK", flush=True)
+    return 0
+
+
+def spawn_fleet(args, extra, k=2):
+    """Launch `bigdl-tpu serve --fleet K` (the ISSUE 20 router + K
+    worker processes) on an ephemeral port. Same contract as
+    spawn_server, but the port is parsed from the ROUTER's banner —
+    worker banners arrive first, prefixed ``[worker N]``, and must not
+    win."""
+    cmd = [sys.executable, "-m", "bigdl_tpu.cli.main", "serve",
+           args.model, "--port", "0", "--fleet", str(k)]
+    if args.ckpt:
+        cmd += ["--model", args.ckpt]
+    else:
+        cmd += ["--randomInit"]
+    if args.platform:
+        cmd += ["--platform", args.platform]
+    if args.model.startswith("transformer_lm") and (args.smoke
+                                                    or not args.ckpt):
+        cmd += _SMOKE_LM
+    cmd += extra
+    proc = subprocess.Popen(cmd, cwd=REPO, stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT, text=True)
+    lines, port = [], None
+    port_re = re.compile(r"^serving .+ fleet on http://[^:]+:(\d+)")
+    ready = threading.Event()
+
+    def _reader():
+        nonlocal port
+        for line in proc.stdout:
+            lines.append(line.rstrip())
+            m = port_re.match(lines[-1])
+            if m:
+                port = int(m.group(1))
+                ready.set()
+        ready.set()
+
+    threading.Thread(target=_reader, daemon=True).start()
+    if not ready.wait(timeout=600) or port is None:
+        proc.kill()
+        raise SystemExit("fleet router never reported its port; log "
+                         "tail:\n" + "\n".join(lines[-30:]))
+    return proc, f"http://127.0.0.1:{port}", lines
+
+
+def _make_lm_ckpt(path, seed=42):
+    """A version-stamped smoke-LM checkpoint (same dims as _SMOKE_LM)
+    for the rolling-swap leg — different seed, visibly different
+    weights."""
+    if REPO not in sys.path:
+        sys.path.insert(0, REPO)
+    import jax
+
+    from bigdl_tpu import models
+    from bigdl_tpu.utils.file import save_pytree
+    m = models.transformer_lm(64, d_model=32, num_layers=2, num_heads=2,
+                              max_len=64)
+    save_pytree({"params": m.init(jax.random.PRNGKey(seed)),
+                 "mod_state": m.init_state()},
+                os.path.join(path, "model.1"))
+    return path
+
+
+def run_fleet_smoke(args):
+    """ISSUE 20 fleet assertions (CI fleet-smoke job), one K=2 fleet:
+
+    leg 1 — the router front door: /generate proxied with the client
+    rid echoed and x-model-version stamped; /metrics carries the
+    router's bigdl_fleet series plus worker-labelled re-exports and
+    summed aggregates; /readyz 200.
+
+    leg 2 — elasticity: kill -9 one worker; /readyz stays 200 and
+    /generate keeps answering on the survivor throughout; the killed
+    worker is restarted within the supervisor budget and rejoins
+    rotation (restarts >= 1, routable again).
+
+    leg 3 — zero-downtime rolling swap: under continuous traffic, POST
+    /admin/reload to a version-B checkpoint; every response during the
+    swap is 200 (no 5xx window), both versions are observed across the
+    window, and afterwards every response reports vB."""
+    import tempfile
+
+    ckpt_b = _make_lm_ckpt(os.path.join(
+        tempfile.mkdtemp(prefix="fleet_smoke_"), "ck_vB"))
+    proc, url, log_lines = spawn_fleet(
+        args, list(args.serveArg) + ["--modelVersion", "vA"], k=2)
+    gen = {"tokens": [3, 1, 4], "max_new_tokens": 4}
+    try:
+        # ---- leg 1: router basics
+        st, _, hdr = _post_h(url + "/generate", gen,
+                             headers={"x-request-id": "fleet-smoke-00"})
+        assert st == 200, f"proxied generate -> {st}"
+        assert hdr.get("x-request-id") == "fleet-smoke-00", hdr
+        assert hdr.get("x-model-version") == "vA", hdr
+        st, _ = _get_status(url + "/readyz")
+        assert st == 200, f"/readyz -> {st}"
+        _, page = _get(url + "/metrics")
+        for needle in ("bigdl_fleet_workers 2",
+                       "bigdl_fleet_requests_generate_total",
+                       "# fleet aggregate", 'worker="0"', 'worker="1"'):
+            assert needle in page, f"fleet metrics missing {needle!r}"
+        print("fleet-smoke: router proxy + rid/version echo + "
+              "aggregated metrics OK", flush=True)
+
+        # ---- leg 2: kill one worker; serve through it, expect rejoin
+        _, body = _get(url + "/debug/fleet")
+        pid = json.loads(body)["workers"][0]["pid"]
+        os.kill(pid, signal.SIGKILL)
+        deadline = time.time() + 120
+        rejoined = False
+        while time.time() < deadline:
+            st, _ = _get_status(url + "/readyz")
+            assert st == 200, "/readyz flipped 503 with a live survivor"
+            st, _, _ = _post_h(url + "/generate", gen, timeout=60)
+            assert st == 200, f"generate during restart -> {st}"
+            _, body = _get(url + "/debug/fleet")
+            w0 = json.loads(body)["workers"][0]
+            if w0["routable"] and w0["restarts"] >= 1:
+                rejoined = True
+                break
+            time.sleep(1.0)
+        assert rejoined, "killed worker never rejoined rotation"
+        print("fleet-smoke: kill -9 -> restart + rejoin, /readyz 200 "
+              "throughout OK", flush=True)
+
+        # ---- leg 3: rolling swap under traffic, zero 5xx window
+        results = []
+        stop = threading.Event()
+
+        def _traffic():
+            while not stop.is_set():
+                s, _, h = _post_h(url + "/generate", gen, timeout=60)
+                results.append((s, h.get("x-model-version")))
+                time.sleep(0.05)
+
+        t = threading.Thread(target=_traffic, daemon=True)
+        t.start()
+        time.sleep(1.0)
+        st, body, _ = _post_h(url + "/admin/reload",
+                              {"checkpoint": ckpt_b, "version": "vB"},
+                              timeout=600)
+        assert st == 200, f"/admin/reload -> {st} ({body})"
+        assert all(r["status"] == "reloaded" for r in body["workers"]), \
+            body
+        time.sleep(1.0)
+        stop.set()
+        t.join(60)
+        statuses = sorted({s for s, _ in results})
+        versions = sorted({v for _, v in results})
+        assert statuses == [200], \
+            f"5xx window during rolling swap: {statuses}"
+        assert versions == ["vA", "vB"], \
+            f"expected both versions across the swap, saw {versions}"
+        st, _, hdr = _post_h(url + "/generate", gen)
+        assert st == 200 and hdr.get("x-model-version") == "vB", hdr
+        record = {"bench": "serving_fleet_smoke", "workers": 2,
+                  "swap_requests": len(results), "swap_5xx": 0,
+                  "versions_observed": versions}
+        print(json.dumps(record), flush=True)
+        print(f"fleet-smoke: rolling swap vA->vB with zero 5xx over "
+              f"{len(results)} in-flight requests OK", flush=True)
+    finally:
+        _shutdown_clean(proc, log_lines)
+    print("fleet-smoke: all ISSUE 20 fleet assertions OK", flush=True)
     return 0
 
 
@@ -1204,8 +1431,17 @@ def main(argv=None):
     p.add_argument("--chaosSmoke", action="store_true",
                    help="serving-hardening assertion pass (ISSUE 6): "
                         "deadline-expiry 504, worker-kill fast 503 + "
-                        "watchdog readiness flip (spawns its own "
-                        "servers)")
+                        "watchdog readiness flip, and x-request-id "
+                        "echo on 503/504s routed through a fleet "
+                        "proxy hop (spawns its own servers)")
+    p.add_argument("--fleetSmoke", action="store_true",
+                   help="serving-fleet assertion pass (ISSUE 20): "
+                        "2-worker fleet behind the router — proxied "
+                        "rid/version echo, worker-labelled + summed "
+                        "/metrics, kill -9 restart/rejoin with /readyz "
+                        "200 throughout, and a rolling /admin/reload "
+                        "with zero 5xx while both x-model-versions are "
+                        "observed (spawns its own fleet)")
     p.add_argument("--streamSmoke", action="store_true",
                    help="streaming /generate assertion pass (ISSUE 18): "
                         "streamed SSE tokens bit-identical to buffered "
@@ -1246,6 +1482,9 @@ def main(argv=None):
     if args.chaosSmoke:
         args.endpoint, args.batch = "predict", 2
         return run_chaos_smoke(args)
+    if args.fleetSmoke:
+        args.endpoint = "generate"
+        return run_fleet_smoke(args)
     if args.specSmoke:
         return run_spec_smoke(args)
     if args.quantSmoke:

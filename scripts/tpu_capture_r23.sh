@@ -1,0 +1,126 @@
+#!/usr/bin/env bash
+# Round-23 capture: ISSUE 20 (serving fleet tier) chip evidence. The
+# correctness contracts are CPU-verified (tests/test_fleet.py, the
+# tier1 fleet-smoke job): rolling-swap atomicity (in-flight decodes
+# finish on OLD weights, zero 5xx window), kill -9 -> supervised
+# restart + rejoin at the CURRENT weights, rid echo through the proxy
+# hop, /readyz 200 while >=1 worker lives. What only hardware can tell
+# us: (a) the rolling-swap 5xx window + p99 inflation at REAL reload
+# cost — a multi-GiB restore + re-place + re-quantize takes seconds on
+# chip, not the CPU smoke's milliseconds, so the drain window finally
+# means something; (b) the worker-kill goodput floor — tokens/s the
+# fleet holds with K-1 workers while the backoff ladder runs; (c) the
+# router proxy overhead vs PR 15's in-process dp:N at equal chip count
+# (the process hop must cost p50 noise, not a tier). Appends to $OUT,
+# mirrored into the repo per step. Results -> PERF.md §27 slots.
+
+set -uo pipefail
+cd "$(dirname "$0")/.."
+OUT="${OUT:-/tmp/tpu_capture_r23.log}"
+REPO_LOG="${REPO_LOG:-TPU_CAPTURE_r23.log}"
+trap 'cp -f "$OUT" "$REPO_LOG" 2>/dev/null || true' EXIT
+
+step() {
+  local name="$1" tmo="$2"; shift 2
+  echo "=== $name ($(date -u +%H:%M:%SZ))" | tee -a "$OUT"
+  timeout "$tmo" "$@" 2>&1 | tail -40 | tee -a "$OUT"
+  echo "=== end $name rc=$?" | tee -a "$OUT"
+  cp -f "$OUT" "$REPO_LOG" 2>/dev/null || true
+}
+
+# 0. the r23 test file + fleet smoke on this env (CPU backends first —
+#    proves the harness before burning chip time)
+step "pytest_r23" 1200 env JAX_PLATFORMS=cpu python -m pytest \
+  tests/test_fleet.py -q
+step "fleet_smoke_cpu" 1200 env JAX_PLATFORMS=cpu \
+  python scripts/serving_bench.py --fleetSmoke --model transformer_lm
+
+# 1. two REAL checkpoints for the A/B swap: same arch, different
+#    training seeds -> observably different weights. Point CKPT_A /
+#    CKPT_B at production checkpoints to override.
+CKPT_A="${CKPT_A:-/tmp/r23_ckpt_a}"
+CKPT_B="${CKPT_B:-/tmp/r23_ckpt_b}"
+LM_DIMS="--vocabSize 32000 --dModel 1024 --numLayers 8 --numHeads 16"
+if [ ! -d "$CKPT_A" ]; then
+  # shellcheck disable=SC2086
+  step "train_ckpt_a" 3600 python -m bigdl_tpu.cli.main train \
+    transformer_lm $LM_DIMS --seq 1024 -b 8 -i 50 --seed 1 \
+    --checkpoint "$CKPT_A" --dataType constant || true
+  # shellcheck disable=SC2086
+  step "train_ckpt_b" 3600 python -m bigdl_tpu.cli.main train \
+    transformer_lm $LM_DIMS --seq 1024 -b 8 -i 50 --seed 2 \
+    --checkpoint "$CKPT_B" --dataType constant || true
+fi
+
+# shared serving geometry — matches tpu_capture_r18..r22 so latency
+# reads against those logs
+LM="--serveArg=--vocabSize --serveArg=32000 \
+    --serveArg=--dModel --serveArg=1024 \
+    --serveArg=--numLayers --serveArg=8 \
+    --serveArg=--numHeads --serveArg=16 \
+    --serveArg=--seq --serveArg=1024 \
+    --serveArg=--slots --serveArg=8"
+GEN="--model transformer_lm --endpoint generate \
+     --requests 64 --promptLen 128 --maxNewTokens 128"
+
+# 2. THE r23 headline — rolling swap under sustained load at real
+#    reload cost. The fleet smoke drives its own kill + swap legs; on
+#    chip the interesting numbers are the swap-window 5xx count (must
+#    stay 0) and how long each worker's drain->restore->rejoin takes
+#    (the per-worker capacity dip). x3 reps.
+for REP in 1 2 3; do
+  step "fleet_swap_rep${REP}" 3600 env \
+    BIGDL_FLEET_CKPT_A="$CKPT_A" BIGDL_FLEET_CKPT_B="$CKPT_B" \
+    python scripts/serving_bench.py --fleetSmoke --model transformer_lm
+done
+
+# 3. proxy-overhead A/B at equal chip count: in-process dp:2 (PR 15)
+#    vs fleet --fleet 2 (this round), same bench geometry. Acceptance:
+#    fleet p50 within noise of dp:2; the delta IS the process hop.
+for REP in 1 2 3; do
+  # shellcheck disable=SC2086
+  step "dp2_rep${REP}" 1800 python scripts/serving_bench.py \
+    $GEN $LM --concurrency 8 \
+    --serveArg=--strategy --serveArg=dp:2 \
+    --serveArg=--reqTrace --serveArg=on || true
+  # shellcheck disable=SC2086
+  step "fleet2_rep${REP}" 1800 python scripts/serving_bench.py \
+    $GEN $LM --concurrency 8 \
+    --serveArg=--fleet --serveArg=2 \
+    --serveArg=--reqTrace --serveArg=on || true
+done
+
+# 4. worker-kill goodput floor: run the closed-loop bench against a
+#    2-worker fleet, kill -9 one worker a third of the way through
+#    (pid from /debug/fleet), let the supervisor restart it. The bench
+#    error count + the router's slo goodput gauges give the floor; the
+#    fleet must never 503 the whole window (readyz stays 200).
+step "fleet_kill_goodput" 3600 bash -c '
+  set -u
+  python scripts/serving_bench.py '"$GEN $LM"' --concurrency 8 \
+    --serveArg=--fleet --serveArg=2 \
+    --serveArg=--slo --serveArg=ttft=2000,tpot=100 &
+  BENCH=$!
+  sleep 45
+  PORT=$(ss -ltnp 2>/dev/null | grep -o ":80[0-9][0-9]" | head -1 | tr -d :)
+  PORT="${PORT:-8000}"
+  WPID=$(python -c "import json,urllib.request as u; \
+    d=json.load(u.urlopen(\"http://127.0.0.1:${PORT}/debug/fleet\")); \
+    print(d[\"workers\"][0][\"pid\"])" 2>/dev/null || echo "")
+  [ -n "$WPID" ] && kill -9 "$WPID" && echo "killed worker pid=$WPID"
+  wait "$BENCH"
+' || true
+
+# 5. composed production stack through the fleet: quantized weights +
+#    paged KV + speculation behind the router — the swap must
+#    re-quantize on reload and the proxy must not tax the decode.
+# shellcheck disable=SC2086
+step "fleet_quant_spec" 1800 python scripts/serving_bench.py \
+  $GEN $LM --concurrency 8 \
+  --serveArg=--fleet --serveArg=2 \
+  --serveArg=--quantize --serveArg=int8+kv8 \
+  --serveArg=--speculate --serveArg=4 \
+  --serveArg=--kvPageTokens --serveArg=128 || true
+
+# 6. summarize every JSON line in this log for PERF.md §27
+step "summarize" 300 python scripts/update_perf_from_capture.py "$OUT"
